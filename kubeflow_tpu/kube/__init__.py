@@ -1,0 +1,57 @@
+"""In-memory Kubernetes control-plane substrate (apiserver + controller
+runtime + fake data plane) that the TPU notebook controllers run against."""
+
+from .cluster import FakeCluster, parse_quantity
+from .controller import Manager, Reconciler, Request, Result, WatchSpec
+from .errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    ForbiddenError,
+    InvalidError,
+    NotFoundError,
+    is_already_exists,
+    is_conflict,
+    is_not_found,
+    retry_on_conflict,
+)
+from .events import EventRecorder
+from .meta import (
+    KubeObject,
+    ObjectMeta,
+    OwnerReference,
+    new_uid,
+    set_controller_reference,
+)
+from .store import AdmissionDenied, AdmissionHook, ApiServer, EventType, WatchEvent
+
+__all__ = [
+    "AdmissionDenied",
+    "AdmissionHook",
+    "AlreadyExistsError",
+    "ApiError",
+    "ApiServer",
+    "ConflictError",
+    "EventRecorder",
+    "EventType",
+    "FakeCluster",
+    "ForbiddenError",
+    "InvalidError",
+    "KubeObject",
+    "Manager",
+    "NotFoundError",
+    "ObjectMeta",
+    "OwnerReference",
+    "Reconciler",
+    "Request",
+    "Result",
+    "WatchEvent",
+    "WatchSpec",
+    "is_already_exists",
+    "is_conflict",
+    "is_not_found",
+    "new_uid",
+    "parse_quantity",
+    "retry_on_conflict",
+    "set_controller_reference",
+]

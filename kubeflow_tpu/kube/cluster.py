@@ -1,0 +1,340 @@
+"""Fake cluster data plane: kubelet + scheduler + node inventory.
+
+The reference never needed this — envtest has no kubelet and its single-pod
+workloads never run in tests (SURVEY.md §4.5).  A TPU framework does need it:
+multi-host slice scheduling must be testable without TPUs.  FakeCluster
+realizes StatefulSets into Pods (honoring ordinals), schedules them onto fake
+nodes with `google.com/tpu` allocatable capacity and
+`cloud.google.com/gke-tpu-*` labels (the fake device plugin), marks them
+Running/Ready, and emulates the OpenShift controller that mints a dockercfg
+pull secret per ServiceAccount (which the ODH lock-removal flow waits on,
+odh notebook_controller.go:155-186).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from .errors import NotFoundError
+from .meta import KubeObject, ObjectMeta, set_controller_reference
+from .store import ApiServer, EventType, WatchEvent
+
+
+def parse_quantity(q) -> float:
+    """Minimal k8s resource.Quantity parser (enough for cpu/memory/tpu)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q)
+    suffixes = {
+        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+    }
+    for suf in sorted(suffixes, key=len, reverse=True):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * suffixes[suf]
+    return float(s)
+
+
+class FakeCluster:
+    """Subscribes to the ApiServer and plays kubelet/scheduler/cloud."""
+
+    def __init__(self, api: ApiServer, auto_ready: bool = True) -> None:
+        self.api = api
+        self.auto_ready = auto_ready
+        self._pod_ip_counter = 0
+        self._failed_pods: set[tuple[str, str]] = set()
+        api.watch(self._on_event)
+
+    # -- node inventory --------------------------------------------------------
+    def add_node(
+        self,
+        name: str,
+        labels: Optional[dict[str, str]] = None,
+        allocatable: Optional[dict[str, str]] = None,
+    ) -> KubeObject:
+        node = KubeObject(
+            api_version="v1",
+            kind="Node",
+            metadata=ObjectMeta(name=name, labels=dict(labels or {})),
+            body={
+                "status": {
+                    "allocatable": dict(allocatable or {"cpu": "8", "memory": "32Gi"}),
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                }
+            },
+        )
+        return self.api.create(node)
+
+    def add_tpu_slice_nodes(
+        self,
+        accelerator: str,
+        topology: str,
+        num_hosts: int,
+        chips_per_host: int,
+        name_prefix: str = "tpu-node",
+    ) -> list[KubeObject]:
+        """Fake GKE TPU node pool: one node per slice host, labeled the way
+        GKE labels TPU nodes so nodeSelector scheduling is exercised."""
+        nodes = []
+        for i in range(num_hosts):
+            nodes.append(
+                self.add_node(
+                    f"{name_prefix}-{accelerator}-{i}",
+                    labels={
+                        "cloud.google.com/gke-tpu-accelerator": accelerator,
+                        "cloud.google.com/gke-tpu-topology": topology,
+                    },
+                    allocatable={
+                        "cpu": "96",
+                        "memory": "192Gi",
+                        "google.com/tpu": str(chips_per_host),
+                    },
+                )
+            )
+        return nodes
+
+    # -- failure injection -----------------------------------------------------
+    def fail_pod(self, namespace: str, name: str, reason: str = "TPUUnhealthy") -> None:
+        """Chaos hook: mark a pod failed (analog of the operator-chaos harness,
+        chaos/knowledge/workbenches.yaml)."""
+        pod = self.api.get("Pod", namespace, name)
+        pod.status = {
+            "phase": "Failed",
+            "reason": reason,
+            "conditions": [{"type": "Ready", "status": "False", "reason": reason}],
+            "containerStatuses": [
+                {
+                    "name": c.get("name", "main"),
+                    "ready": False,
+                    "state": {"terminated": {"exitCode": 137, "reason": reason}},
+                }
+                for c in pod.spec.get("containers", [])
+            ],
+        }
+        self._failed_pods.add((namespace, name))
+        self.api.update_status(pod)
+        self._sync_sts_status_for_pod(pod)
+
+    # -- event loop ------------------------------------------------------------
+    def _on_event(self, ev: WatchEvent) -> None:
+        kind = ev.obj.kind
+        if kind == "StatefulSet":
+            if ev.type in (EventType.ADDED, EventType.MODIFIED):
+                self._reconcile_sts(ev.obj.namespace, ev.obj.name)
+            elif ev.type == EventType.DELETED:
+                pass  # pods cascade via owner-ref GC
+        elif kind == "Pod" and ev.type == EventType.DELETED:
+            self._failed_pods.discard((ev.obj.namespace, ev.obj.name))
+            owner = ev.obj.metadata.controller_owner()
+            if owner is not None and owner.kind == "StatefulSet":
+                self._reconcile_sts(ev.obj.namespace, owner.name)
+            self._retry_pending_pods()  # freed capacity may unblock others
+        elif kind == "Node" and ev.type in (EventType.ADDED, EventType.MODIFIED):
+            self._retry_pending_pods()
+        elif kind == "ServiceAccount" and ev.type == EventType.ADDED:
+            self._mint_pull_secret(ev.obj)
+
+    # -- kubelet/scheduler -----------------------------------------------------
+    def _reconcile_sts(self, namespace: str, name: str) -> None:
+        sts = self.api.try_get("StatefulSet", namespace, name)
+        if sts is None:
+            return
+        want = int(sts.spec.get("replicas", 1))
+        for ordinal in range(want):
+            pod_name = f"{name}-{ordinal}"
+            if self.api.try_get("Pod", namespace, pod_name) is None:
+                self._create_pod(sts, ordinal)
+        # scale-down: delete pods beyond want (highest ordinal first)
+        extra = [
+            p
+            for p in self.api.list("Pod", namespace=namespace)
+            if (ref := p.metadata.controller_owner()) is not None
+            and ref.kind == "StatefulSet"
+            and ref.name == name
+            and _ordinal_of(p.name, name) is not None
+            and _ordinal_of(p.name, name) >= want
+        ]
+        for p in sorted(extra, key=lambda p: -(_ordinal_of(p.name, name) or 0)):
+            try:
+                self.api.delete("Pod", namespace, p.name)
+            except NotFoundError:
+                pass
+        self._sync_sts_status(namespace, name)
+
+    def _create_pod(self, sts: KubeObject, ordinal: int) -> None:
+        namespace, name = sts.namespace, f"{sts.name}-{ordinal}"
+        template = sts.spec.get("template", {})
+        tmeta = template.get("metadata", {})
+        pod = KubeObject(
+            api_version="v1",
+            kind="Pod",
+            metadata=ObjectMeta(
+                name=name,
+                namespace=namespace,
+                labels=dict(tmeta.get("labels") or {}),
+                annotations=dict(tmeta.get("annotations") or {}),
+            ),
+            body={"spec": copy.deepcopy(template.get("spec", {}))},
+        )
+        # indexed-statefulset identity: hostname + subdomain give each worker
+        # a stable DNS name through the headless service — the property
+        # TPU_WORKER_HOSTNAMES depends on
+        pod.spec["hostname"] = name
+        if sts.spec.get("serviceName"):
+            pod.spec["subdomain"] = sts.spec["serviceName"]
+        pod.metadata.labels["apps.kubernetes.io/pod-index"] = str(ordinal)
+        pod.metadata.labels.setdefault(
+            "statefulset.kubernetes.io/pod-name", name
+        )
+        sts_live = self.api.get("StatefulSet", namespace, sts.name)
+        set_controller_reference(sts_live, pod)
+
+        node = self._schedule(pod)
+        pod = self.api.create(pod)
+        if node is None:
+            pod.status = {
+                "phase": "Pending",
+                "conditions": [
+                    {
+                        "type": "PodScheduled",
+                        "status": "False",
+                        "reason": "Unschedulable",
+                        "message": "no node satisfies nodeSelector/resources",
+                    }
+                ],
+            }
+            self.api.update_status(pod)
+            return
+        pod.spec["nodeName"] = node.name
+        pod = self.api.update(pod)
+        if self.auto_ready:
+            self._mark_running(pod)
+
+    def _mark_running(self, pod: KubeObject) -> None:
+        self._pod_ip_counter += 1
+        pod.status = {
+            "phase": "Running",
+            "podIP": f"10.0.{self._pod_ip_counter // 256}.{self._pod_ip_counter % 256}",
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Initialized", "status": "True"},
+                {"type": "ContainersReady", "status": "True"},
+                {"type": "Ready", "status": "True"},
+            ],
+            "containerStatuses": [
+                {
+                    "name": c.get("name", "main"),
+                    "ready": True,
+                    "restartCount": 0,
+                    "image": c.get("image", ""),
+                    "state": {"running": {"startedAt": pod.metadata.creation_timestamp}},
+                }
+                for c in pod.spec.get("containers", [])
+            ],
+        }
+        self.api.update_status(pod)
+
+    def _schedule(self, pod: KubeObject) -> Optional[KubeObject]:
+        selector = pod.spec.get("nodeSelector") or {}
+        requests: dict[str, float] = {}
+        for c in pod.spec.get("containers", []):
+            for res, q in (c.get("resources", {}).get("requests") or {}).items():
+                requests[res] = requests.get(res, 0.0) + parse_quantity(q)
+        for node in self.api.list("Node"):
+            node_labels = node.metadata.labels
+            if not all(node_labels.get(k) == v for k, v in selector.items()):
+                continue
+            alloc = node.body.get("status", {}).get("allocatable", {})
+            # subtract pods already bound to this node
+            used: dict[str, float] = {}
+            for p in self.api.list("Pod"):
+                if p.spec.get("nodeName") != node.name:
+                    continue
+                for c in p.spec.get("containers", []):
+                    for res, q in (c.get("resources", {}).get("requests") or {}).items():
+                        used[res] = used.get(res, 0.0) + parse_quantity(q)
+            if all(
+                parse_quantity(alloc.get(res, 0)) - used.get(res, 0.0) >= need
+                for res, need in requests.items()
+            ):
+                return node
+        return None
+
+    def _retry_pending_pods(self) -> None:
+        """Re-run scheduling for pods that previously found no fitting node
+        (real kube-scheduler retries on Node add / capacity change)."""
+        for pod in self.api.list("Pod"):
+            status = pod.body.get("status", {})
+            if status.get("phase") != "Pending" or pod.spec.get("nodeName"):
+                continue
+            node = self._schedule(pod)
+            if node is None:
+                continue
+            pod.spec["nodeName"] = node.name
+            pod = self.api.update(pod)
+            if self.auto_ready:
+                self._mark_running(pod)
+            self._sync_sts_status_for_pod(pod)
+
+    def _sync_sts_status_for_pod(self, pod: KubeObject) -> None:
+        ref = pod.metadata.controller_owner()
+        if ref is not None and ref.kind == "StatefulSet":
+            self._sync_sts_status(pod.namespace, ref.name)
+
+    def _sync_sts_status(self, namespace: str, name: str) -> None:
+        sts = self.api.try_get("StatefulSet", namespace, name)
+        if sts is None:
+            return
+        pods = [
+            p
+            for p in self.api.list("Pod", namespace=namespace)
+            if (ref := p.metadata.controller_owner()) is not None
+            and ref.kind == "StatefulSet"
+            and ref.name == name
+        ]
+        ready = sum(
+            1
+            for p in pods
+            if any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in p.body.get("status", {}).get("conditions", [])
+            )
+        )
+        sts.status = {
+            "replicas": len(pods),
+            "readyReplicas": ready,
+            "currentReplicas": len(pods),
+            "observedGeneration": sts.metadata.generation,
+        }
+        self.api.update_status(sts)
+
+    # -- openshift service-account controller ---------------------------------
+    def _mint_pull_secret(self, sa: KubeObject) -> None:
+        secret = KubeObject(
+            api_version="v1",
+            kind="Secret",
+            metadata=ObjectMeta(
+                name=f"{sa.name}-dockercfg",
+                namespace=sa.namespace,
+                annotations={"kubernetes.io/service-account.name": sa.name},
+            ),
+            body={"type": "kubernetes.io/dockercfg", "data": {".dockercfg": "e30="}},
+        )
+        try:
+            self.api.create(secret)
+        except Exception:
+            pass
+        live = self.api.get("ServiceAccount", sa.namespace, sa.name)
+        secrets = live.body.setdefault("imagePullSecrets", [])
+        if {"name": secret.name} not in secrets:
+            secrets.append({"name": secret.name})
+            self.api.update(live)
+
+
+def _ordinal_of(pod_name: str, sts_name: str) -> Optional[int]:
+    prefix = sts_name + "-"
+    if not pod_name.startswith(prefix):
+        return None
+    suffix = pod_name[len(prefix):]
+    return int(suffix) if suffix.isdigit() else None

@@ -1,0 +1,79 @@
+"""API error model mirroring apimachinery's StatusError reasons.
+
+The reference leans on k8s error predicates (apierrs.IsNotFound,
+retry.RetryOnConflict) throughout, e.g.
+components/notebook-controller/controllers/culling_controller.go:107,125,144.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+
+class ApiError(Exception):
+    reason = "Unknown"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+
+class NotFoundError(ApiError):
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    reason = "Invalid"
+
+
+class ForbiddenError(ApiError):
+    reason = "Forbidden"
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err: Exception) -> bool:
+    return isinstance(err, ConflictError)
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, AlreadyExistsError)
+
+
+T = TypeVar("T")
+
+
+def retry_on_conflict(
+    fn: Callable[[], T],
+    steps: int = 5,
+    initial_backoff_s: float = 0.0,
+    factor: float = 2.0,
+) -> T:
+    """Equivalent of retry.RetryOnConflict(retry.DefaultRetry, fn).
+
+    The in-memory API server is synchronous so the default backoff is zero;
+    steps mirror client-go's DefaultRetry (5 attempts).
+    """
+    backoff = initial_backoff_s
+    last: Exception | None = None
+    for _ in range(steps):
+        try:
+            return fn()
+        except ConflictError as err:
+            last = err
+            if backoff:
+                time.sleep(backoff)
+                backoff *= factor
+    assert last is not None
+    raise last

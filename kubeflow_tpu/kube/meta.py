@@ -1,0 +1,229 @@
+"""Object metadata and generic object model for the in-memory control plane.
+
+The reference builds on k8s apimachinery (metav1.ObjectMeta and friends).  We
+model the subset the notebook stack actually uses: names/namespaces, labels,
+annotations, ownerReferences, finalizers, resourceVersion-based optimistic
+concurrency, and deletionTimestamp-driven finalization.  Objects are typed
+wrappers over plain dicts ("unstructured" style) because the Notebook CRD's
+pod template is a raw PodSpec passthrough in the reference
+(components/notebook-controller/api/v1/notebook_types.go:26-40) and dicts keep
+that passthrough lossless.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+@dataclass
+class OwnerReference:
+    api_version: str
+    kind: str
+    name: str
+    uid: str
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "name": self.name,
+            "uid": self.uid,
+            "controller": self.controller,
+            "blockOwnerDeletion": self.block_owner_deletion,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OwnerReference":
+        return cls(
+            api_version=d.get("apiVersion", ""),
+            kind=d.get("kind", ""),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            controller=bool(d.get("controller", False)),
+            block_owner_deletion=bool(d.get("blockOwnerDeletion", False)),
+        )
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    generate_name: str = ""
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: str = ""
+    deletion_timestamp: Optional[str] = None
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    finalizers: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "name": self.name,
+            "namespace": self.namespace,
+            "uid": self.uid,
+            "resourceVersion": str(self.resource_version),
+            "generation": self.generation,
+            "creationTimestamp": self.creation_timestamp,
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+        }
+        if self.generate_name:
+            d["generateName"] = self.generate_name
+        if self.deletion_timestamp:
+            d["deletionTimestamp"] = self.deletion_timestamp
+        if self.owner_references:
+            d["ownerReferences"] = [o.to_dict() for o in self.owner_references]
+        if self.finalizers:
+            d["finalizers"] = list(self.finalizers)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", ""),
+            generate_name=d.get("generateName", ""),
+            uid=d.get("uid", ""),
+            resource_version=int(d.get("resourceVersion", 0) or 0),
+            generation=int(d.get("generation", 0) or 0),
+            creation_timestamp=d.get("creationTimestamp", ""),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_references=[
+                OwnerReference.from_dict(o) for o in d.get("ownerReferences") or []
+            ],
+            finalizers=list(d.get("finalizers") or []),
+        )
+
+    def controller_owner(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass
+class KubeObject:
+    """Generic API object: typed metadata + unstructured body.
+
+    `body` holds everything outside metadata (spec/status/data/subsets/...).
+    """
+
+    api_version: str
+    kind: str
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    body: dict[str, Any] = field(default_factory=dict)
+
+    # -- convenience accessors ------------------------------------------------
+    @property
+    def spec(self) -> dict:
+        return self.body.setdefault("spec", {})
+
+    @spec.setter
+    def spec(self, value: dict) -> None:
+        self.body["spec"] = value
+
+    @property
+    def status(self) -> dict:
+        return self.body.setdefault("status", {})
+
+    @status.setter
+    def status(self, value: dict) -> None:
+        self.body["status"] = value
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return self.metadata.labels
+
+    @property
+    def annotations(self) -> dict[str, str]:
+        return self.metadata.annotations
+
+    def gvk(self) -> tuple[str, str]:
+        return (self.api_version, self.kind)
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.kind, self.metadata.namespace, self.metadata.name)
+
+    def deepcopy(self) -> "KubeObject":
+        return KubeObject(
+            api_version=self.api_version,
+            kind=self.kind,
+            metadata=copy.deepcopy(self.metadata),
+            body=copy.deepcopy(self.body),
+        )
+
+    def to_dict(self) -> dict:
+        d = {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+        }
+        d.update(copy.deepcopy(self.body))
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KubeObject":
+        body = {k: v for k, v in d.items() if k not in ("apiVersion", "kind", "metadata")}
+        return cls(
+            api_version=d.get("apiVersion", ""),
+            kind=d.get("kind", ""),
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            body=copy.deepcopy(body),
+        )
+
+    def owner_reference(self, controller: bool = True) -> OwnerReference:
+        return OwnerReference(
+            api_version=self.api_version,
+            kind=self.kind,
+            name=self.metadata.name,
+            uid=self.metadata.uid,
+            controller=controller,
+            block_owner_deletion=controller,
+        )
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def set_controller_reference(owner: KubeObject, controlled: KubeObject) -> None:
+    """Equivalent of controllerutil.SetControllerReference: exactly one
+    controller ref, same namespace enforced (cross-namespace ownership is
+    illegal in k8s — the reference works around it with finalizers for
+    HTTPRoutes, odh notebook_controller.go:206-333)."""
+    if owner.metadata.namespace != controlled.metadata.namespace:
+        raise ValueError(
+            "cross-namespace owner references are not allowed "
+            f"({owner.metadata.namespace} -> {controlled.metadata.namespace})"
+        )
+    existing = controlled.metadata.controller_owner()
+    if existing is not None and existing.uid != owner.metadata.uid:
+        raise ValueError(f"object already controlled by {existing.name}")
+    ref = owner.owner_reference(controller=True)
+    controlled.metadata.owner_references = [
+        r for r in controlled.metadata.owner_references if not r.controller
+    ]
+    controlled.metadata.owner_references.append(ref)

@@ -1,0 +1,330 @@
+"""In-memory API server: the substrate both controllers reconcile against.
+
+This plays the role etcd + kube-apiserver play for the reference (its tests
+spin a real apiserver via envtest,
+components/notebook-controller/controllers/suite_test.go:50-110; we keep the
+same semantics — optimistic concurrency on resourceVersion, admission chain in
+the write path, finalizer-gated deletion, owner-reference garbage collection,
+watch fan-out) in a deterministic, dependency-free form suitable for pytest
+and for running the whole stack standalone.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+from .errors import (
+    AlreadyExistsError,
+    ConflictError,
+    ForbiddenError,
+    InvalidError,
+    NotFoundError,
+)
+from .meta import KubeObject, new_uid, now_iso
+
+
+class EventType(Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: EventType
+    obj: KubeObject
+
+
+class AdmissionDenied(ForbiddenError):
+    """Raised by a validating admission hook to reject a write."""
+
+
+@dataclass
+class AdmissionHook:
+    """Registered admission webhook (mutating or validating).
+
+    The reference registers these on the apiserver via
+    WebhookInstallOptions (odh suite_test.go:121-124); handlers receive the
+    old and new object and either mutate (mutating) or raise AdmissionDenied
+    (validating).  `operations` is a subset of {"CREATE", "UPDATE", "DELETE"}.
+    """
+
+    kinds: tuple[str, ...]
+    handler: Callable[[str, Optional[KubeObject], KubeObject], Optional[KubeObject]]
+    operations: tuple[str, ...] = ("CREATE", "UPDATE")
+    mutating: bool = True
+    name: str = ""
+
+
+def match_labels(labels: dict[str, str], selector: Optional[dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class ApiServer:
+    """Thread-safe in-memory object store with k8s write-path semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # kind -> (namespace, name) -> KubeObject
+        self._objects: dict[str, dict[tuple[str, str], KubeObject]] = {}
+        self._rv_counter = 0
+        self._name_counter = 0
+        self._watchers: list[Callable[[WatchEvent], None]] = []
+        self._mutating: list[AdmissionHook] = []
+        self._validating: list[AdmissionHook] = []
+
+    # -- watch / admission registration --------------------------------------
+    def watch(self, fn: Callable[[WatchEvent], None]) -> None:
+        with self._lock:
+            self._watchers.append(fn)
+
+    def register_admission(self, hook: AdmissionHook) -> None:
+        with self._lock:
+            (self._mutating if hook.mutating else self._validating).append(hook)
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for fn in list(self._watchers):
+            fn(WatchEvent(ev.type, ev.obj.deepcopy()))
+
+    def _next_rv(self) -> int:
+        self._rv_counter += 1
+        return self._rv_counter
+
+    # -- reads ----------------------------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> KubeObject:
+        with self._lock:
+            obj = self._objects.get(kind, {}).get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return obj.deepcopy()
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[KubeObject]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[KubeObject]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._objects.get(kind, {}).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if not match_labels(obj.metadata.labels, label_selector):
+                    continue
+                out.append(obj.deepcopy())
+            return sorted(out, key=lambda o: (o.namespace, o.name))
+
+    # -- admission ------------------------------------------------------------
+    def _admit(
+        self, op: str, old: Optional[KubeObject], obj: KubeObject
+    ) -> KubeObject:
+        for hook in self._mutating:
+            if obj.kind in hook.kinds and op in hook.operations:
+                mutated = hook.handler(op, old, obj.deepcopy())
+                if mutated is not None:
+                    obj = mutated
+        for hook in self._validating:
+            if obj.kind in hook.kinds and op in hook.operations:
+                hook.handler(op, old, obj.deepcopy())  # raises AdmissionDenied
+        return obj
+
+    # -- writes ---------------------------------------------------------------
+    def create(self, obj: KubeObject) -> KubeObject:
+        with self._lock:
+            obj = obj.deepcopy()
+            if not obj.metadata.name and obj.metadata.generate_name:
+                self._name_counter += 1
+                obj.metadata.name = f"{obj.metadata.generate_name}{self._name_counter:05x}"
+            if not obj.metadata.name:
+                raise InvalidError("metadata.name or generateName required")
+            # admission first: a mutating hook may rewrite metadata, and the
+            # store must be keyed by the post-admission identity
+            obj = self._admit("CREATE", None, obj)
+            key = (obj.metadata.namespace, obj.metadata.name)
+            kind_store = self._objects.setdefault(obj.kind, {})
+            if key in kind_store:
+                raise AlreadyExistsError(
+                    f"{obj.kind} {key[0]}/{key[1]} already exists"
+                )
+            obj.metadata.uid = new_uid()
+            obj.metadata.resource_version = self._next_rv()
+            obj.metadata.generation = 1
+            obj.metadata.creation_timestamp = now_iso()
+            kind_store[key] = obj
+            stored = obj.deepcopy()
+        self._notify(WatchEvent(EventType.ADDED, stored))
+        return stored
+
+    def update(self, obj: KubeObject, subresource: str = "") -> KubeObject:
+        """Full-object update with optimistic concurrency.
+
+        subresource="status" skips admission and generation bump, matching
+        the /status subresource the reference writes via Status().Update()
+        (notebook_controller.go:312).
+        """
+        with self._lock:
+            obj = obj.deepcopy()
+            key = (obj.metadata.namespace, obj.metadata.name)
+            kind_store = self._objects.setdefault(obj.kind, {})
+            old = kind_store.get(key)
+            if old is None:
+                raise NotFoundError(f"{obj.kind} {key[0]}/{key[1]} not found")
+            if not obj.metadata.resource_version:
+                raise InvalidError(
+                    f"{obj.kind} {key[0]}/{key[1]}: resourceVersion must be "
+                    "specified for an update (read-modify-write required)"
+                )
+            if obj.metadata.resource_version != old.metadata.resource_version:
+                raise ConflictError(
+                    f"{obj.kind} {key[0]}/{key[1]}: resourceVersion "
+                    f"{obj.metadata.resource_version} != {old.metadata.resource_version}"
+                )
+            if subresource == "status":
+                merged = old.deepcopy()
+                merged.body["status"] = copy.deepcopy(obj.body.get("status", {}))
+            else:
+                merged = obj
+                # status writes only through the status subresource
+                if "status" in old.body:
+                    merged.body["status"] = copy.deepcopy(old.body["status"])
+                elif "status" in merged.body:
+                    del merged.body["status"]
+                merged = self._admit("UPDATE", old, merged)
+                # name/namespace are immutable on update; keep keying sound
+                merged.metadata.name = old.metadata.name
+                merged.metadata.namespace = old.metadata.namespace
+                if merged.body.get("spec") != old.body.get("spec"):
+                    merged.metadata.generation = old.metadata.generation + 1
+                else:
+                    merged.metadata.generation = old.metadata.generation
+            # immutable fields
+            merged.metadata.uid = old.metadata.uid
+            merged.metadata.creation_timestamp = old.metadata.creation_timestamp
+            merged.metadata.deletion_timestamp = old.metadata.deletion_timestamp
+            # no-op writes don't bump resourceVersion or wake watchers —
+            # otherwise level-triggered loops (status sync) self-oscillate
+            merged.metadata.resource_version = old.metadata.resource_version
+            if merged.to_dict() == old.to_dict():
+                return old.deepcopy()
+            merged.metadata.resource_version = self._next_rv()
+            kind_store[key] = merged
+            stored = merged.deepcopy()
+        self._notify(WatchEvent(EventType.MODIFIED, stored))
+        # finalizer removal on a deleting object may complete the delete
+        if stored.metadata.deletion_timestamp and not stored.metadata.finalizers:
+            self._finalize_delete(stored.kind, stored.namespace, stored.name)
+        return stored
+
+    def update_status(self, obj: KubeObject) -> KubeObject:
+        return self.update(obj, subresource="status")
+
+    def merge_patch(
+        self, kind: str, namespace: str, name: str, patch: dict
+    ) -> KubeObject:
+        """RFC 7386 merge patch; `None` values delete keys.  Used by the ODH
+        controller's lock removal (merge-patch with null annotation value,
+        odh notebook_controller.go:516-523).  Holds the (reentrant) lock
+        across read+write: a merge patch never conflicts, matching the
+        apiserver."""
+        with self._lock:
+            current = self.get(kind, namespace, name)
+            merged_dict = _json_merge(current.to_dict(), patch)
+            merged = KubeObject.from_dict(merged_dict)
+            merged.metadata.resource_version = current.metadata.resource_version
+            return self.update(merged)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            obj = self._objects.get(kind, {}).get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = now_iso()
+                    obj.metadata.resource_version = self._next_rv()
+                    stored = obj.deepcopy()
+                else:
+                    return  # already terminating
+            else:
+                stored = None
+        if stored is not None:
+            self._notify(WatchEvent(EventType.MODIFIED, stored))
+            return
+        self._finalize_delete(kind, namespace, name)
+
+    def _finalize_delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            obj = self._objects.get(kind, {}).pop((namespace, name), None)
+            if obj is None:
+                return
+        self._notify(WatchEvent(EventType.DELETED, obj.deepcopy()))
+        self._garbage_collect(obj)
+
+    def _garbage_collect(self, owner: KubeObject) -> None:
+        """Background-cascade GC, matching real k8s semantics: drop the
+        now-dangling ownerReference; delete the dependent only once its last
+        owner is gone (same namespace only, as in real k8s GC)."""
+        to_delete: list[tuple[str, str, str]] = []
+        to_strip: list[KubeObject] = []
+        with self._lock:
+            for kind, kind_store in self._objects.items():
+                for (ns, name), obj in kind_store.items():
+                    if ns != owner.namespace:
+                        continue
+                    refs = obj.metadata.owner_references
+                    if not any(r.uid == owner.metadata.uid for r in refs):
+                        continue
+                    remaining = [r for r in refs if r.uid != owner.metadata.uid]
+                    if remaining:
+                        stripped = obj.deepcopy()
+                        stripped.metadata.owner_references = remaining
+                        to_strip.append(stripped)
+                    else:
+                        to_delete.append((kind, ns, name))
+        for obj in to_strip:
+            try:
+                self.update(obj)
+            except (NotFoundError, ConflictError):
+                pass
+        for kind, ns, name in to_delete:
+            try:
+                self.delete(kind, ns, name)
+            except NotFoundError:
+                pass
+
+    # -- test/ops helpers ------------------------------------------------------
+    def force_remove_finalizers(self, kind: str, namespace: str, name: str) -> None:
+        obj = self.get(kind, namespace, name)
+        obj.metadata.finalizers = []
+        self.update(obj)
+
+    def dump(self) -> dict[str, list[dict]]:
+        with self._lock:
+            return {
+                kind: [o.to_dict() for o in store.values()]
+                for kind, store in self._objects.items()
+            }
+
+
+def _json_merge(base: dict, patch: dict) -> dict:
+    out = copy.deepcopy(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _json_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out

@@ -1,0 +1,37 @@
+"""Clock abstraction so culling/idleness logic is testable.
+
+The reference manipulates time in tests by rewriting annotation timestamps
+(culling_controller_test.go:95-142); we inject a clock instead.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.time()
+
+    def now_iso(self) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self.now()))
+
+
+class FakeClock(Clock):
+    def __init__(self, start: float = 1_700_000_000.0) -> None:
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+    def set(self, t: float) -> None:
+        self._now = t
+
+
+def parse_iso(ts: str) -> float:
+    import calendar
+
+    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))

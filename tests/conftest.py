@@ -1,0 +1,17 @@
+"""Test-wide environment: force an 8-device virtual CPU mesh.
+
+The reference tests controllers with envtest (real apiserver, no kubelet:
+components/notebook-controller/controllers/suite_test.go:50-110).  Our analog
+is the in-memory API server in kubeflow_tpu.kube; for the compute plane we
+emulate a TPU slice with 8 virtual CPU devices so sharding/collective code is
+exercised without hardware.  Must run before the first `import jax`.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

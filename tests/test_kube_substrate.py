@@ -1,0 +1,358 @@
+"""Tests for the in-memory control-plane substrate (our envtest analog)."""
+
+import pytest
+
+from kubeflow_tpu.kube import (
+    AdmissionDenied,
+    AdmissionHook,
+    ApiServer,
+    ConflictError,
+    EventRecorder,
+    FakeCluster,
+    KubeObject,
+    Manager,
+    NotFoundError,
+    ObjectMeta,
+    Request,
+    Result,
+    WatchSpec,
+    retry_on_conflict,
+    set_controller_reference,
+)
+from kubeflow_tpu.utils.clock import FakeClock
+
+
+def mk(kind, name, ns="default", labels=None, spec=None, api_version="v1"):
+    return KubeObject(
+        api_version=api_version,
+        kind=kind,
+        metadata=ObjectMeta(name=name, namespace=ns, labels=dict(labels or {})),
+        body={"spec": spec or {}},
+    )
+
+
+class TestApiServer:
+    def test_create_get_list(self):
+        api = ApiServer()
+        api.create(mk("ConfigMap", "a", labels={"x": "1"}))
+        api.create(mk("ConfigMap", "b", ns="other"))
+        got = api.get("ConfigMap", "default", "a")
+        assert got.metadata.uid and got.metadata.resource_version > 0
+        assert len(api.list("ConfigMap")) == 2
+        assert len(api.list("ConfigMap", namespace="default")) == 1
+        assert len(api.list("ConfigMap", label_selector={"x": "1"})) == 1
+        assert api.list("ConfigMap", label_selector={"x": "2"}) == []
+
+    def test_generate_name(self):
+        api = ApiServer()
+        obj = KubeObject("v1", "ConfigMap", ObjectMeta(generate_name="nb-", namespace="d"))
+        created = api.create(obj)
+        assert created.name.startswith("nb-") and len(created.name) > 3
+
+    def test_update_conflict(self):
+        api = ApiServer()
+        api.create(mk("ConfigMap", "a"))
+        c1 = api.get("ConfigMap", "default", "a")
+        c2 = api.get("ConfigMap", "default", "a")
+        c1.body["data"] = {"k": "1"}
+        api.update(c1)
+        c2.body["data"] = {"k": "2"}
+        with pytest.raises(ConflictError):
+            api.update(c2)
+        # retry_on_conflict with a fresh read succeeds
+        def attempt():
+            fresh = api.get("ConfigMap", "default", "a")
+            fresh.body["data"] = {"k": "2"}
+            api.update(fresh)
+        retry_on_conflict(attempt)
+        assert api.get("ConfigMap", "default", "a").body["data"] == {"k": "2"}
+
+    def test_status_subresource_isolation(self):
+        api = ApiServer()
+        api.create(mk("Notebook", "nb", spec={"x": 1}, api_version="kubeflow.org/v1"))
+        obj = api.get("Notebook", "default", "nb")
+        obj.status = {"readyReplicas": 1}
+        api.update_status(obj)
+        # a spec update must not clobber status, and vice versa
+        obj2 = api.get("Notebook", "default", "nb")
+        obj2.spec = {"x": 2}
+        api.update(obj2)
+        live = api.get("Notebook", "default", "nb")
+        assert live.status == {"readyReplicas": 1}
+        assert live.spec == {"x": 2}
+        assert live.metadata.generation == 2  # spec change bumps generation
+
+    def test_merge_patch_null_deletes(self):
+        api = ApiServer()
+        nb = mk("Notebook", "nb")
+        nb.metadata.annotations["kubeflow-resource-stopped"] = "lock"
+        api.create(nb)
+        api.merge_patch(
+            "Notebook", "default", "nb",
+            {"metadata": {"annotations": {"kubeflow-resource-stopped": None}}},
+        )
+        live = api.get("Notebook", "default", "nb")
+        assert "kubeflow-resource-stopped" not in live.metadata.annotations
+
+    def test_finalizers_gate_deletion(self):
+        api = ApiServer()
+        nb = mk("Notebook", "nb")
+        nb.metadata.finalizers = ["odh.opendatahub.io/cleanup"]
+        api.create(nb)
+        api.delete("Notebook", "default", "nb")
+        live = api.get("Notebook", "default", "nb")  # still present
+        assert live.metadata.deletion_timestamp is not None
+        live.metadata.finalizers = []
+        api.update(live)
+        with pytest.raises(NotFoundError):
+            api.get("Notebook", "default", "nb")
+
+    def test_update_without_resource_version_rejected(self):
+        from kubeflow_tpu.kube import InvalidError
+        api = ApiServer()
+        api.create(mk("ConfigMap", "a"))
+        fresh = mk("ConfigMap", "a")  # no resourceVersion
+        with pytest.raises(InvalidError):
+            api.update(fresh)
+
+    def test_gc_waits_for_last_owner(self):
+        api = ApiServer()
+        o1 = api.create(mk("Notebook", "nb1"))
+        o2 = api.create(mk("Notebook", "nb2"))
+        shared = mk("ReferenceGrant", "shared")
+        shared.metadata.owner_references = [
+            o1.owner_reference(controller=False),
+            o2.owner_reference(controller=False),
+        ]
+        api.create(shared)
+        api.delete("Notebook", "default", "nb1")
+        live = api.get("ReferenceGrant", "default", "shared")  # survives
+        assert len(live.metadata.owner_references) == 1
+        api.delete("Notebook", "default", "nb2")
+        with pytest.raises(NotFoundError):
+            api.get("ReferenceGrant", "default", "shared")
+
+    def test_owner_ref_cascade(self):
+        api = ApiServer()
+        owner = api.create(mk("Notebook", "nb"))
+        child = mk("StatefulSet", "nb", api_version="apps/v1")
+        set_controller_reference(owner, child)
+        api.create(child)
+        api.delete("Notebook", "default", "nb")
+        with pytest.raises(NotFoundError):
+            api.get("StatefulSet", "default", "nb")
+
+    def test_admission_mutating_and_validating(self):
+        api = ApiServer()
+
+        def mutate(op, old, obj):
+            if op == "CREATE":
+                obj.metadata.annotations["injected"] = "yes"
+            return obj
+
+        def validate(op, old, obj):
+            if obj.metadata.labels.get("forbidden") == "true":
+                raise AdmissionDenied("forbidden label")
+
+        api.register_admission(AdmissionHook(kinds=("Notebook",), handler=mutate))
+        api.register_admission(
+            AdmissionHook(kinds=("Notebook",), handler=validate, mutating=False)
+        )
+        created = api.create(mk("Notebook", "nb"))
+        assert created.metadata.annotations["injected"] == "yes"
+        with pytest.raises(AdmissionDenied):
+            api.create(mk("Notebook", "bad", labels={"forbidden": "true"}))
+
+
+class _CountingReconciler:
+    def __init__(self, api):
+        self.api = api
+        self.seen = []
+
+    def reconcile(self, req):
+        self.seen.append(req)
+        return Result()
+
+
+class TestManager:
+    def test_for_owns_watch_routing(self):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+        rec = _CountingReconciler(api)
+        mgr.register(
+            "nb",
+            rec,
+            for_kind="Notebook",
+            owns=["StatefulSet"],
+            watches=[
+                WatchSpec(
+                    kind="Pod",
+                    mapper=lambda pod: (
+                        [Request(pod.namespace, pod.labels["notebook-name"])]
+                        if "notebook-name" in pod.labels
+                        else []
+                    ),
+                )
+            ],
+        )
+        owner = api.create(mk("Notebook", "nb1"))
+        sts = mk("StatefulSet", "nb1", api_version="apps/v1")
+        set_controller_reference(owner, sts)
+        api.create(sts)
+        api.create(mk("Pod", "nb1-0", labels={"notebook-name": "nb1"}))
+        api.create(mk("Pod", "random"))  # no label -> no request
+        mgr.run_until_idle()
+        # workqueue dedupe: three events for the same key collapse to one run
+        assert Request("default", "nb1") in rec.seen
+        assert all(r.name == "nb1" for r in rec.seen)
+
+    def test_requeue_after_with_fake_clock(self):
+        api = ApiServer()
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+
+        class R:
+            def __init__(self):
+                self.calls = 0
+
+            def reconcile(self, req):
+                self.calls += 1
+                return Result(requeue_after=60.0) if self.calls == 1 else Result()
+
+        rec = R()
+        mgr.register("nb", rec, for_kind="Notebook")
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        assert rec.calls == 1
+        assert len(mgr.pending_delayed()) == 1
+        mgr.advance(59.0)
+        assert rec.calls == 1
+        mgr.advance(2.0)
+        assert rec.calls == 2
+
+    def test_error_retry_then_drop(self):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+
+        class Failing:
+            def __init__(self):
+                self.calls = 0
+
+            def reconcile(self, req):
+                self.calls += 1
+                raise RuntimeError("boom")
+
+        rec = Failing()
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=3)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        assert rec.calls == 4  # initial + 3 retries
+        assert len(mgr.dropped_errors) == 1
+
+
+class TestFakeCluster:
+    def test_sts_to_running_pods_and_scale(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1")
+        sts = mk("StatefulSet", "nb", api_version="apps/v1", spec={
+            "replicas": 2,
+            "serviceName": "nb-headless",
+            "template": {
+                "metadata": {"labels": {"notebook-name": "nb"}},
+                "spec": {"containers": [{"name": "main", "image": "img"}]},
+            },
+        })
+        api.create(sts)
+        pods = api.list("Pod", namespace="default")
+        assert [p.name for p in pods] == ["nb-0", "nb-1"]
+        p0 = pods[0]
+        assert p0.body["status"]["phase"] == "Running"
+        assert p0.spec["hostname"] == "nb-0"
+        assert p0.spec["subdomain"] == "nb-headless"
+        assert p0.labels["apps.kubernetes.io/pod-index"] == "0"
+        live = api.get("StatefulSet", "default", "nb")
+        assert live.status["readyReplicas"] == 2
+        # scale to zero (cull): pods removed
+        live.spec["replicas"] = 0
+        api.update(live)
+        assert api.list("Pod", namespace="default") == []
+
+    def test_tpu_scheduling_respects_capacity_and_selector(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", num_hosts=1, chips_per_host=4)
+        sts = mk("StatefulSet", "tpu-nb", api_version="apps/v1", spec={
+            "replicas": 1,
+            "template": {"spec": {
+                "nodeSelector": {
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                    "cloud.google.com/gke-tpu-topology": "4x4",
+                },
+                "containers": [{
+                    "name": "main", "image": "img",
+                    "resources": {"requests": {"google.com/tpu": "4"},
+                                  "limits": {"google.com/tpu": "4"}},
+                }],
+            }},
+        })
+        api.create(sts)
+        pod = api.get("Pod", "default", "tpu-nb-0")
+        assert pod.body["status"]["phase"] == "Running"
+        assert pod.spec["nodeName"].startswith("tpu-node-")
+        # a second slice cannot fit: chips exhausted
+        sts2 = mk("StatefulSet", "tpu-nb2", api_version="apps/v1",
+                  spec={**sts.spec, "replicas": 1})
+        api.create(sts2)
+        pod2 = api.get("Pod", "default", "tpu-nb2-0")
+        assert pod2.body["status"]["phase"] == "Pending"
+
+    def test_pending_pod_rescheduled_when_node_arrives(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        api.create(mk("StatefulSet", "nb", api_version="apps/v1", spec={
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "main", "resources": {"requests": {"cpu": "1"}}}]}},
+        }))
+        assert api.get("Pod", "default", "nb-0").body["status"]["phase"] == "Pending"
+        cluster.add_node("late-node")  # scheduler retries on node add
+        pod = api.get("Pod", "default", "nb-0")
+        assert pod.body["status"]["phase"] == "Running"
+        assert pod.spec["nodeName"] == "late-node"
+
+    def test_sa_pull_secret_minted(self):
+        api = ApiServer()
+        FakeCluster(api)
+        api.create(mk("ServiceAccount", "nb-sa"))
+        secret = api.get("Secret", "default", "nb-sa-dockercfg")
+        assert secret.body["type"] == "kubernetes.io/dockercfg"
+        sa = api.get("ServiceAccount", "default", "nb-sa")
+        assert {"name": "nb-sa-dockercfg"} in sa.body["imagePullSecrets"]
+
+    def test_pod_failure_injection(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1")
+        api.create(mk("StatefulSet", "nb", api_version="apps/v1", spec={
+            "replicas": 1,
+            "template": {"spec": {"containers": [{"name": "main"}]}},
+        }))
+        cluster.fail_pod("default", "nb-0")
+        pod = api.get("Pod", "default", "nb-0")
+        assert pod.body["status"]["phase"] == "Failed"
+        sts = api.get("StatefulSet", "default", "nb")
+        assert sts.status["readyReplicas"] == 0
+
+
+class TestEventRecorder:
+    def test_event_creation_and_aggregation(self):
+        api = ApiServer()
+        rec = EventRecorder(api, "notebook-controller")
+        nb = api.create(mk("Notebook", "nb"))
+        rec.event(nb, "Normal", "Created", "created sts")
+        rec.event(nb, "Normal", "Created", "created sts")
+        events = api.list("Event", namespace="default")
+        assert len(events) == 1
+        assert events[0].body["count"] == 2
+        assert events[0].body["involvedObject"]["name"] == "nb"
