@@ -1,0 +1,191 @@
+"""Notebook API types: the contract between users and the controllers.
+
+Mirrors the reference CRD shape — `spec.template.spec` is a raw PodSpec
+passthrough and status mirrors pod conditions + container state
+(components/notebook-controller/api/v1/notebook_types.go:26-88) — extended
+with the TPU-first `spec.tpu` block:
+
+    spec:
+      tpu:
+        accelerator: v5e            # v4 | v5e | v5p | v6e
+        topology: "4x4"             # per-generation dims
+        slices: 1                   # >1 => multi-slice DCN data-parallel
+      template:
+        spec: {containers: [...]}   # PodSpec passthrough, as in the reference
+
+Like the reference there are three field-identical versions (v1alpha1,
+v1beta1, v1); v1 is the storage version and v1beta1 the conversion hub
+(api/v1beta1/notebook_conversion.go:19, api/v1/notebook_conversion.go:25-69).
+Status gains per-worker readiness and slice health for multi-host slices.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Optional
+
+from ..kube import InvalidError, KubeObject, ObjectMeta
+from ..tpu.topology import SliceShape, TopologyError, resolve
+
+GROUP = "kubeflow.org"
+KIND = "Notebook"
+STORAGE_VERSION = "v1"
+HUB_VERSION = "v1beta1"
+VERSIONS = ("v1alpha1", "v1beta1", "v1")
+
+# Condition types mirror pod conditions (reference PodCondToNotebookCond,
+# notebook_controller.go:376-414)
+CONDITION_RUNNING = "Running"
+CONDITION_WAITING = "Waiting"
+CONDITION_TERMINATED = "Terminated"
+
+
+@dataclass(frozen=True)
+class TPUSpec:
+    accelerator: str
+    topology: str
+    slices: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TPUSpec":
+        return cls(
+            accelerator=str(d.get("accelerator", "")),
+            topology=str(d.get("topology", "")),
+            slices=int(d.get("slices", 1)),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "accelerator": self.accelerator,
+            "topology": self.topology,
+            "slices": self.slices,
+        }
+
+    def validate(self) -> SliceShape:
+        if self.slices < 1:
+            raise InvalidError("spec.tpu.slices must be >= 1")
+        try:
+            return resolve(self.accelerator, self.topology)
+        except TopologyError as e:
+            raise InvalidError(f"spec.tpu: {e}") from None
+
+    @property
+    def shape(self) -> SliceShape:
+        return self.validate()
+
+
+class Notebook:
+    """Typed view over a Notebook KubeObject (any API version)."""
+
+    def __init__(self, obj: KubeObject):
+        if obj.kind != KIND:
+            raise ValueError(f"not a Notebook: {obj.kind}")
+        self.obj = obj
+
+    # -- constructors ---------------------------------------------------------
+    @classmethod
+    def new(
+        cls,
+        name: str,
+        namespace: str,
+        pod_spec: Optional[dict] = None,
+        tpu: Optional[TPUSpec] = None,
+        version: str = STORAGE_VERSION,
+        labels: Optional[dict] = None,
+        annotations: Optional[dict] = None,
+    ) -> "Notebook":
+        spec: dict = {"template": {"spec": pod_spec or {"containers": [{"name": name}]}}}
+        if tpu is not None:
+            spec["tpu"] = tpu.to_dict()
+        return cls(
+            KubeObject(
+                api_version=f"{GROUP}/{version}",
+                kind=KIND,
+                metadata=ObjectMeta(
+                    name=name,
+                    namespace=namespace,
+                    labels=dict(labels or {}),
+                    annotations=dict(annotations or {}),
+                ),
+                body={"spec": spec},
+            )
+        )
+
+    # -- accessors ------------------------------------------------------------
+    @property
+    def metadata(self) -> ObjectMeta:
+        return self.obj.metadata
+
+    @property
+    def name(self) -> str:
+        return self.obj.name
+
+    @property
+    def namespace(self) -> str:
+        return self.obj.namespace
+
+    @property
+    def version(self) -> str:
+        return self.obj.api_version.split("/", 1)[1]
+
+    @property
+    def pod_spec(self) -> dict:
+        return self.obj.spec.setdefault("template", {}).setdefault("spec", {})
+
+    @property
+    def tpu(self) -> Optional[TPUSpec]:
+        d = self.obj.spec.get("tpu")
+        return TPUSpec.from_dict(d) if d else None
+
+    @property
+    def status(self) -> dict:
+        return self.obj.status
+
+    def validate(self) -> None:
+        containers = self.pod_spec.get("containers") or []
+        if not containers:
+            raise InvalidError("spec.template.spec.containers must be non-empty")
+        if self.tpu is not None:
+            self.tpu.validate()
+
+    # -- conversion machinery -------------------------------------------------
+    def convert_to(self, version: str) -> "Notebook":
+        """Spoke -> hub -> spoke conversion.  The three versions are
+        field-identical (as in the reference, where the diff between
+        api/v1*/notebook_types.go is only package + markers), so conversion
+        is a relabel through the hub — but routed through it so a future
+        field divergence has one place to live."""
+        if version not in VERSIONS:
+            raise InvalidError(f"unknown Notebook version {version!r}")
+        hub = self._relabel(HUB_VERSION)
+        return hub._relabel(version)
+
+    def _relabel(self, version: str) -> "Notebook":
+        out = self.obj.deepcopy()
+        out.api_version = f"{GROUP}/{version}"
+        return Notebook(out)
+
+    def deepcopy(self) -> "Notebook":
+        return Notebook(self.obj.deepcopy())
+
+
+def notebook_status(
+    ready_replicas: int,
+    conditions: list[dict],
+    container_state: dict,
+    worker_states: Optional[list[dict]] = None,
+    slice_health: Optional[str] = None,
+) -> dict:
+    """NotebookStatus shape: reference fields (conditions/readyReplicas/
+    containerState, api/v1/notebook_types.go:37-45) + TPU extensions."""
+    status = {
+        "conditions": conditions,
+        "readyReplicas": ready_replicas,
+        "containerState": copy.deepcopy(container_state),
+    }
+    if worker_states is not None:
+        status["workerStates"] = worker_states
+    if slice_health is not None:
+        status["sliceHealth"] = slice_health
+    return status

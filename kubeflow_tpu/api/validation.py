@@ -1,0 +1,41 @@
+"""Structural (CRD-schema-level) validation for Notebook objects.
+
+The reference gets this for free from the CRD OpenAPI schema enforced by the
+apiserver (config/crd/bases); our in-memory apiserver enforces the same
+contract through a validating admission hook registered at scheme setup.
+Semantic ODH rules (e.g. MLflow annotation removal) stay in the ODH
+validating webhook, as in the reference."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..kube import AdmissionDenied, AdmissionHook, ApiServer, KubeObject
+from .types import GROUP, KIND, VERSIONS, Notebook
+
+
+def _validate(op: str, old: Optional[KubeObject], obj: KubeObject) -> None:
+    group, _, version = obj.api_version.partition("/")
+    if group != GROUP or version not in VERSIONS:
+        raise AdmissionDenied(
+            f"Notebook apiVersion {obj.api_version!r} not served; "
+            f"expected {GROUP}/{{{ '|'.join(VERSIONS) }}}"
+        )
+    nb = Notebook(obj)
+    try:
+        nb.validate()
+    except Exception as e:
+        raise AdmissionDenied(f"invalid Notebook: {e}") from None
+
+
+def install_notebook_schema(api: ApiServer) -> None:
+    """Register the Notebook 'CRD': structural validation on create/update."""
+    api.register_admission(
+        AdmissionHook(
+            kinds=(KIND,),
+            handler=_validate,
+            operations=("CREATE", "UPDATE"),
+            mutating=False,
+            name="notebook-crd-schema",
+        )
+    )

@@ -1,0 +1,38 @@
+"""Shared constants for the notebook controllers.
+
+The reference scatters these between the controller file
+(notebook_controller.go:49-64) and the legacy culler package (which remains
+the source of STOP_ANNOTATION, pkg/culler/culler.go; imported by ODH at
+odh notebook_controller.go:35,146).  Centralized here.
+"""
+
+DEFAULT_CONTAINER_PORT = 8888
+DEFAULT_SERVING_PORT = 80
+DEFAULT_FSGROUP = 100
+MAX_STATEFULSET_NAME_LENGTH = 52  # name + controller hash must fit 63 chars
+
+# annotations (user-facing API surface)
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+ANNOTATION_REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
+ANNOTATION_HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
+ANNOTATION_NOTEBOOK_RESTART = "notebooks.opendatahub.io/notebook-restart"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
+    "notebooks.kubeflow.org/last_activity_check_timestamp"
+)
+# TPU extension: set while a pre-cull checkpoint has been requested
+ANNOTATION_CHECKPOINT_REQUESTED = "notebooks.kubeflow.org/checkpoint-requested"
+
+# labels
+WORKBENCH_LABEL = "opendatahub.io/workbenches"
+NOTEBOOK_NAME_LABEL = "notebook-name"
+STATEFULSET_LABEL = "statefulset"
+TPU_SLICE_LABEL = "notebooks.kubeflow.org/tpu-slice"
+
+# env var injected into the notebook container
+PREFIX_ENV_VAR = "NB_PREFIX"
+
+# GKE TPU node labels
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"

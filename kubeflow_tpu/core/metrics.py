@@ -1,0 +1,88 @@
+"""Notebook controller metrics, mirroring pkg/metrics/metrics.go:13-99:
+counters for creations/failures/cullings plus a scraper-style gauge that
+counts running notebooks by listing workload StatefulSets with the
+notebook-name label, extended with TPU slice/chip gauges."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..kube import ApiServer, parse_quantity
+from ..utils.metrics import Registry
+from . import constants as C
+
+
+class NotebookMetrics:
+    def __init__(self, api: ApiServer, registry: Optional[Registry] = None):
+        self.api = api
+        self.registry = registry or Registry()
+        self.running = self.registry.gauge(
+            "notebook_running",
+            "Current running notebooks in the cluster",
+            labels=("namespace",),
+        )
+        self.creation = self.registry.counter(
+            "notebook_create_total",
+            "Total times of creating notebooks",
+            labels=("namespace",),
+        )
+        self.fail_creation = self.registry.counter(
+            "notebook_create_failed_total",
+            "Total failure times of creating notebooks",
+            labels=("namespace",),
+        )
+        self.culling = self.registry.counter(
+            "notebook_culling_total",
+            "Total times of culling notebooks",
+            labels=("namespace", "name"),
+        )
+        self.last_culling_timestamp = self.registry.gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling in seconds",
+            labels=("namespace", "name"),
+        )
+        # TPU extensions
+        self.tpu_chips_requested = self.registry.gauge(
+            "notebook_tpu_chips_requested",
+            "TPU chips requested by running notebook slices",
+            labels=("namespace",),
+        )
+        self.notebook_ready_seconds = self.registry.gauge(
+            "notebook_to_ready_seconds",
+            "Latency from Notebook creation to all workers Ready",
+            labels=("namespace", "name"),
+        )
+
+    def scrape(self) -> str:
+        """List-based scrape (metrics.go:82-99): recompute gauges from the
+        live StatefulSet set, then render."""
+        running_notebooks: dict[str, set[str]] = {}  # ns -> notebook names
+        per_ns_chips: dict[str, float] = {}
+        for sts in self.api.list("StatefulSet"):
+            nb_name = (
+                sts.spec.get("template", {})
+                .get("metadata", {})
+                .get("labels", {})
+                .get(C.NOTEBOOK_NAME_LABEL)
+            )
+            if nb_name is None:
+                continue
+            ns = sts.namespace
+            replicas = int(sts.spec.get("replicas", 0))
+            if replicas > 0:
+                # dedupe by notebook: a multi-slice notebook renders one STS
+                # per slice but is still one running notebook
+                running_notebooks.setdefault(ns, set()).add(nb_name)
+            for c in sts.spec.get("template", {}).get("spec", {}).get("containers", []):
+                chips = (c.get("resources", {}).get("requests") or {}).get(
+                    C.TPU_RESOURCE
+                )
+                if chips:
+                    per_ns_chips[ns] = per_ns_chips.get(ns, 0.0) + parse_quantity(
+                        chips
+                    ) * replicas
+        for ns, names in running_notebooks.items():
+            self.running.labels(ns).set(len(names))
+        for ns, n in per_ns_chips.items():
+            self.tpu_chips_requested.labels(ns).set(n)
+        return self.registry.render()

@@ -1,0 +1,357 @@
+"""Core Notebook reconciler: CR -> StatefulSet(s)/Service(s)/status.
+
+Port of NotebookReconciler
+(components/notebook-controller/controllers/notebook_controller.go:79-826)
+with the TPU workload path.  Event re-emission lives in its own controller
+(the reference multiplexes Events through the same queue and wishes it
+didn't — see the TODO at notebook_controller.go:98; splitting removes the
+name-collision hazard)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import Notebook, notebook_status
+from ..common import reconcilehelper as rh
+from ..kube import (
+    ApiServer,
+    EventRecorder,
+    KubeObject,
+    Manager,
+    NotFoundError,
+    Request,
+    Result,
+    WatchSpec,
+    retry_on_conflict,
+    set_controller_reference,
+)
+from ..utils.clock import Clock
+from ..utils.config import CoreConfig
+from . import constants as C
+from .metrics import NotebookMetrics
+
+logger = logging.getLogger("kubeflow_tpu.core")
+
+
+class NotebookReconciler:
+    def __init__(
+        self,
+        api: ApiServer,
+        cfg: CoreConfig,
+        metrics: NotebookMetrics,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.api = api
+        self.cfg = cfg
+        self.metrics = metrics
+        self.recorder = recorder or EventRecorder(api, "notebook-controller")
+        self.clock = clock or Clock()
+
+    # -- main loop (reference Reconcile, notebook_controller.go:94-294) -------
+    def reconcile(self, req: Request) -> Result:
+        obj = self.api.try_get("Notebook", req.namespace, req.name)
+        if obj is None:
+            return Result()
+        nb = Notebook(obj)
+        # jupyter-web-app deletes with foreground policy: while terminating,
+        # recreating owned objects would fight the API server (:138)
+        if obj.metadata.deletion_timestamp is not None:
+            return Result()
+
+        from .workload import (
+            generate_headless_service,
+            generate_service,
+            generate_statefulsets,
+            generate_virtual_service,
+        )
+
+        # StatefulSets (one per slice; one total for CPU notebooks)
+        desired_sets = generate_statefulsets(nb, self.cfg)
+        existing = [
+            s
+            for s in self.api.list("StatefulSet", namespace=req.namespace)
+            if (ref := s.metadata.controller_owner()) is not None
+            and ref.kind == "Notebook"
+            and ref.uid == obj.metadata.uid
+        ]
+        existing_by_name = {s.name: s for s in existing}
+
+        def slice_of(sts: KubeObject) -> Optional[str]:
+            return (
+                sts.spec.get("template", {})
+                .get("metadata", {})
+                .get("labels", {})
+                .get(C.TPU_SLICE_LABEL)
+            )
+
+        existing_by_slice = {slice_of(s): s for s in existing if slice_of(s)}
+        live_names: list[str] = []  # ordered: slice 0 first
+        matched_live: set[str] = set()
+        for idx, desired in enumerate(desired_sets):
+            set_controller_reference(obj, desired)
+            if desired.name:
+                found = existing_by_name.get(desired.name)
+            elif (s := slice_of(desired)) is not None:
+                # generate-name (long CR name) TPU slices match by slice label
+                found = existing_by_slice.get(s)
+            else:
+                found = existing[0] if existing else None
+            if found is None:
+                self.metrics.creation.labels(req.namespace).inc()
+                try:
+                    live = self.api.create(desired)
+                except Exception:
+                    self.metrics.fail_creation.labels(req.namespace).inc()
+                    raise
+            else:
+                if rh.copy_statefulset_fields(desired, found):
+                    found = self.api.update(found)
+                live = found
+            live_names.append(live.name)
+            matched_live.add(live.name)
+
+        # prune slices beyond spec.tpu.slices (scale-in of multi-slice)
+        for s in existing:
+            if s.name not in matched_live:
+                self.api.delete("StatefulSet", req.namespace, s.name)
+
+        # Services
+        svc = generate_service(nb)
+        set_controller_reference(obj, svc)
+        rh.reconcile_object(self.api, svc, rh.copy_service_fields)
+        if nb.tpu is not None:
+            headless = generate_headless_service(nb)
+            set_controller_reference(obj, headless)
+            rh.reconcile_object(self.api, headless, rh.copy_service_fields)
+
+        if self.cfg.use_istio:
+            vs = generate_virtual_service(nb, self.cfg)
+            set_controller_reference(obj, vs)
+            rh.reconcile_object(self.api, vs, rh.copy_spec)
+
+        # status from live STS + pods
+        self._update_status(nb, live_names)
+
+        # restart annotation (notebook_controller.go:259-294); for TPU
+        # notebooks restart is slice-atomic: delete every worker pod
+        annotations = self.api.get("Notebook", req.namespace, req.name).metadata.annotations
+        if annotations.get(C.ANNOTATION_NOTEBOOK_RESTART) == "true":
+            self._restart_pods(nb, live_names)
+            def clear() -> None:
+                live = self.api.get("Notebook", req.namespace, req.name)
+                live.metadata.annotations.pop(C.ANNOTATION_NOTEBOOK_RESTART, None)
+                self.api.update(live)
+            retry_on_conflict(clear)
+        return Result()
+
+    # -- helpers ---------------------------------------------------------------
+    def _pods_of(self, nb: Notebook, live_sts_name: str) -> list[KubeObject]:
+        """Pods of a live StatefulSet, selected via its own selector — the
+        pod labels carry the *rendered* statefulset name, which differs from
+        the live object name when generateName kicked in (long CR names)."""
+        sts = self.api.try_get("StatefulSet", nb.namespace, live_sts_name)
+        if sts is None:
+            return []
+        selector = sts.spec.get("selector", {}).get("matchLabels", {})
+        if not selector:
+            return []
+        return self.api.list("Pod", namespace=nb.namespace, label_selector=selector)
+
+    def _restart_pods(self, nb: Notebook, live_names: list[str]) -> None:
+        for live_name in live_names:
+            for pod in self._pods_of(nb, live_name):
+                try:
+                    self.api.delete("Pod", nb.namespace, pod.name)
+                except NotFoundError:
+                    pass
+
+    def _update_status(self, nb: Notebook, live_names: list[str]) -> None:
+        """Mirror pod conditions + container state into the CR
+        (createNotebookStatus, notebook_controller.go:299-374); TPU
+        notebooks additionally get per-worker states and slice health."""
+        ready = 0
+        worker_states: list[dict] = []
+        conditions: list[dict] = []
+        container_state: dict = {}
+        tpu = nb.tpu
+        num_slices = tpu.slices if tpu else 1
+        expected_hosts = (tpu.shape.num_hosts * num_slices) if tpu else 1
+
+        first_sts_name = live_names[0] if live_names else nb.name
+        for live_name in live_names:
+            sts = self.api.try_get("StatefulSet", nb.namespace, live_name)
+            if sts is not None:
+                ready += int(sts.status.get("readyReplicas", 0) or 0)
+            if tpu is not None:
+                for pod in sorted(self._pods_of(nb, live_name), key=lambda p: p.name):
+                    phase = pod.body.get("status", {}).get("phase", "Unknown")
+                    pod_ready = any(
+                        c.get("type") == "Ready" and c.get("status") == "True"
+                        for c in pod.body.get("status", {}).get("conditions", [])
+                    )
+                    worker_states.append(
+                        {"pod": pod.name, "phase": phase, "ready": pod_ready}
+                    )
+
+        # conditions + containerState mirror worker 0 (the Jupyter server)
+        pod0 = self.api.try_get("Pod", nb.namespace, f"{first_sts_name}-0")
+        if pod0 is not None and pod0.body.get("status"):
+            pstatus = pod0.body["status"]
+            now = self.clock.now_iso()
+            # reuse previous timestamps for unchanged conditions so the
+            # computed status is idempotent — otherwise every reconcile
+            # would differ by the defaulted times and the status write
+            # would requeue the reconciler forever (the reference defaults
+            # with metav1.Now(), PodCondToNotebookCond :397-414, but only
+            # rewrites status through the apiserver's semantic no-op check)
+            prev = {
+                c.get("type"): c
+                for c in (nb.status.get("conditions") or [])
+            }
+            for podc in pstatus.get("conditions", []):
+                cond = {
+                    "type": podc.get("type", ""),
+                    "status": podc.get("status", ""),
+                }
+                if podc.get("reason"):
+                    cond["reason"] = podc["reason"]
+                if podc.get("message"):
+                    cond["message"] = podc["message"]
+                old = prev.get(cond["type"])
+                unchanged = old is not None and all(
+                    old.get(k) == cond.get(k)
+                    for k in ("status", "reason", "message")
+                )
+                cond["lastProbeTime"] = podc.get("lastProbeTime") or (
+                    old["lastProbeTime"] if unchanged else now
+                )
+                cond["lastTransitionTime"] = podc.get("lastTransitionTime") or (
+                    old["lastTransitionTime"] if unchanged else now
+                )
+                conditions.append(cond)
+            # container with the same name as the CR (:336-356)
+            for cs in pstatus.get("containerStatuses", []):
+                if cs.get("name") == nb.name:
+                    container_state = cs.get("state", {})
+                    break
+
+        slice_health = None
+        if tpu is not None:
+            stopped = C.STOP_ANNOTATION in nb.metadata.annotations
+            if stopped:
+                slice_health = "Stopped"
+            elif ready == expected_hosts:
+                slice_health = "Healthy"
+            elif ready == 0:
+                slice_health = "Unhealthy"
+            else:
+                # partial readiness is a degraded slice: collectives hang
+                slice_health = "Degraded"
+
+        status = notebook_status(
+            ready_replicas=ready,
+            conditions=conditions,
+            container_state=container_state,
+            worker_states=worker_states if tpu is not None else None,
+            slice_health=slice_health,
+        )
+
+        def write() -> None:
+            live = self.api.get("Notebook", nb.namespace, nb.name)
+            if live.body.get("status") == status:
+                return
+            live.status = status
+            self.api.update_status(live)
+
+        retry_on_conflict(write)
+
+
+class EventReemitReconciler:
+    """Re-emits Events from owned StatefulSets/Pods onto the Notebook CR so
+    users see workload failures with `kubectl describe notebook`
+    (notebook_controller.go:99-122, nbNameFromInvolvedObject :705)."""
+
+    def __init__(self, api: ApiServer, recorder: EventRecorder):
+        self.api = api
+        self.recorder = recorder
+        self._emitted: set[str] = set()
+
+    def reconcile(self, req: Request) -> Result:
+        ev = self.api.try_get("Event", req.namespace, req.name)
+        if ev is None:
+            return Result()
+        if ev.metadata.uid in self._emitted:
+            return Result()
+        involved = ev.body.get("involvedObject", {})
+        nb_name = self._notebook_for(req.namespace, involved)
+        if nb_name is None:
+            return Result()
+        nb = self.api.try_get("Notebook", req.namespace, nb_name)
+        if nb is None:
+            return Result()
+        self._emitted.add(ev.metadata.uid)
+        self.recorder.event(
+            nb,
+            ev.body.get("type", "Normal"),
+            ev.body.get("reason", ""),
+            "Reissued from %s/%s: %s"
+            % (involved.get("kind", "").lower(), involved.get("name", ""),
+               ev.body.get("message", "")),
+        )
+        return Result()
+
+    def _notebook_for(self, namespace: str, involved: dict) -> Optional[str]:
+        kind, name = involved.get("kind"), involved.get("name")
+        if not kind or not name:
+            return None
+        obj = self.api.try_get(kind, namespace, name)
+        if obj is None:
+            return None
+        if kind == "Pod":
+            return obj.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+        if kind == "StatefulSet":
+            ref = obj.metadata.controller_owner()
+            if ref is not None and ref.kind == "Notebook":
+                return ref.name
+            return obj.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+        return None
+
+
+def setup_core_controllers(
+    mgr: Manager,
+    cfg: Optional[CoreConfig] = None,
+    metrics: Optional[NotebookMetrics] = None,
+) -> NotebookReconciler:
+    """Wire the core controllers into a manager (main.go:58-148 analog;
+    culling registration is separate, gated on ENABLE_CULLING —
+    main.go:111-123 — see core.culling_controller.setup_culling)."""
+    cfg = cfg or CoreConfig.from_env()
+    api = mgr.api
+    from ..api.validation import install_notebook_schema
+
+    install_notebook_schema(api)
+    metrics = metrics or NotebookMetrics(api)
+    recorder = EventRecorder(api, "notebook-controller")
+    rec = NotebookReconciler(api, cfg, metrics, recorder, clock=mgr.clock)
+
+    def pod_to_request(pod: KubeObject) -> list[Request]:
+        name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+        return [Request(pod.namespace, name)] if name else []
+
+    mgr.register(
+        "notebook",
+        rec,
+        for_kind="Notebook",
+        owns=["StatefulSet", "Service", "VirtualService"],
+        watches=[WatchSpec(kind="Pod", mapper=pod_to_request)],
+    )
+    reemit = EventReemitReconciler(api, recorder)
+    mgr.register(
+        "event-reemit",
+        reemit,
+        for_kind="Event",
+        watches=[],
+    )
+    return rec

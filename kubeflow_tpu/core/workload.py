@@ -1,0 +1,254 @@
+"""Workload rendering: Notebook CR -> StatefulSet(s) + Service(s).
+
+CPU path matches the reference generator behavior
+(notebook-controller/controllers/notebook_controller.go:433-552): one
+StatefulSet with replicas 0/1 from the stop annotation, label/annotation
+propagation with kubectl/notebook filtering, default workdir/port/NB_PREFIX,
+optional fsGroup, and a ClusterIP Service 80 -> 8888.
+
+TPU path (spec.tpu) is the new capability: per slice an *indexed* StatefulSet
+with replicas = hosts(topology) (0 when stopped — slice-atomic, never
+partial), parallel pod management (gang-style startup), google.com/tpu
+resource requests, GKE TPU nodeSelectors, and the distributed-runtime env;
+plus one shared headless Service giving every worker a stable DNS identity.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..api.types import Notebook
+from ..kube import KubeObject, ObjectMeta
+from ..tpu import env as tpuenv
+from ..utils.config import CoreConfig
+from . import constants as C
+
+
+def _propagated_annotations(nb: Notebook) -> dict[str, str]:
+    """Copy CR annotations to the pod, excluding kubectl/notebook ones
+    (reference filter, notebook_controller.go:484-489)."""
+    return {
+        k: v
+        for k, v in nb.metadata.annotations.items()
+        if "kubectl" not in k and "notebook" not in k
+    }
+
+
+def _base_pod_template(nb: Notebook, cfg: CoreConfig, sts_name: str) -> dict:
+    pod_spec = copy.deepcopy(nb.pod_spec)
+    containers = pod_spec.get("containers") or [{"name": nb.name}]
+    main = containers[0]
+    if not main.get("workingDir"):
+        main["workingDir"] = "/home/jovyan"
+    if not main.get("ports"):
+        main["ports"] = [
+            {
+                "containerPort": C.DEFAULT_CONTAINER_PORT,
+                "name": "notebook-port",
+                "protocol": "TCP",
+            }
+        ]
+    prefix = f"/notebook/{nb.namespace}/{nb.name}"
+    main["env"] = tpuenv.merge_env(
+        main.get("env") or [], [{"name": C.PREFIX_ENV_VAR, "value": prefix}]
+    )
+    if cfg.add_fsgroup and pod_spec.get("securityContext") is None:
+        pod_spec["securityContext"] = {"fsGroup": C.DEFAULT_FSGROUP}
+    pod_spec["containers"] = containers
+
+    labels = {
+        C.STATEFULSET_LABEL: sts_name,
+        C.NOTEBOOK_NAME_LABEL: nb.name,
+        C.WORKBENCH_LABEL: "true",
+    }
+    labels.update(nb.metadata.labels)
+    return {
+        "metadata": {
+            "labels": labels,
+            "annotations": _propagated_annotations(nb),
+        },
+        "spec": pod_spec,
+    }
+
+
+def _sts_meta(nb: Notebook, name: str, use_generate_name: bool) -> ObjectMeta:
+    if use_generate_name:
+        # name-length guard (notebook_controller.go:142-149): controller
+        # appends an 11-char hash label, total must fit 63
+        meta = ObjectMeta(generate_name="nb-", namespace=nb.namespace)
+    else:
+        meta = ObjectMeta(name=name, namespace=nb.namespace)
+    meta.labels = dict(nb.metadata.labels)
+    return meta
+
+
+def generate_statefulsets(nb: Notebook, cfg: CoreConfig) -> list[KubeObject]:
+    """Render the workload STS set: one for CPU notebooks, one per slice for
+    TPU notebooks."""
+    stopped = C.STOP_ANNOTATION in nb.metadata.annotations
+    tpu = nb.tpu
+
+    if tpu is None:
+        name = nb.name
+        use_generate_name = len(name) > C.MAX_STATEFULSET_NAME_LENGTH
+        sts = KubeObject(
+            api_version="apps/v1",
+            kind="StatefulSet",
+            metadata=_sts_meta(nb, name, use_generate_name),
+            body={
+                "spec": {
+                    "replicas": 0 if stopped else 1,
+                    "serviceName": nb.name,
+                    "selector": {"matchLabels": {C.STATEFULSET_LABEL: name}},
+                    "template": _base_pod_template(nb, cfg, name),
+                }
+            },
+        )
+        return [sts]
+
+    shape = tpu.validate()
+    out = []
+    for slice_id in range(tpu.slices):
+        name = tpuenv.statefulset_name(nb.name, slice_id, tpu.slices)
+        # the slice suffix counts against the 52-char guard too
+        use_generate_name = len(name) > C.MAX_STATEFULSET_NAME_LENGTH
+        template = _base_pod_template(nb, cfg, name)
+        template["metadata"]["labels"][C.TPU_SLICE_LABEL] = str(slice_id)
+        pod_spec = template["spec"]
+        selector = pod_spec.setdefault("nodeSelector", {})
+        selector[C.GKE_TPU_ACCELERATOR_LABEL] = shape.accelerator.gke_label
+        selector[C.GKE_TPU_TOPOLOGY_LABEL] = shape.topology
+        main = pod_spec["containers"][0]
+        resources = main.setdefault("resources", {})
+        for kind in ("requests", "limits"):
+            resources.setdefault(kind, {})[C.TPU_RESOURCE] = str(shape.chips_per_host)
+        main["env"] = tpuenv.merge_env(
+            main["env"], tpuenv.tpu_env_vars(nb.name, shape, slice_id, tpu.slices)
+        )
+        sts = KubeObject(
+            api_version="apps/v1",
+            kind="StatefulSet",
+            metadata=_sts_meta(nb, name, use_generate_name),
+            body={
+                "spec": {
+                    # slice-atomic: all hosts or none — partial slices can
+                    # never run a collective, so 0 is the only other state
+                    "replicas": 0 if stopped else shape.num_hosts,
+                    "serviceName": tpuenv.headless_service_name(nb.name),
+                    "podManagementPolicy": "Parallel",
+                    "selector": {"matchLabels": {C.STATEFULSET_LABEL: name}},
+                    "template": template,
+                }
+            },
+        )
+        sts.metadata.labels[C.NOTEBOOK_NAME_LABEL] = nb.name
+        out.append(sts)
+    return out
+
+
+def generate_service(nb: Notebook) -> KubeObject:
+    """ClusterIP Service 80 -> notebook port, name http-notebook (Istio-
+    compatible port naming), selecting the (first) statefulset's pods
+    (notebook_controller.go:525-552).  For TPU notebooks this fronts worker
+    0, where the JupyterLab server runs."""
+    containers = nb.pod_spec.get("containers") or []
+    port = C.DEFAULT_CONTAINER_PORT
+    if containers and containers[0].get("ports"):
+        port = int(containers[0]["ports"][0].get("containerPort", port))
+    tpu = nb.tpu
+    sts0 = tpuenv.statefulset_name(nb.name, 0, tpu.slices if tpu else 1)
+    return KubeObject(
+        api_version="v1",
+        kind="Service",
+        metadata=ObjectMeta(name=nb.name, namespace=nb.namespace),
+        body={
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {C.STATEFULSET_LABEL: sts0},
+                "ports": [
+                    {
+                        "name": "http-notebook",
+                        "port": C.DEFAULT_SERVING_PORT,
+                        "targetPort": port,
+                        "protocol": "TCP",
+                    }
+                ],
+            }
+        },
+    )
+
+
+def generate_headless_service(nb: Notebook) -> KubeObject:
+    """Headless Service over ALL workers of ALL slices: gives each pod the
+    stable {pod}.{svc}.{ns} DNS name that TPU_WORKER_HOSTNAMES and the JAX
+    coordinator address rely on.  The TPU-native analog of the reference's
+    plain Service (SURVEY.md §5 'Distributed communication backend')."""
+    return KubeObject(
+        api_version="v1",
+        kind="Service",
+        metadata=ObjectMeta(
+            name=tpuenv.headless_service_name(nb.name), namespace=nb.namespace
+        ),
+        body={
+            "spec": {
+                "clusterIP": "None",
+                "selector": {C.NOTEBOOK_NAME_LABEL: nb.name},
+                # workers must resolve worker 0 before any pod can become
+                # Ready — without this, gang startup deadlocks on DNS
+                "publishNotReadyAddresses": True,
+                "ports": [
+                    {
+                        "name": "jax-coordinator",
+                        "port": tpuenv.JAX_COORDINATOR_PORT,
+                        "targetPort": tpuenv.JAX_COORDINATOR_PORT,
+                        "protocol": "TCP",
+                    }
+                ],
+            }
+        },
+    )
+
+
+def generate_virtual_service(nb: Notebook, cfg: CoreConfig) -> KubeObject:
+    """Istio VirtualService under USE_ISTIO
+    (notebook_controller.go:558-699): route
+    /notebook/{ns}/{name}/ through the configured gateway to the Service,
+    honoring the rewrite/headers annotations."""
+    prefix = f"/notebook/{nb.namespace}/{nb.name}/"
+    rewrite = nb.metadata.annotations.get(C.ANNOTATION_REWRITE_URI, "")
+    rewrite_uri = rewrite if rewrite.strip() else prefix
+    http_route: dict = {
+        "match": [{"uri": {"prefix": prefix}}],
+        "rewrite": {"uri": rewrite_uri},
+        "route": [
+            {
+                "destination": {
+                    "host": f"{nb.name}.{nb.namespace}.svc.{cfg.cluster_domain}",
+                    "port": {"number": C.DEFAULT_SERVING_PORT},
+                }
+            }
+        ],
+        "timeout": "300s",
+    }
+    headers = nb.metadata.annotations.get(C.ANNOTATION_HEADERS_REQUEST_SET, "")
+    if headers.strip():
+        import json
+
+        try:
+            http_route["headers"] = {"request": {"set": json.loads(headers)}}
+        except ValueError:
+            pass  # malformed annotation ignored, as in the reference
+    return KubeObject(
+        api_version="networking.istio.io/v1alpha3",
+        kind="VirtualService",
+        metadata=ObjectMeta(
+            name=f"notebook-{nb.namespace}-{nb.name}", namespace=nb.namespace
+        ),
+        body={
+            "spec": {
+                "hosts": [cfg.istio_host],
+                "gateways": [cfg.istio_gateway],
+                "http": [http_route],
+            }
+        },
+    )

@@ -1,0 +1,110 @@
+"""Distributed-runtime environment wiring for TPU notebook workers.
+
+The TPU-native analog of the reference's `NB_PREFIX` injection and Service
+generation (notebook-controller/controllers/notebook_controller.go:417-431,
+525-552): every worker pod gets the coordination env that
+`jax.distributed.initialize()` (and MaxText/libtpu) read, derived from the
+indexed StatefulSet + headless Service identity:
+
+- TPU_WORKER_ID        — pod ordinal, via downward API from the pod-index label
+- TPU_WORKER_HOSTNAMES — comma list of stable per-worker DNS names
+- JAX_COORDINATOR_ADDRESS / COORDINATOR_ADDRESS — worker 0 of slice 0
+- TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, TPU_HOSTS_PER_SLICE - slice geometry
+- MEGASCALE_* — multi-slice (DCN data-parallel) coordination
+
+The hostnames list is ordered by ordinal: its index MUST equal TPU_WORKER_ID
+or jax.distributed mis-assigns process ids (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from .topology import SliceShape
+
+JAX_COORDINATOR_PORT = 8471
+MEGASCALE_PORT = 8080
+
+POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
+
+
+def headless_service_name(notebook_name: str) -> str:
+    return f"{notebook_name}-workers"
+
+
+def worker_hostname(
+    notebook_name: str, slice_id: int, num_slices: int, ordinal: int
+) -> str:
+    """Short DNS name of one worker through the headless Service.
+
+    Resolvable cluster-wide as {pod}.{svc}.{ns}.svc via the pod's
+    subdomain; we emit the svc-qualified short form GKE uses.
+    """
+    sts = statefulset_name(notebook_name, slice_id, num_slices)
+    return f"{sts}-{ordinal}.{headless_service_name(notebook_name)}"
+
+
+def statefulset_name(notebook_name: str, slice_id: int, num_slices: int) -> str:
+    """Slice 0 of a single-slice notebook keeps the bare CR name so the
+    CPU-path naming contract (STS == notebook name, reference
+    notebook_controller.go:433-447) holds; multi-slice appends -slice-N."""
+    if num_slices <= 1:
+        return notebook_name
+    return f"{notebook_name}-slice-{slice_id}"
+
+
+def worker_hostnames(notebook_name: str, shape: SliceShape, slice_id: int, num_slices: int) -> list[str]:
+    return [
+        worker_hostname(notebook_name, slice_id, num_slices, i)
+        for i in range(shape.num_hosts)
+    ]
+
+
+def tpu_env_vars(
+    notebook_name: str,
+    shape: SliceShape,
+    slice_id: int,
+    num_slices: int,
+) -> list[dict]:
+    """corev1.EnvVar list (dict form) for every worker container in a slice.
+
+    TPU_WORKER_ID comes from the downward API so one pod template serves all
+    ordinals — the same property the reference exploits for NB_PREFIX being
+    identical across the (single) replica.
+    """
+    hostnames = ",".join(worker_hostnames(notebook_name, shape, slice_id, num_slices))
+    coordinator = (
+        f"{worker_hostname(notebook_name, 0, num_slices, 0)}:{JAX_COORDINATOR_PORT}"
+    )
+    env: list[dict] = [
+        {
+            "name": "TPU_WORKER_ID",
+            "valueFrom": {
+                "fieldRef": {
+                    "fieldPath": f"metadata.labels['{POD_INDEX_LABEL}']"
+                }
+            },
+        },
+        {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
+        {"name": "TPU_ACCELERATOR_TYPE", "value": shape.accelerator.name},
+        {"name": "TPU_TOPOLOGY", "value": shape.topology},
+        {"name": "TPU_HOSTS_PER_SLICE", "value": str(shape.num_hosts)},
+        {"name": "TPU_CHIPS_PER_HOST_BOUNDS", "value": str(shape.chips_per_host)},
+        {"name": "JAX_COORDINATOR_ADDRESS", "value": coordinator},
+        {"name": "COORDINATOR_ADDRESS", "value": coordinator},
+    ]
+    if num_slices > 1:
+        megascale_coord = worker_hostname(notebook_name, 0, num_slices, 0)
+        env += [
+            {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value": megascale_coord},
+            {"name": "MEGASCALE_NUM_SLICES", "value": str(num_slices)},
+            {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
+            {"name": "MEGASCALE_PORT", "value": str(MEGASCALE_PORT)},
+        ]
+    return env
+
+
+def merge_env(existing: list[dict], injected: list[dict]) -> list[dict]:
+    """Inject env vars, keeping user-provided values for colliding names
+    (same precedence rule as the reference's setPrefixEnvVar, which leaves a
+    user NB_PREFIX in place — notebook_controller.go:417-431)."""
+    have = {e.get("name") for e in existing}
+    return list(existing) + [e for e in injected if e["name"] not in have]

@@ -1,0 +1,103 @@
+"""TPU topology math: accelerator generation + topology string -> slice shape.
+
+This is the piece the reference has no analog for (SURVEY.md §2.5): its
+workload is a hardcoded 0/1-replica StatefulSet
+(notebook-controller/controllers/notebook_controller.go:434-437).  Here the
+`spec.tpu` block `{accelerator, topology, slices}` determines how many hosts
+a slice spans, how many chips each host exposes via the `google.com/tpu`
+device plugin, and which GKE node labels
+(`cloud.google.com/gke-tpu-accelerator`, `cloud.google.com/gke-tpu-topology`)
+the pods must target.
+
+Numbers follow the public GKE/Cloud TPU topology tables: v5e/v6e are 2-D
+(x,y) slices with 1, 4, or 8 chips on single-host machines and 4 chips per
+host in multi-host slices; v4/v5p are 3-D (x,y,z) slices with 4 chips per
+host (a 2x2x1 sub-cube per host).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Accelerator:
+    name: str                 # user-facing: "v5e"
+    gke_label: str            # cloud.google.com/gke-tpu-accelerator value
+    dims: int                 # topology rank (2 for v5e/v6e, 3 for v4/v5p)
+    chips_per_host: int       # chips per host in multi-host slices
+    max_single_host_chips: int
+    hbm_gib_per_chip: int
+    bf16_peak_tflops: float   # per-chip peak, for MFU math
+
+
+ACCELERATORS: dict[str, Accelerator] = {
+    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 32, 275.0),
+    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 16, 197.0),
+    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 95, 459.0),
+    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 32, 918.0),
+}
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """Resolved shape of one TPU slice."""
+
+    accelerator: Accelerator
+    topology: str
+    chips: int
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def bf16_peak_tflops(self) -> float:
+        return self.chips * self.accelerator.bf16_peak_tflops
+
+
+def parse_topology(topology: str, dims: int) -> tuple[int, ...]:
+    parts = topology.lower().split("x")
+    if len(parts) != dims:
+        raise TopologyError(
+            f"topology {topology!r} must have {dims} dimensions (e.g. "
+            f"{'4x4' if dims == 2 else '2x2x2'})"
+        )
+    try:
+        vals = tuple(int(p) for p in parts)
+    except ValueError as e:
+        raise TopologyError(f"topology {topology!r}: {e}") from None
+    if any(v < 1 for v in vals):
+        raise TopologyError(f"topology {topology!r}: dimensions must be >= 1")
+    return vals
+
+
+def resolve(accelerator: str, topology: str) -> SliceShape:
+    """Resolve {accelerator, topology} to chips/hosts/chips-per-host."""
+    acc = ACCELERATORS.get(accelerator)
+    if acc is None:
+        raise TopologyError(
+            f"unknown accelerator {accelerator!r}; supported: "
+            f"{sorted(ACCELERATORS)}"
+        )
+    dims = parse_topology(topology, acc.dims)
+    chips = math.prod(dims)
+    if chips <= acc.max_single_host_chips:
+        num_hosts, per_host = 1, chips
+    else:
+        if chips % acc.chips_per_host != 0:
+            raise TopologyError(
+                f"{accelerator} topology {topology}: {chips} chips not "
+                f"divisible by {acc.chips_per_host} chips/host"
+            )
+        num_hosts, per_host = chips // acc.chips_per_host, acc.chips_per_host
+    return SliceShape(
+        accelerator=acc,
+        topology=topology,
+        chips=chips,
+        num_hosts=num_hosts,
+        chips_per_host=per_host,
+    )

@@ -1,0 +1,101 @@
+"""Env-var configuration surface.
+
+The reference's de-facto config system is environment variables fed by
+kustomize params ConfigMaps (SURVEY.md §5 "Config/flag system";
+culling_controller.go:32-42,534-567, notebook_controller.go:238,514,587,596).
+We keep the same variable names for drop-in compatibility but bind them into
+an injectable Config object so tests don't mutate process env.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+
+def _bool(env: Mapping[str, str], key: str, default: bool) -> bool:
+    v = env.get(key)
+    if v is None:
+        return default
+    return v.strip().lower() == "true"
+
+
+def _int(env: Mapping[str, str], key: str, default: int) -> int:
+    v = env.get(key)
+    if v is None or not v.strip():
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class CoreConfig:
+    """Core notebook-controller config (reference main.go:58-148 flags +
+    controller env vars)."""
+
+    # culling (culling_controller.go:32-42)
+    enable_culling: bool = False
+    cull_idle_time_min: int = 1440       # CULL_IDLE_TIME
+    idleness_check_period_min: int = 1   # IDLENESS_CHECK_PERIOD
+    cluster_domain: str = "cluster.local"
+    dev: bool = False
+    # workload rendering (notebook_controller.go:238,514)
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    add_fsgroup: bool = True
+    # TPU extensions
+    checkpoint_before_cull: bool = False  # signal workers before slice stop
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
+        env = env if env is not None else os.environ
+        return cls(
+            enable_culling=_bool(env, "ENABLE_CULLING", False),
+            cull_idle_time_min=_int(env, "CULL_IDLE_TIME", 1440),
+            idleness_check_period_min=_int(env, "IDLENESS_CHECK_PERIOD", 1),
+            cluster_domain=env.get("CLUSTER_DOMAIN", "cluster.local"),
+            dev=_bool(env, "DEV", False),
+            use_istio=_bool(env, "USE_ISTIO", False),
+            istio_gateway=env.get("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
+            istio_host=env.get("ISTIO_HOST", "*"),
+            add_fsgroup=_bool(env, "ADD_FSGROUP", True),
+            checkpoint_before_cull=_bool(env, "CHECKPOINT_BEFORE_CULL", False),
+        )
+
+
+@dataclass
+class OdhConfig:
+    """ODH controller config (odh main.go:141-347 + per-file env reads)."""
+
+    set_pipeline_rbac: bool = False          # SET_PIPELINE_RBAC
+    set_pipeline_secret: bool = False        # SET_PIPELINE_SECRET
+    inject_cluster_proxy_env: bool = False   # INJECT_CLUSTER_PROXY_ENV
+    mlflow_enabled: bool = False             # MLFLOW_ENABLED
+    gateway_url: str = ""                    # GATEWAY_URL
+    gateway_name: str = "data-science-gateway"       # NOTEBOOK_GATEWAY_NAME
+    gateway_namespace: str = "openshift-ingress"     # NOTEBOOK_GATEWAY_NAMESPACE
+    controller_namespace: str = "opendatahub"        # K8S_NAMESPACE
+    kube_rbac_proxy_image: str = "kube-rbac-proxy:latest"
+    # TPU extension: image swap table, CUDA image -> JAX/libtpu image
+    tpu_image_map: dict[str, str] = field(default_factory=dict)
+    tpu_default_image: str = "jupyter-tpu-jax:latest"
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "OdhConfig":
+        env = env if env is not None else os.environ
+        return cls(
+            set_pipeline_rbac=_bool(env, "SET_PIPELINE_RBAC", False),
+            set_pipeline_secret=_bool(env, "SET_PIPELINE_SECRET", False),
+            inject_cluster_proxy_env=_bool(env, "INJECT_CLUSTER_PROXY_ENV", False),
+            mlflow_enabled=_bool(env, "MLFLOW_ENABLED", False),
+            gateway_url=env.get("GATEWAY_URL", ""),
+            gateway_name=env.get("NOTEBOOK_GATEWAY_NAME", "data-science-gateway"),
+            gateway_namespace=env.get("NOTEBOOK_GATEWAY_NAMESPACE", "openshift-ingress"),
+            controller_namespace=env.get("K8S_NAMESPACE", "opendatahub"),
+            kube_rbac_proxy_image=env.get("KUBE_RBAC_PROXY_IMAGE", "kube-rbac-proxy:latest"),
+            tpu_default_image=env.get("TPU_DEFAULT_IMAGE", "jupyter-tpu-jax:latest"),
+        )

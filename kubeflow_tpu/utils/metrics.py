@@ -1,0 +1,113 @@
+"""Minimal Prometheus-style metrics registry (counters + gauges with labels)
+with text exposition, standing in for the controller-runtime metrics registry
+the reference uses (pkg/metrics/metrics.go:13-64)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str) -> "_Child":
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {values}"
+            )
+        return _Child(self, tuple(values))
+
+    def _set(self, key: tuple[str, ...], v: float) -> None:
+        with self._lock:
+            self._values[key] = v
+
+    def _add(self, key: tuple[str, ...], v: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + v
+
+    def value(self, *values: str) -> float:
+        return self._values.get(tuple(values), 0.0)
+
+    def kind(self) -> str:
+        raise NotImplementedError
+
+    def collect(self) -> dict[tuple[str, ...], float]:
+        return dict(self._values)
+
+
+class _Child:
+    def __init__(self, metric: _Metric, key: tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._metric._add(self._key, amount)
+
+    def set(self, v: float) -> None:
+        self._metric._set(self._key, v)
+
+
+class Counter(_Metric):
+    def kind(self) -> str:
+        return "counter"
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._add((), amount)
+
+
+class Gauge(_Metric):
+    def kind(self) -> str:
+        return "gauge"
+
+    def set(self, v: float) -> None:
+        self._set((), v)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    def collect(self) -> dict[tuple[str, ...], float]:
+        fn = getattr(self, "_fn", None)
+        if fn is not None:
+            self._set((), float(fn()))
+        return super().collect()
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list[_Metric] = []
+
+    def counter(
+        self, name: str, help_: str = "", labels: tuple[str, ...] = ()
+    ) -> Counter:
+        m = Counter(name, help_, labels)
+        self._metrics.append(m)
+        return m
+
+    def gauge(
+        self, name: str, help_: str = "", labels: tuple[str, ...] = ()
+    ) -> Gauge:
+        m = Gauge(name, help_, labels)
+        self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        for m in self._metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind()}")
+            for key, v in sorted(m.collect().items()):
+                if key:
+                    labels = ",".join(
+                        f'{n}="{val}"' for n, val in zip(m.label_names, key)
+                    )
+                    lines.append(f"{m.name}{{{labels}}} {v:g}")
+                else:
+                    lines.append(f"{m.name} {v:g}")
+        return "\n".join(lines) + "\n"

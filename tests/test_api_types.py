@@ -1,0 +1,61 @@
+"""Notebook API type tests: versions, conversion, validation (reference
+api/v1/notebook_conversion.go:25-69, field-identical version set)."""
+
+import pytest
+
+from kubeflow_tpu.api.types import HUB_VERSION, Notebook, TPUSpec, VERSIONS
+from kubeflow_tpu.kube import InvalidError
+
+
+class TestConversion:
+    def test_roundtrip_all_versions_lossless(self):
+        nb = Notebook.new(
+            "nb", "ns", tpu=TPUSpec("v5e", "4x4", slices=2),
+            pod_spec={"containers": [{"name": "nb", "image": "img"}]},
+            version="v1",
+        )
+        for v in VERSIONS:
+            converted = nb.convert_to(v)
+            assert converted.version == v
+            assert converted.obj.body == nb.obj.body
+            back = converted.convert_to("v1")
+            assert back.obj.to_dict() == nb.obj.to_dict()
+
+    def test_unknown_version_rejected(self):
+        nb = Notebook.new("nb", "ns")
+        with pytest.raises(InvalidError):
+            nb.convert_to("v2")
+
+    def test_hub_is_v1beta1(self):
+        assert HUB_VERSION == "v1beta1"
+
+
+class TestValidation:
+    def test_empty_containers_rejected(self):
+        nb = Notebook.new("nb", "ns", pod_spec={"containers": []})
+        with pytest.raises(InvalidError):
+            nb.validate()
+
+    def test_tpu_spec_validated(self):
+        nb = Notebook.new("nb", "ns", tpu=TPUSpec("v5e", "9x9x9"))
+        with pytest.raises(InvalidError):
+            nb.validate()
+
+    def test_valid_tpu_shape_exposed(self):
+        nb = Notebook.new("nb", "ns", tpu=TPUSpec("v5e", "4x4"))
+        nb.validate()
+        assert nb.tpu.shape.num_hosts == 4
+
+    def test_schema_enforced_at_apiserver(self):
+        from kubeflow_tpu.kube import AdmissionDenied, ApiServer
+        from kubeflow_tpu.api.validation import install_notebook_schema
+
+        api = ApiServer()
+        install_notebook_schema(api)
+        with pytest.raises(AdmissionDenied, match="containers"):
+            api.create(Notebook.new("bad", "ns", pod_spec={"containers": []}).obj)
+        with pytest.raises(AdmissionDenied, match="not served"):
+            bad = Notebook.new("bad", "ns")
+            bad.obj.api_version = "kubeflow.org/v9"
+            api.create(bad.obj)
+        api.create(Notebook.new("good", "ns", tpu=TPUSpec("v5e", "2x2")).obj)

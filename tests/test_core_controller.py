@@ -1,0 +1,338 @@
+"""Core NotebookReconciler tests: the analog of the reference's envtest BDD
+suite (notebook_controller_bdd_test.go:32-96) plus the TPU slice paths
+(SURVEY.md §7 build-plan steps 2-4)."""
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+
+@pytest.fixture()
+def env():
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    mgr = Manager(api, clock=FakeClock())
+    metrics = NotebookMetrics(api)
+    rec = setup_core_controllers(mgr, CoreConfig(), metrics)
+    return api, cluster, mgr, metrics, rec
+
+
+def create_nb(api, mgr, name="test-nb", ns="user1", tpu=None, pod_spec=None,
+              annotations=None):
+    nb = Notebook.new(name, ns, tpu=tpu, pod_spec=pod_spec,
+                      annotations=annotations)
+    api.create(nb.obj)
+    mgr.run_until_idle()
+    return nb
+
+
+class TestCpuPath:
+    def test_sts_and_service_created(self, env):
+        api, cluster, mgr, metrics, _ = env
+        create_nb(api, mgr)
+        sts = api.get("StatefulSet", "user1", "test-nb")
+        assert sts.spec["replicas"] == 1
+        assert sts.spec["serviceName"] == "test-nb"
+        tmpl = sts.spec["template"]
+        assert tmpl["metadata"]["labels"][C.NOTEBOOK_NAME_LABEL] == "test-nb"
+        assert tmpl["metadata"]["labels"][C.WORKBENCH_LABEL] == "true"
+        main = tmpl["spec"]["containers"][0]
+        assert main["workingDir"] == "/home/jovyan"
+        assert main["ports"][0]["containerPort"] == 8888
+        assert {"name": "NB_PREFIX", "value": "/notebook/user1/test-nb"} in main["env"]
+        assert tmpl["spec"]["securityContext"] == {"fsGroup": 100}
+        svc = api.get("Service", "user1", "test-nb")
+        assert svc.spec["ports"][0] == {
+            "name": "http-notebook", "port": 80, "targetPort": 8888,
+            "protocol": "TCP",
+        }
+        assert svc.spec["selector"] == {C.STATEFULSET_LABEL: "test-nb"}
+        assert metrics.creation.value("user1") == 1
+
+    def test_user_values_not_clobbered(self, env):
+        api, cluster, mgr, _, _ = env
+        pod_spec = {
+            "containers": [{
+                "name": "test-nb",
+                "workingDir": "/custom",
+                "ports": [{"containerPort": 9999, "name": "p"}],
+                "env": [{"name": "NB_PREFIX", "value": "/mine"}],
+            }],
+            "securityContext": {"runAsUser": 1000},
+        }
+        create_nb(api, mgr, pod_spec=pod_spec)
+        tmpl = api.get("StatefulSet", "user1", "test-nb").spec["template"]
+        main = tmpl["spec"]["containers"][0]
+        assert main["workingDir"] == "/custom"
+        assert main["ports"][0]["containerPort"] == 9999
+        assert main["env"] == [{"name": "NB_PREFIX", "value": "/mine"}]
+        # user securityContext respected (no fsGroup injected over it)
+        assert tmpl["spec"]["securityContext"] == {"runAsUser": 1000}
+        # service targets the user port
+        svc = api.get("Service", "user1", "test-nb")
+        assert svc.spec["ports"][0]["targetPort"] == 9999
+
+    def test_stop_annotation_scales_to_zero_and_back(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        assert api.get("Pod", "user1", "test-nb-0").body["status"]["phase"] == "Running"
+        nb = api.get("Notebook", "user1", "test-nb")
+        nb.metadata.annotations[C.STOP_ANNOTATION] = "2024-01-01T00:00:00Z"
+        api.update(nb)
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "test-nb").spec["replicas"] == 0
+        assert api.try_get("Pod", "user1", "test-nb-0") is None
+        # un-cull
+        nb = api.get("Notebook", "user1", "test-nb")
+        del nb.metadata.annotations[C.STOP_ANNOTATION]
+        api.update(nb)
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "test-nb").spec["replicas"] == 1
+        assert api.get("Pod", "user1", "test-nb-0").body["status"]["phase"] == "Running"
+
+    def test_status_mirrors_pod(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        nb = api.get("Notebook", "user1", "test-nb")
+        status = nb.status
+        assert status["readyReplicas"] == 1
+        cond_types = {c["type"] for c in status["conditions"]}
+        assert "Ready" in cond_types
+        # containerState mirrors the container named like the CR
+        assert "running" in status["containerState"]
+
+    def test_drift_reverted(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        sts = api.get("StatefulSet", "user1", "test-nb")
+        sts.spec["replicas"] = 5
+        api.update(sts)
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "test-nb").spec["replicas"] == 1
+
+    def test_recreated_on_delete(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        api.delete("Service", "user1", "test-nb")
+        mgr.run_until_idle()
+        assert api.get("Service", "user1", "test-nb") is not None
+
+    def test_restart_annotation(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        pod_uid = api.get("Pod", "user1", "test-nb-0").metadata.uid
+        nb = api.get("Notebook", "user1", "test-nb")
+        nb.metadata.annotations[C.ANNOTATION_NOTEBOOK_RESTART] = "true"
+        api.update(nb)
+        mgr.run_until_idle()
+        # pod recreated with a new identity, annotation cleared
+        new_pod = api.get("Pod", "user1", "test-nb-0")
+        assert new_pod.metadata.uid != pod_uid
+        nb = api.get("Notebook", "user1", "test-nb")
+        assert C.ANNOTATION_NOTEBOOK_RESTART not in nb.metadata.annotations
+
+    def test_long_name_uses_generate_name(self, env):
+        api, cluster, mgr, _, _ = env
+        long_name = "n" * 60
+        create_nb(api, mgr, name=long_name)
+        stss = api.list("StatefulSet", namespace="user1")
+        assert len(stss) == 1
+        assert stss[0].name.startswith("nb-")
+        assert len(stss[0].name) <= C.MAX_STATEFULSET_NAME_LENGTH
+        # reconciling again must not create a second STS
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        assert len(api.list("StatefulSet", namespace="user1")) == 1
+
+    def test_status_write_idempotent_with_real_clock(self):
+        """Re-reconciling with a ticking clock must not rewrite status
+        (timestamps are preserved for unchanged conditions) — otherwise
+        standalone mode hot-loops on its own status updates."""
+        from kubeflow_tpu.utils.clock import Clock
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1")
+        mgr = Manager(api, clock=Clock())  # real time
+        setup_core_controllers(mgr, CoreConfig(), NotebookMetrics(api))
+        api.create(Notebook.new("nb", "user1").obj)
+        mgr.run_until_idle()
+        rv = api.get("Notebook", "user1", "nb").metadata.resource_version
+        mgr.enqueue_all("notebook")
+        mgr.run_until_idle()
+        assert api.get("Notebook", "user1", "nb").metadata.resource_version == rv
+
+    def test_long_name_restart_and_pods_found(self, env):
+        api, cluster, mgr, _, _ = env
+        long_name = "n" * 60
+        create_nb(api, mgr, name=long_name)
+        sts = api.list("StatefulSet", namespace="user1")[0]
+        pod_name = f"{sts.name}-0"
+        pod_uid = api.get("Pod", "user1", pod_name).metadata.uid
+        nb = api.get("Notebook", "user1", long_name)
+        nb.metadata.annotations[C.ANNOTATION_NOTEBOOK_RESTART] = "true"
+        api.update(nb)
+        mgr.run_until_idle()
+        assert api.get("Pod", "user1", pod_name).metadata.uid != pod_uid
+
+    def test_terminating_notebook_not_reconciled(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        nb = api.get("Notebook", "user1", "test-nb")
+        nb.metadata.finalizers = ["some/finalizer"]
+        api.update(nb)
+        mgr.run_until_idle()
+        api.delete("Notebook", "user1", "test-nb")  # sets deletionTimestamp
+        api.delete("Service", "user1", "test-nb")
+        mgr.run_until_idle()
+        # controller must NOT recreate while terminating
+        assert api.try_get("Service", "user1", "test-nb") is None
+
+
+class TestTpuPath:
+    def test_v5e16_multihost_slice(self, env):
+        """BASELINE config #4: v5e-16 -> 4-worker indexed STS + headless svc
+        + distributed env wiring."""
+        api, cluster, mgr, _, _ = env
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        create_nb(api, mgr, name="maxtext", tpu=TPUSpec("v5e", "4x4"))
+        sts = api.get("StatefulSet", "user1", "maxtext")
+        assert sts.spec["replicas"] == 4
+        assert sts.spec["podManagementPolicy"] == "Parallel"
+        assert sts.spec["serviceName"] == "maxtext-workers"
+        spec = sts.spec["template"]["spec"]
+        assert spec["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        main = spec["containers"][0]
+        assert main["resources"]["requests"]["google.com/tpu"] == "4"
+        assert main["resources"]["limits"]["google.com/tpu"] == "4"
+        env_by_name = {e["name"]: e for e in main["env"]}
+        assert env_by_name["TPU_WORKER_HOSTNAMES"]["value"] == ",".join(
+            f"maxtext-{i}.maxtext-workers" for i in range(4)
+        )
+        assert (
+            env_by_name["TPU_WORKER_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "metadata.labels['apps.kubernetes.io/pod-index']"
+        )
+        assert env_by_name["JAX_COORDINATOR_ADDRESS"]["value"] == (
+            "maxtext-0.maxtext-workers:8471"
+        )
+        assert "MEGASCALE_NUM_SLICES" not in env_by_name  # single slice
+        # headless service exists and fronts all workers
+        headless = api.get("Service", "user1", "maxtext-workers")
+        assert headless.spec["clusterIP"] == "None"
+        assert headless.spec["selector"] == {C.NOTEBOOK_NAME_LABEL: "maxtext"}
+        # all 4 workers scheduled and running on distinct TPU nodes
+        pods = api.list("Pod", namespace="user1")
+        assert len(pods) == 4
+        assert all(p.body["status"]["phase"] == "Running" for p in pods)
+        assert len({p.spec["nodeName"] for p in pods}) == 4
+        # status: per-worker states + slice health
+        nb = api.get("Notebook", "user1", "maxtext")
+        assert nb.status["readyReplicas"] == 4
+        assert nb.status["sliceHealth"] == "Healthy"
+        assert len(nb.status["workerStates"]) == 4
+
+    def test_multislice_dcn_env(self, env):
+        """BASELINE config #5: 2 slices -> 2 STS + MEGASCALE_* coordination."""
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr, name="gemma", tpu=TPUSpec("v5p", "2x2x2", slices=2))
+        sts0 = api.get("StatefulSet", "user1", "gemma-slice-0")
+        sts1 = api.get("StatefulSet", "user1", "gemma-slice-1")
+        for slice_id, sts in ((0, sts0), (1, sts1)):
+            assert sts.spec["replicas"] == 2
+            env_by_name = {
+                e["name"]: e
+                for e in sts.spec["template"]["spec"]["containers"][0]["env"]
+            }
+            assert env_by_name["MEGASCALE_NUM_SLICES"]["value"] == "2"
+            assert env_by_name["MEGASCALE_SLICE_ID"]["value"] == str(slice_id)
+            assert env_by_name["MEGASCALE_COORDINATOR_ADDRESS"]["value"] == (
+                "gemma-slice-0-0.gemma-workers"
+            )
+        # scale-in to 1 slice prunes slice-1
+        nb = api.get("Notebook", "user1", "gemma")
+        nb.spec["tpu"]["slices"] = 1
+        api.update(nb)
+        mgr.run_until_idle()
+        assert api.try_get("StatefulSet", "user1", "gemma-slice-1") is None
+        assert api.get("StatefulSet", "user1", "gemma") is not None
+
+    def test_slice_atomic_stop(self, env):
+        api, cluster, mgr, _, _ = env
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        create_nb(api, mgr, name="maxtext", tpu=TPUSpec("v5e", "4x4"))
+        nb = api.get("Notebook", "user1", "maxtext")
+        nb.metadata.annotations[C.STOP_ANNOTATION] = "now"
+        api.update(nb)
+        mgr.run_until_idle()
+        # whole slice gone, not partial
+        assert api.get("StatefulSet", "user1", "maxtext").spec["replicas"] == 0
+        assert api.list("Pod", namespace="user1") == []
+        nb = api.get("Notebook", "user1", "maxtext")
+        assert nb.status["sliceHealth"] == "Stopped"
+
+    def test_degraded_slice_health(self, env):
+        api, cluster, mgr, _, _ = env
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        create_nb(api, mgr, name="maxtext", tpu=TPUSpec("v5e", "4x4"))
+        cluster.fail_pod("user1", "maxtext-2")
+        mgr.run_until_idle()
+        nb = api.get("Notebook", "user1", "maxtext")
+        assert nb.status["sliceHealth"] == "Degraded"
+        states = {w["pod"]: w for w in nb.status["workerStates"]}
+        assert states["maxtext-2"]["ready"] is False
+        assert states["maxtext-2"]["phase"] == "Failed"
+
+    def test_invalid_topology_rejected(self, env):
+        from kubeflow_tpu.kube import InvalidError
+        with pytest.raises(InvalidError):
+            TPUSpec("v5e", "3x5x7").validate()
+
+
+class TestEventReemission:
+    def test_pod_event_reemitted_on_notebook(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        from kubeflow_tpu.kube import EventRecorder
+        kubelet_rec = EventRecorder(api, "kubelet")
+        pod = api.get("Pod", "user1", "test-nb-0")
+        kubelet_rec.event(pod, "Warning", "FailedMount", "volume not found")
+        mgr.run_until_idle()
+        nb_events = [
+            e
+            for e in api.list("Event", namespace="user1")
+            if e.body["involvedObject"]["kind"] == "Notebook"
+        ]
+        assert len(nb_events) == 1
+        assert nb_events[0].body["reason"] == "FailedMount"
+        assert "Reissued from pod/test-nb-0" in nb_events[0].body["message"]
+
+
+class TestMetricsScrape:
+    def test_running_gauge_and_chips(self, env):
+        api, cluster, mgr, metrics, _ = env
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        create_nb(api, mgr, name="cpu-nb")
+        create_nb(api, mgr, name="tpu-nb", tpu=TPUSpec("v5e", "4x4"))
+        text = metrics.scrape()
+        assert metrics.running.value("user1") == 2
+        assert metrics.tpu_chips_requested.value("user1") == 16
+        assert 'notebook_running{namespace="user1"} 2' in text
+
+    def test_multislice_counts_as_one_notebook(self, env):
+        api, cluster, mgr, metrics, _ = env
+        create_nb(api, mgr, name="gemma", tpu=TPUSpec("v5p", "2x2x2", slices=2))
+        metrics.scrape()
+        assert metrics.running.value("user1") == 1
+        # chips: 2 slices x 2 hosts x 4 chips
+        assert metrics.tpu_chips_requested.value("user1") == 16

@@ -1,0 +1,54 @@
+"""TPU topology math tests."""
+
+import pytest
+
+from kubeflow_tpu.tpu.topology import ACCELERATORS, TopologyError, resolve
+
+
+class TestResolve:
+    @pytest.mark.parametrize(
+        "acc,topo,chips,hosts,per_host",
+        [
+            # v5e (2D): single host up to 8 chips, then 4 chips/host
+            ("v5e", "1x1", 1, 1, 1),
+            ("v5e", "2x2", 4, 1, 4),
+            ("v5e", "2x4", 8, 1, 8),
+            ("v5e", "4x4", 16, 4, 4),       # BASELINE config #4 (v5e-16)
+            ("v5e", "4x8", 32, 8, 4),
+            ("v5e", "8x8", 64, 16, 4),
+            ("v5e", "16x16", 256, 64, 4),
+            # v6e mirrors v5e shapes
+            ("v6e", "4x4", 16, 4, 4),
+            # v4/v5p (3D): 4 chips per host
+            ("v4", "2x2x1", 4, 1, 4),
+            ("v4", "2x2x4", 16, 4, 4),
+            ("v5p", "2x2x1", 4, 1, 4),
+            ("v5p", "2x2x2", 8, 2, 4),
+            ("v5p", "4x4x8", 128, 32, 4),   # BASELINE config #5 (v5p-128)
+        ],
+    )
+    def test_known_topologies(self, acc, topo, chips, hosts, per_host):
+        shape = resolve(acc, topo)
+        assert shape.chips == chips
+        assert shape.num_hosts == hosts
+        assert shape.chips_per_host == per_host
+
+    def test_unknown_accelerator(self):
+        with pytest.raises(TopologyError, match="unknown accelerator"):
+            resolve("v99", "2x2")
+
+    def test_wrong_dims(self):
+        with pytest.raises(TopologyError, match="dimensions"):
+            resolve("v5e", "2x2x2")  # v5e is 2D
+        with pytest.raises(TopologyError, match="dimensions"):
+            resolve("v5p", "4x4")  # v5p is 3D
+
+    def test_garbage(self):
+        with pytest.raises(TopologyError):
+            resolve("v5e", "axb")
+        with pytest.raises(TopologyError):
+            resolve("v5e", "0x4")
+
+    def test_peak_flops_scales_with_chips(self):
+        shape = resolve("v5e", "4x4")
+        assert shape.bf16_peak_tflops == 16 * ACCELERATORS["v5e"].bf16_peak_tflops
