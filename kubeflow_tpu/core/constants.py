@@ -20,8 +20,10 @@ LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
 LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
     "notebooks.kubeflow.org/last_activity_check_timestamp"
 )
-# TPU extension: set while a pre-cull checkpoint has been requested
+# TPU extension: set while a pre-cull checkpoint has been requested; the
+# in-notebook runtime acknowledges with checkpoint-complete
 ANNOTATION_CHECKPOINT_REQUESTED = "notebooks.kubeflow.org/checkpoint-requested"
+ANNOTATION_CHECKPOINT_COMPLETE = "notebooks.kubeflow.org/checkpoint-complete"
 
 # labels
 WORKBENCH_LABEL = "opendatahub.io/workbenches"

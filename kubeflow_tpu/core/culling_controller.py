@@ -1,0 +1,165 @@
+"""Culling controller: idle detection -> scale-to-zero.
+
+Port of CullingReconciler
+(components/notebook-controller/controllers/culling_controller.go:73-588)
+with two TPU extensions: culling is slice-atomic by construction (the stop
+annotation scales every slice StatefulSet to zero — partial stops cannot
+exist), and an optional checkpoint-before-cull handshake gives the
+in-notebook runtime a grace window to snapshot JAX state before the slice
+goes away (SURVEY.md §5 'Checkpoint/resume')."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import Notebook
+from ..kube import ApiServer, Manager, Request, Result, retry_on_conflict
+from ..tpu import env as tpuenv
+from ..utils.clock import Clock
+from ..utils.config import CoreConfig
+from . import constants as C
+from . import culler
+from .jupyter import JupyterAPI
+from .metrics import NotebookMetrics
+
+logger = logging.getLogger("kubeflow_tpu.culling")
+
+# annotation the in-notebook runtime sets once its pre-cull checkpoint is done
+CHECKPOINT_COMPLETE_ANNOTATION = C.ANNOTATION_CHECKPOINT_COMPLETE
+
+
+class CullingReconciler:
+    def __init__(
+        self,
+        api: ApiServer,
+        cfg: CoreConfig,
+        jupyter: JupyterAPI,
+        metrics: NotebookMetrics,
+        clock: Optional[Clock] = None,
+    ):
+        self.api = api
+        self.cfg = cfg
+        self.jupyter = jupyter
+        self.metrics = metrics
+        self.clock = clock or Clock()
+
+    def _requeue(self) -> Result:
+        return Result(requeue_after=self.cfg.idleness_check_period_min * 60)
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.api.try_get("Notebook", req.namespace, req.name)
+        if obj is None:
+            return Result()
+        nb = Notebook(obj)
+
+        # already stopping: drop activity annotations, no requeue (:105-118)
+        if culler.stop_annotation_is_set(obj.metadata):
+            self._mutate(req, culler.remove_activity_annotations)
+            return Result()
+
+        # worker-0 pod of slice 0 runs the Jupyter server; without it there
+        # is nothing to probe (:121-136)
+        num_slices = nb.tpu.slices if nb.tpu else 1
+        sts0 = tpuenv.statefulset_name(nb.name, 0, num_slices)
+        pod0 = self.api.try_get("Pod", req.namespace, f"{sts0}-0")
+        if pod0 is None:
+            self._mutate(req, culler.remove_activity_annotations)
+            return Result()
+
+        # initialize annotations (:142-154)
+        if not culler.annotations_exist(obj.metadata):
+            self._mutate(
+                req, lambda meta: culler.initialize_annotations(meta, self.clock)
+            )
+
+        # period gate (:157-160)
+        live = self.api.get("Notebook", req.namespace, req.name)
+        if not culler.culling_check_period_has_passed(
+            live.metadata, self.clock, self.cfg.idleness_check_period_min
+        ):
+            return self._requeue()
+
+        # probe Jupyter outside the retry loop (:163-169)
+        kernels = self.jupyter.get_kernels(req.name, req.namespace)
+        terminals = self.jupyter.get_terminals(req.name, req.namespace)
+
+        def apply(meta) -> None:
+            culler.update_last_activity_from_kernels(meta, kernels, self.clock)
+            culler.update_last_activity_from_terminals(meta, terminals, self.clock)
+            culler.update_last_culling_check_timestamp(meta, self.clock)
+            if not culler.notebook_is_idle(
+                meta, self.clock, self.cfg.cull_idle_time_min
+            ):
+                # activity resumed: reset the checkpoint handshake so the
+                # next idle period gets a fresh request + grace window
+                culler.remove_checkpoint_annotations(meta)
+            else:
+                if self._should_wait_for_checkpoint(nb, meta):
+                    return
+                logger.info("culling notebook %s/%s", req.namespace, req.name)
+                culler.set_stop_annotation(meta, self.clock)
+                self.metrics.culling.labels(req.namespace, req.name).inc()
+                self.metrics.last_culling_timestamp.labels(
+                    req.namespace, req.name
+                ).set(self.clock.now())
+
+        self._mutate(req, apply)
+        return self._requeue()
+
+    def _should_wait_for_checkpoint(self, nb: Notebook, meta) -> bool:
+        """Checkpoint-before-cull handshake (TPU extension, off by default):
+        on the first idle verdict, stamp checkpoint-requested and hold the
+        cull until the runtime acknowledges with checkpoint-complete or the
+        grace window (one idleness period) expires."""
+        if not (self.cfg.checkpoint_before_cull and nb.tpu is not None):
+            return False
+        requested = meta.annotations.get(C.ANNOTATION_CHECKPOINT_REQUESTED)
+        if requested is None:
+            meta.annotations[C.ANNOTATION_CHECKPOINT_REQUESTED] = (
+                self.clock.now_iso()
+            )
+            return True
+        if C.ANNOTATION_CHECKPOINT_COMPLETE in meta.annotations:
+            return False
+        from ..utils.clock import parse_iso
+
+        try:
+            grace_end = parse_iso(requested) + self.cfg.idleness_check_period_min * 60
+        except ValueError:
+            return False
+        return self.clock.now() < grace_end
+
+    def _mutate(self, req: Request, fn) -> None:
+        """Read-modify-write on the CR metadata with conflict retry — the
+        reference wraps every annotation write the same way
+        (culling_controller.go:107,125,144,172)."""
+
+        def attempt() -> None:
+            live = self.api.get("Notebook", req.namespace, req.name)
+            before = dict(live.metadata.annotations)
+            fn(live.metadata)
+            if live.metadata.annotations != before:
+                self.api.update(live)
+
+        retry_on_conflict(attempt)
+
+
+def setup_culling(
+    mgr: Manager,
+    cfg: Optional[CoreConfig] = None,
+    jupyter: Optional[JupyterAPI] = None,
+    metrics: Optional[NotebookMetrics] = None,
+) -> Optional[CullingReconciler]:
+    """Register the culler, gated on ENABLE_CULLING (main.go:111-123)."""
+    cfg = cfg or CoreConfig.from_env()
+    if not cfg.enable_culling:
+        return None
+    if jupyter is None:
+        from .jupyter import HttpJupyterClient
+
+        jupyter = HttpJupyterClient(cfg.cluster_domain, cfg.dev)
+    metrics = metrics or NotebookMetrics(mgr.api)
+    rec = CullingReconciler(mgr.api, cfg, jupyter, metrics, clock=mgr.clock)
+    mgr.register("culling", rec, for_kind="Notebook")
+    return rec

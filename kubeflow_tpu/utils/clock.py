@@ -32,6 +32,15 @@ class FakeClock(Clock):
 
 
 def parse_iso(ts: str) -> float:
-    import calendar
+    """RFC3339 parse accepting fractional seconds and offsets — real Jupyter
+    reports e.g. 2026-07-29T10:00:00.533016Z (the Go reference parses with
+    time.RFC3339, which accepts the same)."""
+    from datetime import datetime, timezone
 
-    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    s = ts.strip()
+    if s.endswith(("Z", "z")):
+        s = s[:-1] + "+00:00"
+    dt = datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()

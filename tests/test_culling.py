@@ -1,0 +1,282 @@
+"""Culling controller tests (reference culling_controller_test.go:13-142 +
+idleness flow through the manager with a fake clock)."""
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core import culler
+from kubeflow_tpu.core.culling_controller import (
+    CHECKPOINT_COMPLETE_ANNOTATION,
+    setup_culling,
+)
+from kubeflow_tpu.core.jupyter import FakeJupyterState
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager, ObjectMeta
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+
+class TestCullerLib:
+    def test_stop_annotation_roundtrip(self):
+        clock = FakeClock()
+        meta = ObjectMeta()
+        assert not culler.stop_annotation_is_set(meta)
+        culler.set_stop_annotation(meta, clock)
+        assert culler.stop_annotation_is_set(meta)
+        culler.remove_stop_annotation(meta)
+        assert not culler.stop_annotation_is_set(meta)
+
+    def test_idleness_math(self):
+        clock = FakeClock()
+        meta = ObjectMeta()
+        culler.initialize_annotations(meta, clock)
+        assert not culler.notebook_is_idle(meta, clock, cull_idle_min=60)
+        clock.advance(59 * 60)
+        assert not culler.notebook_is_idle(meta, clock, cull_idle_min=60)
+        clock.advance(2 * 60)
+        assert culler.notebook_is_idle(meta, clock, cull_idle_min=60)
+        # stopped notebooks are never "idle"
+        culler.set_stop_annotation(meta, clock)
+        assert not culler.notebook_is_idle(meta, clock, cull_idle_min=60)
+
+    def test_busy_kernel_bumps_activity_to_now(self):
+        clock = FakeClock()
+        meta = ObjectMeta()
+        culler.initialize_annotations(meta, clock)
+        clock.advance(3600)
+        kernels = [
+            {"execution_state": "idle", "last_activity": "2020-01-01T00:00:00Z"},
+            {"execution_state": "busy", "last_activity": "2020-01-01T00:00:00Z"},
+        ]
+        culler.update_last_activity_from_kernels(meta, kernels, clock)
+        assert meta.annotations[C.LAST_ACTIVITY_ANNOTATION] == clock.now_iso()
+
+    def test_idle_kernels_use_most_recent_but_never_backwards(self):
+        clock = FakeClock()
+        meta = ObjectMeta()
+        meta.annotations[C.LAST_ACTIVITY_ANNOTATION] = "2023-06-01T00:00:00Z"
+        kernels = [
+            {"execution_state": "idle", "last_activity": "2023-01-01T00:00:00Z"},
+            {"execution_state": "idle", "last_activity": "2023-02-01T00:00:00Z"},
+        ]
+        culler.update_last_activity_from_kernels(meta, kernels, clock)
+        # both kernel times predate the annotation: no backwards move
+        assert meta.annotations[C.LAST_ACTIVITY_ANNOTATION] == "2023-06-01T00:00:00Z"
+        kernels[1]["last_activity"] = "2023-07-01T00:00:00Z"
+        culler.update_last_activity_from_kernels(meta, kernels, clock)
+        assert meta.annotations[C.LAST_ACTIVITY_ANNOTATION] == "2023-07-01T00:00:00Z"
+
+    def test_fractional_second_timestamps_parse(self):
+        """Real Jupyter reports fractional seconds; they must advance the
+        annotation (regression: strict %S parse silently dropped them)."""
+        clock = FakeClock()
+        meta = ObjectMeta()
+        meta.annotations[C.LAST_ACTIVITY_ANNOTATION] = "2023-06-01T00:00:00Z"
+        kernels = [{"execution_state": "idle",
+                    "last_activity": "2023-07-29T10:00:00.533016Z"}]
+        culler.update_last_activity_from_kernels(meta, kernels, clock)
+        assert meta.annotations[C.LAST_ACTIVITY_ANNOTATION] == (
+            "2023-07-29T10:00:00.533016Z"
+        )
+
+    def test_unparsable_timestamp_ignored(self):
+        clock = FakeClock()
+        meta = ObjectMeta()
+        meta.annotations[C.LAST_ACTIVITY_ANNOTATION] = "2023-06-01T00:00:00Z"
+        kernels = [{"execution_state": "idle", "last_activity": "not-a-time"}]
+        culler.update_last_activity_from_kernels(meta, kernels, clock)
+        assert meta.annotations[C.LAST_ACTIVITY_ANNOTATION] == "2023-06-01T00:00:00Z"
+
+
+@pytest.fixture()
+def env():
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("n1", allocatable={"cpu": "64", "memory": "256Gi"})
+    cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+    clock = FakeClock()
+    mgr = Manager(api, clock=clock)
+    metrics = NotebookMetrics(api)
+    jupyter = FakeJupyterState()
+    cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                     idleness_check_period_min=1)
+    setup_core_controllers(mgr, cfg, metrics)
+    setup_culling(mgr, cfg, jupyter, metrics)
+    return api, mgr, clock, jupyter, metrics
+
+
+def idle_kernel(ts="2023-01-01T00:00:00Z"):
+    return {"id": "k1", "name": "python3", "last_activity": ts,
+            "execution_state": "idle", "connections": 0}
+
+
+class TestCullingFlow:
+    def test_idle_notebook_culled_and_metrics(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("nb", "u1").obj)
+        mgr.run_until_idle()
+        jupyter.set_kernels("u1", "nb", [idle_kernel()])
+        # annotations initialized on first pass
+        nb = api.get("Notebook", "u1", "nb")
+        assert C.LAST_ACTIVITY_ANNOTATION in nb.metadata.annotations
+        # not yet idle: advance 30 min
+        mgr.advance(30 * 60)
+        nb = api.get("Notebook", "u1", "nb")
+        assert not culler.stop_annotation_is_set(nb.metadata)
+        # push past the 60-min idle threshold
+        mgr.advance(35 * 60)
+        nb = api.get("Notebook", "u1", "nb")
+        assert culler.stop_annotation_is_set(nb.metadata)
+        # notebook controller saw it: replicas 0, pod gone
+        assert api.get("StatefulSet", "u1", "nb").spec["replicas"] == 0
+        assert api.try_get("Pod", "u1", "nb-0") is None
+        assert metrics.culling.value("u1", "nb") == 1
+        # activity annotations removed once stopping
+        mgr.run_until_idle()
+        nb = api.get("Notebook", "u1", "nb")
+        assert C.LAST_ACTIVITY_ANNOTATION not in nb.metadata.annotations
+
+    def test_busy_kernel_prevents_cull(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("nb", "u1").obj)
+        mgr.run_until_idle()
+        busy = dict(idle_kernel(), execution_state="busy")
+        jupyter.set_kernels("u1", "nb", [busy])
+        for _ in range(5):
+            mgr.advance(30 * 60)
+        nb = api.get("Notebook", "u1", "nb")
+        assert not culler.stop_annotation_is_set(nb.metadata)
+        assert api.get("StatefulSet", "u1", "nb").spec["replicas"] == 1
+
+    def test_uncull_reinitializes(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("nb", "u1").obj)
+        mgr.run_until_idle()
+        jupyter.set_kernels("u1", "nb", [idle_kernel()])
+        mgr.advance(61 * 60)
+        assert culler.stop_annotation_is_set(api.get("Notebook", "u1", "nb").metadata)
+        # dashboard un-culls by removing the annotation
+        def unstop():
+            nb = api.get("Notebook", "u1", "nb")
+            culler.remove_stop_annotation(nb.metadata)
+            api.update(nb)
+        from kubeflow_tpu.kube import retry_on_conflict
+        retry_on_conflict(unstop)
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "u1", "nb").spec["replicas"] == 1
+        assert api.get("Pod", "u1", "nb-0").body["status"]["phase"] == "Running"
+
+    def test_unreachable_jupyter_does_not_cull_prematurely(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("nb", "u1").obj)
+        mgr.run_until_idle()
+        # jupyter returns None (unreachable): last-activity stays at init time,
+        # so the notebook still culls after the idle window — matching the
+        # reference (probe failure doesn't block culling)
+        mgr.advance(61 * 60)
+        nb = api.get("Notebook", "u1", "nb")
+        assert culler.stop_annotation_is_set(nb.metadata)
+
+
+class TestSliceAtomicCulling:
+    def test_tpu_notebook_culled_whole_slice(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        assert len(api.list("Pod", namespace="u1")) == 4
+        jupyter.set_kernels("u1", "tnb", [idle_kernel()])
+        mgr.advance(61 * 60)
+        # all four workers gone atomically
+        assert api.list("Pod", namespace="u1") == []
+        assert api.get("Notebook", "u1", "tnb").status["sliceHealth"] == "Stopped"
+
+    def test_checkpoint_before_cull_handshake(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api)
+        jupyter = FakeJupyterState()
+        cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                         idleness_check_period_min=1, checkpoint_before_cull=True)
+        setup_core_controllers(mgr, cfg, metrics)
+        setup_culling(mgr, cfg, jupyter, metrics)
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        jupyter.set_kernels("u1", "tnb", [idle_kernel()])
+        mgr.advance(61 * 60)
+        nb = api.get("Notebook", "u1", "tnb")
+        # first idle verdict: checkpoint requested, NOT yet stopped
+        assert C.ANNOTATION_CHECKPOINT_REQUESTED in nb.metadata.annotations
+        assert not culler.stop_annotation_is_set(nb.metadata)
+        assert len(api.list("Pod", namespace="u1")) == 4
+        # runtime acks the checkpoint -> culled on next pass
+        nb.metadata.annotations[CHECKPOINT_COMPLETE_ANNOTATION] = "true"
+        api.update(nb)
+        mgr.advance(61)
+        nb = api.get("Notebook", "u1", "tnb")
+        assert culler.stop_annotation_is_set(nb.metadata)
+        assert api.list("Pod", namespace="u1") == []
+
+    def test_stale_checkpoint_state_reset_on_activity(self):
+        """A stale checkpoint-complete from a previous cycle, or a stale
+        request, must not bypass the next grace window."""
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                         idleness_check_period_min=1, checkpoint_before_cull=True)
+        metrics = NotebookMetrics(api)
+        jupyter = FakeJupyterState()
+        setup_core_controllers(mgr, cfg, metrics)
+        setup_culling(mgr, cfg, jupyter, metrics)
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        jupyter.set_kernels("u1", "tnb", [idle_kernel()])
+        mgr.advance(61 * 60)  # idle -> checkpoint requested
+        assert C.ANNOTATION_CHECKPOINT_REQUESTED in api.get(
+            "Notebook", "u1", "tnb").metadata.annotations
+        # user comes back: busy kernel resets the handshake
+        jupyter.set_kernels(
+            "u1", "tnb", [dict(idle_kernel(), execution_state="busy")])
+        mgr.advance(2 * 60)
+        anns = api.get("Notebook", "u1", "tnb").metadata.annotations
+        assert C.ANNOTATION_CHECKPOINT_REQUESTED not in anns
+        assert not culler.stop_annotation_is_set(
+            api.get("Notebook", "u1", "tnb").metadata)
+
+    def test_checkpoint_grace_expires(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                         idleness_check_period_min=1, checkpoint_before_cull=True)
+        metrics = NotebookMetrics(api)
+        jupyter = FakeJupyterState()
+        setup_core_controllers(mgr, cfg, metrics)
+        setup_culling(mgr, cfg, jupyter, metrics)
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        jupyter.set_kernels("u1", "tnb", [idle_kernel()])
+        mgr.advance(61 * 60)
+        assert not culler.stop_annotation_is_set(
+            api.get("Notebook", "u1", "tnb").metadata
+        )
+        # no ack; grace (= one check period) passes -> culled anyway
+        mgr.advance(2 * 60)
+        assert culler.stop_annotation_is_set(
+            api.get("Notebook", "u1", "tnb").metadata
+        )
+
+
+class TestCullingDisabled:
+    def test_setup_returns_none_when_disabled(self):
+        mgr = Manager(ApiServer(), clock=FakeClock())
+        assert setup_culling(mgr, CoreConfig(enable_culling=False)) is None
