@@ -1,0 +1,13 @@
+"""ODH extension plane: routing, auth, webhooks, and data-science
+integrations layered over the core notebook controller (reference:
+components/odh-notebook-controller)."""
+
+from .controller import OpenshiftNotebookReconciler, setup_odh_controllers
+from .webhook import NotebookMutatingWebhook, NotebookValidatingWebhook
+
+__all__ = [
+    "NotebookMutatingWebhook",
+    "NotebookValidatingWebhook",
+    "OpenshiftNotebookReconciler",
+    "setup_odh_controllers",
+]

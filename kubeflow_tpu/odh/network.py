@@ -1,0 +1,113 @@
+"""Per-notebook NetworkPolicies.
+
+Port of odh notebook_network.go: lock the Jupyter port down to the controller
+namespace (the gateway data path enters through the central-ns HTTPRoute) and
+open the kube-rbac-proxy port to everyone — it authenticates for itself
+(notebook_network.go:44-211).  TPU extension: a third policy opening the JAX
+coordinator / MEGASCALE ports *between the workers of the same notebook*, so
+ICI/DCN bootstrap traffic flows while the slice stays isolated from other
+tenants (SURVEY.md §7 step 7).
+"""
+
+from __future__ import annotations
+
+from ..api.types import Notebook
+from ..common import reconcilehelper as rh
+from ..kube import ApiServer, KubeObject, ObjectMeta, set_controller_reference
+from ..tpu import env as tpuenv
+from . import constants as C
+
+
+def _policy(nb: Notebook, name: str, spec: dict) -> KubeObject:
+    return KubeObject(
+        api_version="networking.k8s.io/v1",
+        kind="NetworkPolicy",
+        metadata=ObjectMeta(name=name, namespace=nb.namespace),
+        body={"spec": spec},
+    )
+
+
+def new_notebook_network_policy(nb: Notebook, controller_namespace: str) -> KubeObject:
+    """Allow :8888 only from the controller namespace
+    (notebook_network.go:132-174)."""
+    return _policy(
+        nb,
+        nb.name + "-ctrl-np",
+        {
+            "podSelector": {"matchLabels": {C.NOTEBOOK_NAME_LABEL: nb.name}},
+            "ingress": [
+                {
+                    "ports": [{"protocol": "TCP", "port": C.NOTEBOOK_PORT}],
+                    "from": [
+                        {
+                            "namespaceSelector": {
+                                "matchLabels": {
+                                    "kubernetes.io/metadata.name": controller_namespace
+                                }
+                            }
+                        }
+                    ],
+                }
+            ],
+            "policyTypes": ["Ingress"],
+        },
+    )
+
+
+def new_kube_rbac_proxy_network_policy(nb: Notebook) -> KubeObject:
+    """Allow :8443 from anywhere — the proxy is the auth boundary
+    (notebook_network.go:177-211)."""
+    return _policy(
+        nb,
+        nb.name + C.KUBE_RBAC_PROXY_NETWORK_POLICY_SUFFIX,
+        {
+            "podSelector": {"matchLabels": {C.NOTEBOOK_NAME_LABEL: nb.name}},
+            "ingress": [
+                {"ports": [{"protocol": "TCP", "port": C.KUBE_RBAC_PROXY_PORT}]}
+            ],
+            "policyTypes": ["Ingress"],
+        },
+    )
+
+
+def new_tpu_worker_network_policy(nb: Notebook) -> KubeObject:
+    """TPU extension: workers of one notebook may reach each other on the
+    distributed-runtime ports (JAX coordinator + MEGASCALE DCN transport).
+    Selector on both sides is the notebook-name label, so the policy covers
+    every slice of a multi-slice notebook."""
+    peer = {
+        "podSelector": {"matchLabels": {C.NOTEBOOK_NAME_LABEL: nb.name}},
+    }
+    return _policy(
+        nb,
+        nb.name + C.TPU_WORKER_NETWORK_POLICY_SUFFIX,
+        {
+            "podSelector": {"matchLabels": {C.NOTEBOOK_NAME_LABEL: nb.name}},
+            "ingress": [
+                {
+                    "ports": [
+                        {"protocol": "TCP", "port": tpuenv.JAX_COORDINATOR_PORT},
+                        {"protocol": "TCP", "port": tpuenv.MEGASCALE_PORT},
+                    ],
+                    "from": [peer],
+                }
+            ],
+            "policyTypes": ["Ingress"],
+        },
+    )
+
+
+def reconcile_all_network_policies(
+    api: ApiServer, nb: Notebook, controller_namespace: str
+) -> None:
+    """ReconcileAllNetworkPolicies (notebook_network.go:44-66) + the TPU
+    worker policy when spec.tpu is set."""
+    policies = [
+        new_notebook_network_policy(nb, controller_namespace),
+        new_kube_rbac_proxy_network_policy(nb),
+    ]
+    if nb.tpu is not None:
+        policies.append(new_tpu_worker_network_policy(nb))
+    for desired in policies:
+        set_controller_reference(nb.obj, desired)
+        rh.reconcile_object(api, desired, rh.copy_spec)

@@ -1,0 +1,24 @@
+"""Legacy OAuthClient migration cleanup.
+
+Port of notebook_oauth.go: RHOAI 2.x created one cluster-scoped OAuthClient
+per notebook; on notebook deletion the matching client (named
+`{name}-{namespace}-oauth-client`) is deleted via finalizer
+(notebook_oauth.go:67-96).
+"""
+
+from __future__ import annotations
+
+from ..api.types import Notebook
+from ..kube import ApiServer, NotFoundError
+
+
+def oauth_client_name(nb: Notebook) -> str:
+    return f"{nb.name}-{nb.namespace}-oauth-client"
+
+
+def delete_oauth_client(api: ApiServer, nb: Notebook) -> None:
+    """deleteOAuthClient (notebook_oauth.go:67-96); absence is success."""
+    try:
+        api.delete("OAuthClient", "", oauth_client_name(nb))
+    except NotFoundError:
+        pass

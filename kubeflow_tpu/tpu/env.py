@@ -102,6 +102,18 @@ def tpu_env_vars(
     return env
 
 
+def upsert_by_name(items: list[dict], item: dict) -> None:
+    """Replace the entry with the same `name`, or append.  The idempotent
+    mutation primitive every webhook injection (volumes, volumeMounts,
+    containers) is built on — mirrors the reference's replace-or-append loops
+    (e.g. notebook_mutating_webhook.go:283-307)."""
+    for i, existing in enumerate(items):
+        if existing.get("name") == item.get("name"):
+            items[i] = item
+            return
+    items.append(item)
+
+
 def merge_env(existing: list[dict], injected: list[dict]) -> list[dict]:
     """Inject env vars, keeping user-provided values for colliding names
     (same precedence rule as the reference's setPrefixEnvVar, which leaves a

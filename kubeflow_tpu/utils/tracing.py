@@ -1,0 +1,129 @@
+"""Minimal OpenTelemetry-style tracing.
+
+The reference traces its mutating webhook with OTel — a lazily-created tracer
+(sync.OnceValue, notebook_mutating_webhook.go:74-76), a root span per
+admission with notebook attributes (:366-373), child spans, and span events
+that the test suite asserts on via an in-memory exporter
+(opentelemetry_test.go:26-78).  We keep the same shape: a process-global
+provider that defaults to noop, swappable for an InMemorySpanExporter in
+tests — tracing as a test observability channel.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class SpanEvent:
+    name: str
+    attributes: dict = field(default_factory=dict)
+    timestamp: float = 0.0
+
+
+@dataclass
+class Span:
+    name: str
+    attributes: dict = field(default_factory=dict)
+    events: list[SpanEvent] = field(default_factory=list)
+    parent: Optional["Span"] = None
+    start_time: float = 0.0
+    end_time: float = 0.0
+    recording: bool = True
+
+    def add_event(self, name: str, attributes: Optional[dict] = None) -> None:
+        if self.recording:
+            self.events.append(SpanEvent(name, dict(attributes or {}), time.time()))
+
+    def set_attribute(self, key: str, value) -> None:
+        if self.recording:
+            self.attributes[key] = value
+
+
+_NOOP_SPAN = Span(name="", recording=False)
+
+
+class InMemorySpanExporter:
+    """Collects finished spans for test assertions
+    (opentelemetry_test.go InMemoryExporter analog)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    @property
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def find(self, name: str) -> list[Span]:
+        return [s for s in self.spans if s.name == name]
+
+    def events(self) -> list[str]:
+        return [e.name for s in self.spans for e in s.events]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+class Tracer:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._local = threading.local()
+
+    def current_span(self) -> Span:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else _NOOP_SPAN
+
+    @contextlib.contextmanager
+    def start_span(
+        self, name: str, attributes: Optional[dict] = None
+    ) -> Iterator[Span]:
+        # the exporter is resolved per-span, matching the reference's lazily
+        # created tracer whose provider is swapped in by tests
+        exporter = _exporter
+        if exporter is None:
+            yield _NOOP_SPAN
+            return
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        span = Span(
+            name=name,
+            attributes=dict(attributes or {}),
+            parent=stack[-1] if stack else None,
+            start_time=time.time(),
+        )
+        stack.append(span)
+        try:
+            yield span
+        finally:
+            stack.pop()
+            span.end_time = time.time()
+            exporter.export(span)
+
+
+_provider_lock = threading.Lock()
+_exporter: Optional[InMemorySpanExporter] = None
+
+
+def set_exporter(exporter: Optional[InMemorySpanExporter]) -> None:
+    """Install the process-wide exporter (tests); None restores noop."""
+    global _exporter
+    with _provider_lock:
+        _exporter = exporter
+
+
+def get_tracer(name: str) -> Tracer:
+    """Tracer whose exporter is resolved at each span start, matching the
+    reference's OnceValue'd tracer that resolves the provider lazily."""
+    return Tracer(name)

@@ -1,0 +1,217 @@
+"""Routing-plane tests: HTTPRoute, ReferenceGrant, NetworkPolicies.
+
+Analog of the reference envtest specs
+(odh notebook_controller_test.go:52-330 HTTPRoute/ReferenceGrant lifecycle,
+:827 NetworkPolicies) against the in-memory control plane.
+"""
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.odh import constants as C
+from kubeflow_tpu.odh.controller import setup_odh_controllers
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
+
+CENTRAL_NS = "opendatahub"
+
+
+@pytest.fixture()
+def env():
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    mgr = Manager(api, clock=FakeClock())
+    cfg = OdhConfig(controller_namespace=CENTRAL_NS)
+    setup_core_controllers(mgr, CoreConfig())
+    setup_odh_controllers(mgr, cfg)
+    return api, cluster, mgr, cfg
+
+
+def create_nb(api, mgr, name="wb", ns="user1", annotations=None, tpu=None):
+    nb = Notebook.new(name, ns, tpu=tpu, annotations=annotations)
+    api.create(nb.obj)
+    mgr.run_until_idle()
+    return nb
+
+
+class TestHTTPRoute:
+    def test_route_created_in_central_namespace(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-wb")
+        assert route.metadata.labels == {
+            "notebook-name": "wb",
+            "notebook-namespace": "user1",
+        }
+        spec = route.spec
+        assert spec["parentRefs"] == [
+            {"name": "data-science-gateway", "namespace": "openshift-ingress"}
+        ]
+        rule = spec["rules"][0]
+        assert rule["matches"][0]["path"]["value"] == "/notebook/user1/wb"
+        assert rule["backendRefs"][0] == {
+            "name": "wb", "namespace": "user1", "port": 8888,
+        }
+
+    def test_route_recreated_after_manual_delete(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        api.delete("HTTPRoute", CENTRAL_NS, "nb-user1-wb")
+        mgr.run_until_idle()
+        assert api.try_get("HTTPRoute", CENTRAL_NS, "nb-user1-wb") is not None
+
+    def test_route_drift_reconciled(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-wb")
+        route.spec["rules"][0]["matches"][0]["path"]["value"] = "/hacked"
+        api.update(route)
+        mgr.run_until_idle()
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-wb")
+        assert route.spec["rules"][0]["matches"][0]["path"]["value"] == "/notebook/user1/wb"
+
+    def test_long_name_uses_generate_name(self, env):
+        api, _, mgr, _ = env
+        long_name = "a" * 60
+        create_nb(api, mgr, name=long_name)
+        routes = api.list(
+            "HTTPRoute", namespace=CENTRAL_NS,
+            label_selector={"notebook-name": long_name},
+        )
+        assert len(routes) == 1
+        assert len(routes[0].name) <= 63 + 6
+        assert routes[0].name.startswith("nb-user1-" [:13])
+
+    def test_route_deleted_with_notebook(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        api.delete("Notebook", "user1", "wb")
+        mgr.run_until_idle()
+        assert api.try_get("Notebook", "user1", "wb") is None
+        assert api.try_get("HTTPRoute", CENTRAL_NS, "nb-user1-wb") is None
+
+    def test_auth_mode_switches_route_backend(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        nb = api.get("Notebook", "user1", "wb")
+        nb.metadata.annotations[C.ANNOTATION_INJECT_AUTH] = "true"
+        api.update(nb)
+        mgr.run_until_idle()
+        routes = api.list(
+            "HTTPRoute", namespace=CENTRAL_NS,
+            label_selector={"notebook-name": "wb"},
+        )
+        assert len(routes) == 1
+        backend = routes[0].spec["rules"][0]["backendRefs"][0]
+        assert backend["name"] == "wb-kube-rbac-proxy"
+        assert backend["port"] == 8443
+        # flip back to non-auth
+        nb = api.get("Notebook", "user1", "wb")
+        del nb.metadata.annotations[C.ANNOTATION_INJECT_AUTH]
+        api.update(nb)
+        mgr.run_until_idle()
+        routes = api.list(
+            "HTTPRoute", namespace=CENTRAL_NS,
+            label_selector={"notebook-name": "wb"},
+        )
+        assert len(routes) == 1
+        backend = routes[0].spec["rules"][0]["backendRefs"][0]
+        assert backend["port"] == 8888
+
+
+class TestReferenceGrant:
+    def test_grant_created_and_shared(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, name="wb1")
+        grant = api.get("ReferenceGrant", "user1", C.REFERENCEGRANT_NAME)
+        assert grant.spec["from"][0]["namespace"] == CENTRAL_NS
+        assert grant.spec["to"][0]["kind"] == "Service"
+        rv = grant.metadata.resource_version
+        create_nb(api, mgr, name="wb2")
+        grant = api.get("ReferenceGrant", "user1", C.REFERENCEGRANT_NAME)
+        assert grant.metadata.resource_version == rv  # untouched, shared
+
+    def test_grant_survives_first_deletion_goes_with_last(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, name="wb1")
+        create_nb(api, mgr, name="wb2")
+        api.delete("Notebook", "user1", "wb1")
+        mgr.run_until_idle()
+        assert api.try_get("ReferenceGrant", "user1", C.REFERENCEGRANT_NAME) is not None
+        api.delete("Notebook", "user1", "wb2")
+        mgr.run_until_idle()
+        assert api.try_get("ReferenceGrant", "user1", C.REFERENCEGRANT_NAME) is None
+
+
+class TestNetworkPolicies:
+    def test_notebook_and_proxy_policies(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        ctrl_np = api.get("NetworkPolicy", "user1", "wb-ctrl-np")
+        ingress = ctrl_np.spec["ingress"][0]
+        assert ingress["ports"] == [{"protocol": "TCP", "port": 8888}]
+        assert ingress["from"][0]["namespaceSelector"]["matchLabels"] == {
+            "kubernetes.io/metadata.name": CENTRAL_NS
+        }
+        proxy_np = api.get("NetworkPolicy", "user1", "wb-kube-rbac-proxy-np")
+        assert proxy_np.spec["ingress"][0]["ports"] == [
+            {"protocol": "TCP", "port": 8443}
+        ]
+        assert "from" not in proxy_np.spec["ingress"][0]
+        # CPU notebook: no TPU worker policy
+        assert api.try_get("NetworkPolicy", "user1", "wb-tpu-workers-np") is None
+
+    def test_tpu_worker_policy(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, tpu=TPUSpec("v5e", "2x4"))
+        np = api.get("NetworkPolicy", "user1", "wb-tpu-workers-np")
+        ingress = np.spec["ingress"][0]
+        assert {"protocol": "TCP", "port": 8471} in ingress["ports"]
+        assert ingress["from"][0]["podSelector"]["matchLabels"] == {
+            "notebook-name": "wb"
+        }
+
+    def test_policies_garbage_collected(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        api.delete("Notebook", "user1", "wb")
+        mgr.run_until_idle()
+        assert api.try_get("NetworkPolicy", "user1", "wb-ctrl-np") is None
+
+
+class TestAuthResources:
+    def test_auth_object_set(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, annotations={C.ANNOTATION_INJECT_AUTH: "true"})
+        assert api.try_get("ServiceAccount", "user1", "wb") is not None
+        svc = api.get("Service", "user1", "wb-kube-rbac-proxy")
+        assert svc.metadata.annotations[C.SERVING_CERT_ANNOTATION] == "wb-kube-rbac-proxy-tls"
+        assert svc.spec["ports"][0]["port"] == 8443
+        cm = api.get("ConfigMap", "user1", "wb-kube-rbac-proxy-config")
+        config = cm.body["data"]["config-file.yaml"]
+        assert "resource: notebooks" in config
+        assert "name: wb" in config
+        crb = api.get("ClusterRoleBinding", "", "wb-rbac-user1-auth-delegator")
+        assert crb.body["roleRef"]["name"] == "system:auth-delegator"
+        assert crb.body["subjects"][0] == {
+            "kind": "ServiceAccount", "name": "wb", "namespace": "user1",
+        }
+
+    def test_crb_cleaned_on_delete(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, annotations={C.ANNOTATION_INJECT_AUTH: "true"})
+        api.delete("Notebook", "user1", "wb")
+        mgr.run_until_idle()
+        assert api.try_get("ClusterRoleBinding", "", "wb-rbac-user1-auth-delegator") is None
+
+    def test_crb_cleaned_when_auth_disabled(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, annotations={C.ANNOTATION_INJECT_AUTH: "true"})
+        nb = api.get("Notebook", "user1", "wb")
+        nb.metadata.annotations[C.ANNOTATION_INJECT_AUTH] = "false"
+        api.update(nb)
+        mgr.run_until_idle()
+        assert api.try_get("ClusterRoleBinding", "", "wb-rbac-user1-auth-delegator") is None
