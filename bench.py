@@ -1,0 +1,77 @@
+"""Benchmark: flagship decoder training MFU on the local TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "train_mfu_v5e", "value": <mfu>, "unit": "fraction",
+   "vs_baseline": <mfu / 0.35>}
+
+The reference publishes no perf numbers (BASELINE.md); the baseline is this
+framework's own headline target — >=35% MFU on the MaxText-style Llama
+workload (BASELINE.json).  Single-chip proxy: the same architecture at
+~0.4B params (weights + Adam state fit one v5e's 16 GiB HBM), bf16 compute,
+remat + scanned layers, Pallas flash attention.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.configs import LLAMA2_350M
+from kubeflow_tpu.models.train import mfu, setup_training, timed_steps
+from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
+
+MFU_TARGET = 0.35  # BASELINE.md headline: MaxText Llama-2-7B on v5e-16
+
+
+def main() -> None:
+    num_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    backend = jax.default_backend()
+    devices = jax.devices()
+    from kubeflow_tpu.tpu.topology import accelerator_from_device_kind
+
+    accel = accelerator_from_device_kind(devices[0].device_kind)
+
+    config = LLAMA2_350M
+    batch, seq = 8, 2048
+    if backend == "cpu":  # CI smoke: tiny shapes, still one honest JSON line
+        from kubeflow_tpu.models.configs import TINY
+
+        config, batch, seq = TINY, 4, 128
+
+    mesh = make_mesh(MeshConfig(data=len(devices)), devices=devices)
+    setup = setup_training(config, mesh, batch_shape=(batch, seq))
+    key = jax.random.PRNGKey(0)
+    data = {
+        "inputs": jax.random.randint(key, (batch, seq), 0, config.vocab_size),
+    }
+    data["targets"] = jnp.roll(data["inputs"], -1, axis=1)
+
+    result = timed_steps(setup, data, num_steps=num_steps, warmup=2)
+    achieved_mfu = mfu(
+        result["tokens_per_s"], config, seq, num_chips=len(devices), accelerator=accel
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_mfu_v5e",
+                "value": round(achieved_mfu, 4),
+                "unit": "fraction",
+                "vs_baseline": round(achieved_mfu / MFU_TARGET, 4),
+                "detail": {
+                    "model": "llama2-350m-proxy" if backend != "cpu" else "tiny-cpu",
+                    "tokens_per_s": round(result["tokens_per_s"], 1),
+                    "step_time_s": round(result["step_time_s"], 4),
+                    "final_loss": round(result["loss"], 4),
+                    "chips": len(devices),
+                    "backend": backend,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

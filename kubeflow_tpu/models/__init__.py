@@ -1,0 +1,12 @@
+"""Model zoo for the BASELINE workload matrix: MNIST MLP, ViT, and the
+Llama/Gemma decoder family with sharded training (models.train)."""
+
+from .configs import GEMMA_7B, LLAMA2_7B, LLAMA2_350M, PRESETS, TINY, TransformerConfig
+from .mlp import MLP
+from .transformer import Transformer
+from .vit import VIT_B16, VIT_TINY, ViT, ViTConfig
+
+__all__ = [
+    "GEMMA_7B", "LLAMA2_7B", "LLAMA2_350M", "MLP", "PRESETS", "TINY",
+    "Transformer", "TransformerConfig", "VIT_B16", "VIT_TINY", "ViT", "ViTConfig",
+]

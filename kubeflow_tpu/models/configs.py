@@ -1,0 +1,111 @@
+"""Model configurations for the BASELINE.md workload matrix.
+
+Presets map to the baseline configs: MNIST MLP (v5e-1), ViT-B/16 (v5e-8),
+Llama-2-7B (v5e-16 MaxText config), Gemma-7B (v5p-128 two-slice pretrain).
+`llama2_350m` is the single-chip bench proxy: same architecture family,
+sized so weights + Adam state fit one v5e chip's 16 GiB HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 32
+    embed_dim: int = 4096
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    mlp_dim: int = 11_008
+    max_seq_len: int = 4096
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"          # activation/compute dtype
+    param_dtype: str = "float32"     # master weights
+    attention_impl: str = "auto"     # auto | flash | xla | ring
+    remat: bool = True               # checkpoint each layer (HBM for FLOPs)
+    scan_layers: bool = True         # lax.scan over layers (compile time)
+    tie_embeddings: bool = False
+    logits_softcap: float = 0.0      # gemma-style tanh softcap; 0 = off
+
+    def with_(self, **kw) -> "TransformerConfig":
+        return replace(self, **kw)
+
+    @property
+    def num_params(self) -> int:
+        """Parameter count (embed + per-layer attn/mlp/norms + final norm
+        [+ untied output head])."""
+        d, l = self.embed_dim, self.num_layers
+        attn = d * self.num_heads * self.head_dim * 2  # q + out
+        attn += d * self.num_kv_heads * self.head_dim * 2  # k + v
+        mlp = 3 * d * self.mlp_dim  # gate, up, down
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        embed = self.vocab_size * d
+        head = 0 if self.tie_embeddings else self.vocab_size * d
+        return embed + l * per_layer + d + head
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training (fwd+bwd) matmul FLOPs per token: 6x matmul params plus
+        the causal attention term 12*L*S*(H*Dh)/2 (QK^T and AV, halved for
+        causality) — the standard MFU accounting (PaLM appendix B).
+
+        The embedding table is a lookup (no matmul) when untied, so it is
+        excluded; when tied it doubles as the logits matmul weight and
+        counts."""
+        matmul_params = self.num_params - (
+            0 if self.tie_embeddings else self.vocab_size * self.embed_dim
+        )
+        attn = 12 * self.num_layers * seq_len * self.num_heads * self.head_dim / 2
+        return 6.0 * matmul_params + attn
+
+
+LLAMA2_7B = TransformerConfig()  # the MaxText v5e-16 headline config
+
+GEMMA_7B = TransformerConfig(
+    vocab_size=256_128,
+    num_layers=28,
+    embed_dim=3072,
+    num_heads=16,
+    num_kv_heads=16,
+    head_dim=256,
+    mlp_dim=24_576,
+    max_seq_len=8192,
+    tie_embeddings=True,
+    logits_softcap=30.0,
+)
+
+# single-chip bench proxy (~0.4B params)
+LLAMA2_350M = TransformerConfig(
+    num_layers=24,
+    embed_dim=1024,
+    num_heads=16,
+    num_kv_heads=16,
+    head_dim=64,
+    mlp_dim=2816,
+    max_seq_len=2048,
+)
+
+# CI/test config: tiny but structurally identical (GQA, scan, remat)
+TINY = TransformerConfig(
+    vocab_size=256,
+    num_layers=2,
+    embed_dim=64,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    mlp_dim=128,
+    max_seq_len=128,
+    dtype="float32",
+    param_dtype="float32",
+)
+
+PRESETS = {
+    "llama2-7b": LLAMA2_7B,
+    "gemma-7b": GEMMA_7B,
+    "llama2-350m": LLAMA2_350M,
+    "tiny": TINY,
+}

@@ -1,0 +1,61 @@
+"""MNIST MLP — the BASELINE "v5e-1 single chip" smoke workload.
+
+Small on purpose: it validates the `google.com/tpu` request path end-to-end
+(`jax.devices()` sees the chip, a jitted step runs) rather than performance.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1)
+        for i, feat in enumerate(self.features):
+            x = nn.Dense(feat, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+def train_mnist_steps(
+    num_steps: int = 20, batch: int = 128, rng: int = 0
+) -> dict:
+    """Self-contained training sanity loop on synthetic MNIST-shaped data;
+    returns first/last loss so callers can assert learning happened."""
+    key = jax.random.PRNGKey(rng)
+    model = MLP()
+    kx, kp = jax.random.split(key)
+    x = jax.random.normal(kx, (batch, 28, 28, 1))
+    y = jax.random.randint(kp, (batch,), 0, 10)
+    params = model.init(kp, x)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    first = None
+    for _ in range(num_steps):
+        params, opt_state, loss = step(params, opt_state)
+        if first is None:
+            first = float(loss)
+    return {"first_loss": first, "last_loss": float(loss)}

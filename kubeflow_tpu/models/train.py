@@ -1,0 +1,174 @@
+"""Sharded training loop: init, train step, MFU accounting.
+
+The in-notebook training harness for the BASELINE workloads: pjit-style
+automatic SPMD — parameters and optimizer state sharded by the logical rules
+in parallel.sharding, activations constrained inside the model — plus the
+MFU math the north-star metric is measured with (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel.sharding import DEFAULT_RULES, logical_sharding
+from ..tpu.topology import ACCELERATORS
+from .configs import TransformerConfig
+from .transformer import Transformer
+
+
+class TrainState(train_state.TrainState):
+    pass
+
+
+@dataclass
+class TrainSetup:
+    """Everything a notebook needs to run sharded steps."""
+
+    mesh: Mesh
+    model: nn.Module
+    state: TrainState
+    state_shardings: Any
+    train_step: Callable[[TrainState, dict], tuple[TrainState, dict]]
+    config: TransformerConfig
+
+
+def default_optimizer(
+    learning_rate: float = 3e-4,
+    warmup_steps: int = 100,
+    total_steps: int = 10_000,
+    weight_decay: float = 0.1,
+    max_grad_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token NLL in fp32.  Targets are inputs shifted by the
+    caller; full [B, S] weight (no padding in the bench path)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES):
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        def loss_fn(params):
+            logits = model.apply({"params": params}, batch["inputs"])
+            return cross_entropy_loss(logits, batch["targets"])
+
+        with nn.logical_axis_rules(list(rules)):
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step,
+        }
+        return new_state, metrics
+
+    return step
+
+
+def setup_training(
+    config: TransformerConfig,
+    mesh: Mesh,
+    rng: Optional[jax.Array] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    rules=DEFAULT_RULES,
+    batch_shape: Optional[tuple[int, int]] = None,
+) -> TrainSetup:
+    """Initialize a sharded TrainState on `mesh` and return a jitted train
+    step with explicit in/out shardings (single compiled SPMD program; XLA
+    inserts the psums/all-gathers the rules imply)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = Transformer(config, mesh)
+    batch_shape = batch_shape or (max(len(mesh.devices.flat), 1), 256)
+    sample = jnp.zeros(batch_shape, jnp.int32)
+    optimizer = optimizer or default_optimizer()
+
+    def init_fn(rng):
+        params = model.init(rng, sample)["params"]
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=optimizer
+        )
+
+    with mesh, nn.logical_axis_rules(list(rules)):
+        abstract = jax.eval_shape(init_fn, rng)
+        # logical names recorded by nn.with_logical_partitioning -> physical
+        logical_specs = nn.get_partition_spec(abstract)
+        state_shardings = nn.logical_to_mesh_sharding(
+            logical_specs, mesh, list(rules)
+        )
+        state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
+
+        batch_sharding = logical_sharding(mesh, ("batch", None), rules)
+        step = jax.jit(
+            make_train_step(model, optimizer, rules),
+            in_shardings=(state_shardings, {"inputs": batch_sharding,
+                                            "targets": batch_sharding}),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,),
+        )
+    return TrainSetup(mesh, model, state, state_shardings, step, config)
+
+
+# -- MFU accounting -------------------------------------------------------------
+
+
+def model_flops_per_step(config: TransformerConfig, batch: int, seq: int) -> float:
+    return config.flops_per_token(seq) * batch * seq
+
+
+def mfu(
+    tokens_per_second: float,
+    config: TransformerConfig,
+    seq_len: int,
+    num_chips: int,
+    accelerator: str = "v5e",
+) -> float:
+    """Achieved fraction of the slice's bf16 peak."""
+    peak = ACCELERATORS[accelerator].bf16_peak_tflops * 1e12 * num_chips
+    return tokens_per_second * config.flops_per_token(seq_len) / peak
+
+
+def timed_steps(
+    setup: TrainSetup,
+    batch: dict,
+    num_steps: int = 10,
+    warmup: int = 2,
+) -> dict:
+    """Run steps synchronously and report wall-clock throughput + MFU inputs."""
+    state = setup.state
+    for _ in range(warmup):
+        state, metrics = setup.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(num_steps):
+        state, metrics = setup.train_step(state, batch)
+    loss = float(jax.block_until_ready(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    setup.state = state
+    b, s = batch["inputs"].shape
+    step_time = dt / num_steps
+    return {
+        "loss": loss,
+        "step_time_s": step_time,
+        "tokens_per_s": b * s / step_time,
+        "flops_per_step": model_flops_per_step(setup.config, b, s),
+    }

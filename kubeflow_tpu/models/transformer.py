@@ -1,0 +1,224 @@
+"""Llama/Gemma-family decoder, TPU-first.
+
+Design choices (vs a torch translation):
+  - flax.linen with *logical* axis metadata on every parameter
+    (nn.with_logical_partitioning); physical placement comes from
+    parallel.sharding rules at jit boundary — one table controls
+    dp/fsdp/tp/sp.
+  - layers run under `nn.scan` (one compiled layer body, rolled over a
+    leading "layers" param axis) + per-layer `nn.remat` — compile time and
+    HBM both scale to 7B+ on a notebook chip.
+  - attention dispatches to the Pallas flash kernel on TPU, ring attention
+    when the mesh has a populated "sequence" axis (long context), and the
+    einsum reference elsewhere (ops/attention.py, ops/ring_attention.py).
+  - bf16 activations, fp32 master weights and norm/softmax accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import attention
+from ..ops.ring_attention import ring_attention
+from .configs import TransformerConfig
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(self.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding on [B, S, H, D]; fp32 trig, split-half convention."""
+    half = x.shape[-1] // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angle = positions[..., None].astype(jnp.float32) * freq  # [B, S, half]
+    cos = jnp.cos(angle)[:, :, None, :]
+    sin = jnp.sin(angle)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _dense(
+    features,
+    axes,
+    name=None,
+    dtype=jnp.bfloat16,
+    param_dtype=jnp.float32,
+    contract_axes=(-1,),
+):
+    return nn.DenseGeneral(
+        features,
+        axis=contract_axes,
+        use_bias=False,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), axes
+        ),
+        name=name,
+    )
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dtype = _dtype(cfg.dtype)
+        q = _dense(
+            (cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q",
+            dtype, _dtype(cfg.param_dtype),
+        )(x)
+        k = _dense(
+            (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"), "k",
+            dtype, _dtype(cfg.param_dtype),
+        )(x)
+        v = _dense(
+            (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"), "v",
+            dtype, _dtype(cfg.param_dtype),
+        )(x)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        use_ring = (
+            cfg.attention_impl == "ring"
+            or (
+                cfg.attention_impl == "auto"
+                and self.mesh is not None
+                and "sequence" in self.mesh.shape
+                and self.mesh.shape["sequence"] > 1
+            )
+        )
+        if use_ring:
+            if self.mesh is None:
+                raise ValueError("ring attention requires a mesh")
+            out = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            impl = cfg.attention_impl if cfg.attention_impl != "ring" else "auto"
+            out = attention(q, k, v, causal=True, impl=impl)
+        out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "kv"))
+        return _dense(
+            cfg.embed_dim, ("heads", "kv", "embed"), "out",
+            dtype, _dtype(cfg.param_dtype), contract_axes=(-2, -1),
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        gate = _dense(cfg.mlp_dim, ("embed", "mlp"), "gate", dtype, pdtype)(x)
+        up = _dense(cfg.mlp_dim, ("embed", "mlp"), "up", dtype, pdtype)(x)
+        hidden = nn.silu(gate) * up
+        hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "mlp"))
+        return _dense(cfg.embed_dim, ("mlp", "embed"), "down", dtype, pdtype)(hidden)
+
+
+class DecoderLayer(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dtype = _dtype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        h = RMSNorm(cfg.norm_eps, dtype, name="attn_norm")(x)
+        x = x + Attention(cfg, self.mesh, name="attn")(h, positions)
+        h = RMSNorm(cfg.norm_eps, dtype, name="mlp_norm")(x)
+        x = x + MLP(cfg, name="mlp")(h)
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, V]."""
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.embed_dim,
+            dtype=dtype,
+            param_dtype=pdtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(1.0), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(tokens)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                DecoderLayer,
+                prevent_cse=not cfg.scan_layers,
+                static_argnums=(),
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(layer_cls(cfg, self.mesh, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, self.mesh, name=f"layer_{i}")(x, positions)
+
+        x = RMSNorm(cfg.norm_eps, dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(pdtype))
+        else:
+            logits = _dense(
+                cfg.vocab_size, ("embed", "vocab"), "lm_head", dtype, pdtype
+            )(x)
+        if cfg.logits_softcap > 0.0:
+            cap = cfg.logits_softcap
+            logits = jnp.tanh(logits.astype(jnp.float32) / cap) * cap
+        return nn.with_logical_constraint(
+            logits.astype(jnp.float32), ("batch", "seq", "vocab")
+        )

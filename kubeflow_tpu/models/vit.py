@@ -1,0 +1,97 @@
+"""ViT — the BASELINE "v5e-8 single host" fine-tune workload.
+
+Encoder-only transformer over patch embeddings with the same logical-axis
+sharding vocabulary as the decoder (parallel.sharding): dp/fsdp shard the
+batch and parameters, tensor parallelism shards heads/MLP.  Attention is
+bidirectional (causal=False) through the same ops.attention dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from .transformer import RMSNorm, _dense
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: str = "bfloat16"
+
+
+VIT_B16 = ViTConfig()
+VIT_TINY = ViTConfig(
+    image_size=32, patch_size=8, num_classes=10, num_layers=2,
+    embed_dim=64, num_heads=4, mlp_dim=128, dtype="float32",
+)
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        head_dim = cfg.embed_dim // cfg.num_heads
+        h = RMSNorm(dtype=dtype, name="attn_norm")(x)
+        q = _dense((cfg.num_heads, head_dim), ("embed", "heads", "kv"), "q", dtype)(h)
+        k = _dense((cfg.num_heads, head_dim), ("embed", "heads", "kv"), "k", dtype)(h)
+        v = _dense((cfg.num_heads, head_dim), ("embed", "heads", "kv"), "v", dtype)(h)
+        out = attention(q, k, v, causal=False)
+        x = x + _dense(
+            cfg.embed_dim, ("heads", "kv", "embed"), "out", dtype,
+            contract_axes=(-2, -1),
+        )(out)
+        h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
+        h = _dense(cfg.mlp_dim, ("embed", "mlp"), "up", dtype)(h)
+        h = nn.gelu(h)
+        return x + _dense(cfg.embed_dim, ("mlp", "embed"), "down", dtype)(h)
+
+
+class ViT(nn.Module):
+    """images [B, H, W, C] -> logits [B, num_classes]."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        x = nn.Conv(
+            cfg.embed_dim,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=dtype,
+            name="patch_embed",
+        )(images)
+        x = x.reshape(x.shape[0], -1, cfg.embed_dim)  # [B, tokens, D]
+        num_tokens = x.shape[1]
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "seq", "embed")
+            ),
+            (1, num_tokens, cfg.embed_dim),
+            jnp.float32,
+        )
+        x = (x + pos.astype(dtype)).astype(dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        for i in range(cfg.num_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x)
+        x = RMSNorm(dtype=dtype, name="final_norm")(x)
+        x = jnp.mean(x, axis=1)  # global average pool
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, name="head"
+        )(x.astype(jnp.float32))

@@ -1,0 +1,7 @@
+"""TPU compute ops: flash/XLA attention and ring attention
+(sequence-parallel exact attention over the ICI ring)."""
+
+from .attention import attention, flash_attention, xla_attention
+from .ring_attention import ring_attention
+
+__all__ = ["attention", "flash_attention", "ring_attention", "xla_attention"]

@@ -1,0 +1,113 @@
+"""Attention ops: causal multi-head/GQA attention for TPU.
+
+Three execution paths, chosen by `attention()`:
+  - "flash": the Pallas TPU flash-attention kernel (jax.experimental.pallas
+    .ops.tpu) — VMEM-blocked online softmax, the MXU-friendly hot path.
+  - "xla": plain einsum attention. XLA fuses the softmax chain well on TPU;
+    also the numerics reference for tests and the CPU fallback.
+  - ring attention lives in ops.ring_attention (sequence-parallel shard_map).
+
+Shapes follow the [batch, seq, heads, head_dim] convention throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask_bias(q_len: int, kv_len: int, q_offset: int = 0, dtype=jnp.float32):
+    """Additive -inf bias above the causal diagonal.  q_offset shifts query
+    positions for ring/blockwise variants where the local q block starts at a
+    global position > 0."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return jnp.where(q_pos >= kv_pos, 0.0, -jnp.inf).astype(dtype)
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """GQA: tile kv heads up to the query head count."""
+    num_kv = k.shape[2]
+    if num_kv == num_q_heads:
+        return k
+    return jnp.repeat(k, num_q_heads // num_kv, axis=2)
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_offset: int = 0,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference einsum attention in fp32 accumulation."""
+    *_, head_dim = q.shape
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        scores = scores + causal_mask_bias(q.shape[1], k.shape[1], q_offset)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.cache
+def _pallas_flash():
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as kernel,
+    )
+
+    return kernel
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Pallas TPU flash attention (expects [b, h, s, d]; we carry
+    [b, s, h, d] and transpose at the boundary — XLA folds the transposes
+    into the surrounding copies)."""
+    *_, head_dim = q.shape
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+    out = _pallas_flash()(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=causal,
+        sm_scale=scale,
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    impl: str = "auto",
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Dispatch: flash on TPU when the shape fits the kernel's tiling
+    (seq multiple of the 128-lane block, head_dim >= 128-friendly), else XLA.
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        seq_ok = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+        impl = "flash" if (on_tpu and seq_ok) else "xla"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

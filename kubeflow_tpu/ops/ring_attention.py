@@ -1,0 +1,117 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context path (SURVEY.md §5 "Long-context/sequence parallelism"): the
+sequence dimension is sharded across the mesh's "sequence" axis; each device
+holds a [B, S/n, H, D] block of q/k/v.  K/V blocks rotate around the ICI
+ring with `lax.ppermute` while each device folds every visiting block into a
+numerically-stable online softmax (flash-attention style m/l accumulators) —
+full attention without ever materializing [S, S] or gathering K/V.
+
+Compute/communication overlap is XLA's job: the ppermute for step i+1 is
+independent of step i's einsum, and latency hiding on TPU comes from the
+async collective scheduler.  Causality is enforced per-block with global
+position offsets; fully-masked blocks still traverse the ring (uniform
+control flow keeps the collective schedule identical on every shard).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import _repeat_kv
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool,
+    softmax_scale: Optional[float],
+) -> jax.Array:
+    """Per-shard body (runs under shard_map).  q/k/v: [B, S_blk, H, D]."""
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    batch, q_len, num_heads, head_dim = q.shape
+    kv_len = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    k = _repeat_kv(k, num_heads)
+    v = _repeat_kv(v, num_heads)
+
+    out = jnp.zeros((batch, num_heads, q_len, head_dim), jnp.float32)
+    row_max = jnp.full((batch, num_heads, q_len), -jnp.inf, jnp.float32)
+    row_sum = jnp.zeros((batch, num_heads, q_len), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        out, row_max, row_sum, k_blk, v_blk = carry
+        # after i rotations we hold the block originally on shard my_idx - i
+        src = (my_idx - i) % n
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            q_pos = my_idx * q_len + jnp.arange(q_len)
+            kv_pos = src * kv_len + jnp.arange(kv_len)
+            bias = jnp.where(
+                q_pos[:, None] >= kv_pos[None, :], 0.0, -jnp.inf
+            ).astype(jnp.float32)
+            scores = scores + bias
+        blk_max = jnp.max(scores, axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        # fully-masked rows keep -inf max; exp(-inf - -inf) guards below
+        correction = jnp.exp(row_max - new_max)
+        correction = jnp.where(jnp.isfinite(row_max), correction, 0.0)
+        probs = jnp.exp(scores - new_max[..., None])
+        probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
+        out = out * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd",
+            probs,
+            v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+        row_max = new_max
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return out, row_max, row_sum, k_blk, v_blk
+
+    out, row_max, row_sum, _, _ = jax.lax.fori_loop(
+        0, n, step, (out, row_max, row_sum, k, v)
+    )
+    out = out / jnp.maximum(row_sum, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+) -> jax.Array:
+    """Sequence-parallel exact attention.  Inputs [B, S, H, D] with S
+    sharded over `axis_name`; composes with batch sharding over
+    `batch_axes` and head (tensor) sharding over `head_axis`."""
+    spec = P(batch_axes, axis_name, head_axis, None)
+    local = jax.shard_map(
+        lambda q_, k_, v_: _ring_attention_local(
+            q_, k_, v_, axis_name, causal, softmax_scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return local(q, k, v)

@@ -1,0 +1,10 @@
+"""Parallelism: device-mesh construction (ICI/DCN-aware) and logical-axis
+sharding rules binding models to the mesh."""
+
+from .mesh import MESH_AXES, MeshConfig, make_mesh, mesh_for_slice
+from .sharding import DEFAULT_RULES, constrain, logical_sharding, logical_to_spec
+
+__all__ = [
+    "DEFAULT_RULES", "MESH_AXES", "MeshConfig", "constrain",
+    "logical_sharding", "logical_to_spec", "make_mesh", "mesh_for_slice",
+]

@@ -1,0 +1,125 @@
+"""Device-mesh construction for notebook training workloads.
+
+The TPU-native replacement for the reference's absent distributed backend
+(SURVEY.md §2.5): within a slice, parallelism axes ride ICI; across slices
+(spec.tpu.slices > 1) the leading data-parallel axis rides DCN, exactly the
+layout `jax.experimental.mesh_utils.create_hybrid_device_mesh` produces and
+MEGASCALE_* coordination expects.
+
+Axis convention (MaxText-style, outermost first):
+  data     — batch data parallelism (DCN across slices, ICI within)
+  fsdp     — parameter/optimizer sharding (ZeRO-3 style)
+  sequence — sequence/context parallelism (ring attention)
+  tensor   — tensor (Megatron) parallelism for MLP/attention heads
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+MESH_AXES = ("data", "fsdp", "sequence", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Parallelism degrees; -1 in `data` means "absorb remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    num_slices: int = 1  # >1 => hybrid mesh, data axis spans DCN
+
+    def resolved(self, num_devices: int) -> "MeshConfig":
+        fixed = self.fsdp * self.sequence * self.tensor
+        data = self.data
+        if data == -1:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by "
+                    f"fsdp*sequence*tensor={fixed}"
+                )
+            data = num_devices // fixed
+        if data * fixed != num_devices:
+            raise ValueError(
+                f"mesh {data}x{self.fsdp}x{self.sequence}x{self.tensor} != "
+                f"{num_devices} devices"
+            )
+        return MeshConfig(data, self.fsdp, self.sequence, self.tensor, self.num_slices)
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.sequence, self.tensor)
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the training Mesh.
+
+    Single-slice: `create_device_mesh` arranges devices so neighboring mesh
+    coordinates are ICI neighbors (ring-friendly for psum/ppermute).
+    Multi-slice: `create_hybrid_device_mesh` puts the data axis across
+    slices (DCN) and everything else within a slice (ICI) — the layout the
+    controller's MEGASCALE env injection (tpu/env.py) coordinates.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = (config or MeshConfig()).resolved(len(devices))
+    if config.num_slices > 1:
+        if config.data % config.num_slices != 0:
+            raise ValueError(
+                f"data={config.data} not divisible by num_slices={config.num_slices}"
+            )
+        per_slice = (
+            config.data // config.num_slices,
+            config.fsdp,
+            config.sequence,
+            config.tensor,
+        )
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice,
+            dcn_mesh_shape=(config.num_slices, 1, 1, 1),
+            devices=devices,
+        )
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                config.shape, devices=devices
+            )
+        except Exception:
+            # virtual CPU devices have no topology info; plain reshape
+            device_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+def mesh_for_slice(
+    num_devices: int,
+    num_slices: int = 1,
+    tensor: int = 1,
+    sequence: int = 1,
+    fsdp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Convenience: fill fsdp with whatever data parallelism doesn't take.
+    Default policy (fsdp=None): all non-tensor/sequence devices go to fsdp
+    within a slice and data across slices — the standard recipe for
+    memory-bound fine-tuning in a notebook."""
+    per_slice = num_devices // num_slices
+    if fsdp is None:
+        fsdp = per_slice // (tensor * sequence)
+    cfg = MeshConfig(
+        data=-1, fsdp=fsdp, sequence=sequence, tensor=tensor, num_slices=num_slices
+    )
+    return make_mesh(cfg, devices=devices)
+
+
+def num_devices_of(mesh: Mesh) -> int:
+    return math.prod(mesh.devices.shape)

@@ -1,0 +1,78 @@
+"""Logical-axis sharding rules.
+
+Models annotate arrays with *logical* axis names ("batch", "embed", "mlp",
+...); these rules bind logical names to the physical mesh axes from
+parallel.mesh.  Sharding thereby lives in one table instead of being wired
+through every layer — the idiomatic jax/flax pattern (equivalent to MaxText's
+logical_axis_rules), and the in-notebook counterpart of the controller's
+topology plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# logical name -> mesh axis (or tuple of axes); None = replicated
+DEFAULT_RULES: tuple[tuple[str, object], ...] = (
+    ("layers", None),                # nn.scan's stacked-layer axis
+    ("batch", ("data", "fsdp")),     # activation batch over all DP-ish axes
+    ("seq", "sequence"),             # activation sequence (context parallel)
+    ("embed", "fsdp"),               # parameter embed dim (ZeRO-3)
+    ("heads", "tensor"),             # attention heads (Megatron)
+    ("kv", None),                    # per-head dim stays local
+    ("mlp", "tensor"),               # MLP hidden (Megatron)
+    ("vocab", "tensor"),             # embedding/logits vocab dim
+    ("norm", None),
+)
+
+
+def rules_dict(
+    rules: Optional[Sequence[tuple[str, object]]] = None,
+) -> dict[str, object]:
+    return dict(rules if rules is not None else DEFAULT_RULES)
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Sequence[tuple[str, object]]] = None,
+) -> PartitionSpec:
+    """("batch", "seq", "embed") -> PartitionSpec(("data","fsdp"), "sequence",
+    "fsdp")."""
+    table = rules_dict(rules)
+    return PartitionSpec(
+        *(table.get(axis) if axis is not None else None for axis in logical_axes)
+    )
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Sequence[tuple[str, object]]] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def constrain(
+    x: jax.Array,
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Sequence[tuple[str, object]]] = None,
+) -> jax.Array:
+    """with_sharding_constraint by logical names — the hint that keeps XLA
+    from resharding activations mid-layer."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules)
+    )
+
+
+def tree_shardings(mesh: Mesh, logical_tree, rules=None):
+    """Map a pytree of logical-axis tuples to NamedShardings (for jit
+    in_shardings/out_shardings of whole parameter trees)."""
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda v: isinstance(v, tuple),
+    )

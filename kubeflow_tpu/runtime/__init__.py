@@ -1,0 +1,19 @@
+"""In-notebook runtime: distributed bootstrap, checkpoint/cull hooks,
+performance metrics.  Ships inside the TPU workbench image; everything the
+controller plane arranges (env injection, headless DNS, cull signals) is
+consumed here."""
+
+from .checkpoint import CheckpointManager, CullSignalWatcher, checkpoint_on_cull
+from .init import WorkerIdentity, parse_worker_env, tpu_init
+from .metrics import StepTimer, hbm_usage_bytes
+
+__all__ = [
+    "CheckpointManager",
+    "CullSignalWatcher",
+    "StepTimer",
+    "WorkerIdentity",
+    "checkpoint_on_cull",
+    "hbm_usage_bytes",
+    "parse_worker_env",
+    "tpu_init",
+]

@@ -1,0 +1,108 @@
+"""Checkpoint/restore hooks with cull-signal integration.
+
+The reference has no in-process checkpointing — all state is CR annotations
+(SURVEY.md §5 "Checkpoint/resume").  A TPU notebook does real training, so
+the runtime pairs Orbax with the culling controller's checkpoint-before-cull
+protocol (core/constants.py ANNOTATION_CHECKPOINT_REQUESTED/_COMPLETE):
+
+  controller sets  checkpoint-requested  ->  (downward-API file appears)
+  runtime saves + acks via the signal file ->  controller proceeds to cull
+
+The signal transport is a file because annotations are projected into pods
+via the downward API; tests drive the same path with a tmp file.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+DEFAULT_SIGNAL_DIR = "/etc/podinfo"
+REQUEST_FILE = "checkpoint-requested"
+ACK_FILE = "checkpoint-complete"
+
+
+class CheckpointManager:
+    """Thin Orbax wrapper: sharded async-capable save/restore keyed by step.
+
+    Multi-host safe: orbax coordinates the distributed write itself; every
+    process must call save/restore collectively.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = Path(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            return None
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(state_like)
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+class CullSignalWatcher:
+    """Watches for the controller's checkpoint-before-cull request.
+
+    `check()` is cheap enough for a per-step call; `acknowledge()` writes the
+    completion marker the culling controller's checkpoint gate polls for
+    (core/culling_controller.py)."""
+
+    def __init__(self, signal_dir: str = DEFAULT_SIGNAL_DIR):
+        self.signal_dir = Path(signal_dir)
+
+    def check(self) -> bool:
+        req = self.signal_dir / REQUEST_FILE
+        try:
+            return req.exists() and req.read_text().strip() not in ("", "false")
+        except OSError:
+            return False
+
+    def acknowledge(self) -> None:
+        self.signal_dir.mkdir(parents=True, exist_ok=True)
+        (self.signal_dir / ACK_FILE).write_text(str(time.time()))
+
+
+def checkpoint_on_cull(
+    manager: CheckpointManager,
+    watcher: Optional[CullSignalWatcher] = None,
+) -> Callable[[int, Any], bool]:
+    """Returns a per-step hook: `hook(step, state)` saves synchronously and
+    acknowledges when a cull is pending; returns True when it fired so the
+    training loop can drain/exit cleanly."""
+    watcher = watcher or CullSignalWatcher()
+    fired = threading.Event()
+
+    def hook(step: int, state: Any) -> bool:
+        if fired.is_set() or not watcher.check():
+            return False
+        manager.save(step, state, wait=True)
+        watcher.acknowledge()
+        fired.set()
+        return True
+
+    return hook

@@ -1,0 +1,86 @@
+"""In-notebook distributed bootstrap: `tpu_init()`.
+
+Consumes exactly the env the controller injects into every worker
+(tpu/env.py: TPU_WORKER_ID from the pod-index downward API,
+TPU_WORKER_HOSTNAMES ordered by ordinal, JAX_COORDINATOR_ADDRESS pinned to
+slice-0 worker-0, MEGASCALE_* for multi-slice) and calls
+`jax.distributed.initialize()` so `jax.devices()` shows the whole slice —
+the contract SURVEY.md §7 calls out as failing only at init time when wrong.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+
+@dataclass(frozen=True)
+class WorkerIdentity:
+    """Parsed coordination env of this worker."""
+
+    worker_id: int
+    hosts_per_slice: int
+    slice_id: int
+    num_slices: int
+    coordinator_address: str
+    hostnames: tuple[str, ...]
+
+    @property
+    def process_id(self) -> int:
+        # global process ids are slice-major, matching the hostname ordering
+        # the controller generates (tpu/env.py worker_hostnames)
+        return self.slice_id * self.hosts_per_slice + self.worker_id
+
+    @property
+    def num_processes(self) -> int:
+        return self.hosts_per_slice * self.num_slices
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+
+def parse_worker_env(env: Optional[Mapping[str, str]] = None) -> WorkerIdentity:
+    env = env if env is not None else os.environ
+    hostnames = tuple(
+        h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    )
+    hosts_per_slice = int(env.get("TPU_HOSTS_PER_SLICE", len(hostnames) or 1))
+    worker_id = int(env.get("TPU_WORKER_ID", 0) or 0)
+    return WorkerIdentity(
+        worker_id=worker_id,
+        hosts_per_slice=hosts_per_slice,
+        slice_id=int(env.get("MEGASCALE_SLICE_ID", 0) or 0),
+        num_slices=int(env.get("MEGASCALE_NUM_SLICES", 1) or 1),
+        coordinator_address=env.get(
+            "JAX_COORDINATOR_ADDRESS", env.get("COORDINATOR_ADDRESS", "")
+        ),
+        hostnames=hostnames,
+    )
+
+
+def tpu_init(env: Optional[Mapping[str, str]] = None) -> WorkerIdentity:
+    """Initialize the JAX distributed runtime from the injected env.
+
+    Single-host (or CPU-notebook) pods are a no-op beyond parsing; multi-host
+    slices block in `jax.distributed.initialize` until all workers arrive —
+    the gang-startup rendezvous the headless Service's
+    publishNotReadyAddresses makes resolvable (core/workload.py).
+    """
+    identity = parse_worker_env(env)
+    if identity.is_multihost and identity.coordinator_address:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=identity.coordinator_address,
+            num_processes=identity.num_processes,
+            process_id=identity.process_id,
+        )
+    return identity
+
+
+def local_chip_count() -> int:
+    import jax
+
+    return jax.local_device_count()

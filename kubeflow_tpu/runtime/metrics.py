@@ -1,0 +1,87 @@
+"""In-notebook performance metrics: MFU, throughput, HBM.
+
+The north-star metrics from BASELINE.md are measured here (the control-plane
+Prometheus metrics live in core/metrics.py; this is the data-plane side,
+exported in Prometheus text format so the same scrape infra picks both up).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+from ..models.configs import TransformerConfig
+from ..tpu.topology import ACCELERATORS
+
+
+def hbm_usage_bytes() -> dict[str, int]:
+    """Per-device HBM in use (0s on backends without memory_stats)."""
+    usage = {}
+    for dev in jax.local_devices():
+        stats = getattr(dev, "memory_stats", lambda: None)() or {}
+        usage[str(dev)] = int(stats.get("bytes_in_use", 0))
+    return usage
+
+
+@dataclass
+class StepTimer:
+    """Rolling train-step telemetry; call `observe()` once per synced step."""
+
+    config: TransformerConfig
+    batch: int
+    seq_len: int
+    num_chips: int
+    accelerator: str = "v5e"
+    window: int = 20
+    _times: list[float] = field(default_factory=list)
+    _last: Optional[float] = None
+
+    def observe(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+            if len(self._times) > self.window:
+                self._times.pop(0)
+        self._last = now
+
+    @property
+    def step_time_s(self) -> float:
+        return sum(self._times) / len(self._times) if self._times else 0.0
+
+    @property
+    def tokens_per_s(self) -> float:
+        st = self.step_time_s
+        return self.batch * self.seq_len / st if st else 0.0
+
+    @property
+    def mfu(self) -> float:
+        from ..models.train import mfu as mfu_fn
+
+        return mfu_fn(
+            self.tokens_per_s,
+            self.config,
+            self.seq_len,
+            self.num_chips,
+            self.accelerator,
+        )
+
+    def report(self) -> dict:
+        return {
+            "step_time_s": self.step_time_s,
+            "tokens_per_s": self.tokens_per_s,
+            "mfu": self.mfu,
+            "hbm_bytes_in_use": sum(hbm_usage_bytes().values()),
+        }
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition the workbench image can serve on /metrics."""
+        r = self.report()
+        lines = []
+        for key, value in r.items():
+            name = f"notebook_training_{key}"
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"

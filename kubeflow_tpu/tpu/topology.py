@@ -75,6 +75,22 @@ def parse_topology(topology: str, dims: int) -> tuple[int, ...]:
     return vals
 
 
+def accelerator_from_device_kind(device_kind: str) -> str:
+    """Map a PJRT device_kind string ("TPU v5 lite", "TPU v5p", ...) to the
+    user-facing generation key, defaulting to v5e for unknown kinds so MFU
+    denominators stay conservative on this image's tunneled chip."""
+    kind = device_kind.lower()
+    if "v6" in kind:
+        return "v6e"
+    if "v5" in kind and ("lite" in kind or "v5e" in kind):
+        return "v5e"
+    if "v5" in kind:
+        return "v5p"
+    if "v4" in kind:
+        return "v4"
+    return "v5e"
+
+
 def resolve(accelerator: str, topology: str) -> SliceShape:
     """Resolve {accelerator, topology} to chips/hosts/chips-per-host."""
     acc = ACCELERATORS.get(accelerator)

@@ -9,9 +9,16 @@ exercised without hardware.  Must run before the first `import jax`.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# this image's site hook re-registers the hardware PJRT plugin and overrides
+# jax_platforms after env processing; pin the config explicitly so tests
+# always see the 8-device virtual CPU mesh
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,93 @@
+"""Runtime tests: worker-env bootstrap contract, checkpoint/cull hooks,
+step metrics — the consumer side of the controller's env injection
+(tpu/env.py must round-trip through runtime/init.py)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.runtime.checkpoint import (
+    ACK_FILE,
+    REQUEST_FILE,
+    CheckpointManager,
+    CullSignalWatcher,
+    checkpoint_on_cull,
+)
+from kubeflow_tpu.runtime.init import parse_worker_env, tpu_init
+from kubeflow_tpu.runtime.metrics import StepTimer, hbm_usage_bytes
+from kubeflow_tpu.models.configs import TINY
+from kubeflow_tpu.tpu import env as tpuenv
+from kubeflow_tpu.tpu.topology import resolve
+
+
+class TestWorkerEnvContract:
+    def test_roundtrip_with_controller_injection(self):
+        """The env the controller renders (tpu/env.py) must parse into the
+        identity jax.distributed.initialize needs — index i of
+        TPU_WORKER_HOSTNAMES == process_id i (SURVEY.md §7 hard parts)."""
+        shape = resolve("v5e", "4x4")  # 16 chips, 4 hosts
+        rendered = tpuenv.tpu_env_vars("nb", shape, slice_id=1, num_slices=2)
+        env = {e["name"]: e.get("value", "") for e in rendered if "value" in e}
+        env["TPU_WORKER_ID"] = "2"  # downward API would set this per pod
+        identity = parse_worker_env(env)
+        assert identity.hosts_per_slice == 4
+        assert identity.num_slices == 2
+        assert identity.slice_id == 1
+        assert identity.process_id == 1 * 4 + 2
+        assert identity.num_processes == 8
+        assert identity.coordinator_address == (
+            "nb-slice-0-0.nb-workers:8471"
+        )
+        # hostname list ordering == ordinal ordering
+        assert identity.hostnames[2].startswith("nb-slice-1-2.")
+
+    def test_single_host_is_noop(self):
+        identity = tpu_init({"TPU_WORKER_HOSTNAMES": "only-one"})
+        assert not identity.is_multihost
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        state = {"w": jnp.arange(8.0), "step": jnp.asarray(3)}
+        mgr.save(3, state, wait=True)
+        like = jax.tree.map(jnp.zeros_like, state)
+        restored = mgr.restore(like)
+        assert float(restored["w"][5]) == 5.0
+        assert mgr.latest_step() == 3
+        mgr.close()
+
+    def test_restore_without_checkpoint_returns_none(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        assert mgr.restore({"w": jnp.zeros(2)}) is None
+        mgr.close()
+
+    def test_cull_signal_hook(self, tmp_path):
+        signal_dir = tmp_path / "podinfo"
+        signal_dir.mkdir()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        watcher = CullSignalWatcher(str(signal_dir))
+        hook = checkpoint_on_cull(mgr, watcher)
+        state = {"w": jnp.ones(4)}
+        assert hook(1, state) is False  # no signal yet
+        (signal_dir / REQUEST_FILE).write_text("true")
+        assert hook(2, state) is True
+        assert (signal_dir / ACK_FILE).exists()
+        assert mgr.latest_step() == 2
+        assert hook(3, state) is False  # fires once
+        mgr.close()
+
+
+class TestStepMetrics:
+    def test_mfu_math(self):
+        timer = StepTimer(TINY, batch=4, seq_len=128, num_chips=1)
+        timer._times = [0.1, 0.1]
+        assert timer.tokens_per_s == pytest.approx(4 * 128 / 0.1)
+        assert 0.0 < timer.mfu < 1e-3  # tiny model, far from peak
+        text = timer.prometheus_text()
+        assert "notebook_training_mfu" in text
+        assert "notebook_training_tokens_per_s" in text
+
+    def test_hbm_usage_shape(self):
+        usage = hbm_usage_bytes()
+        assert len(usage) == jax.local_device_count()
