@@ -1,0 +1,5 @@
+"""Deployment manifests as code (the reference's kustomize plane)."""
+
+from .manifests import PROFILES, render_profile, render_yaml, validate_docs
+
+__all__ = ["PROFILES", "render_profile", "render_yaml", "validate_docs"]

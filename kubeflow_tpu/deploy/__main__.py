@@ -1,0 +1,11 @@
+"""`python -m kubeflow_tpu.deploy [profile]` -> multi-doc YAML on stdout
+(the `kustomize build config/overlays/{profile}` analog)."""
+
+import sys
+
+from .manifests import PROFILES, render_yaml
+
+profile = sys.argv[1] if len(sys.argv) > 1 else "standalone"
+if profile not in PROFILES:
+    sys.exit(f"unknown profile {profile!r}; choose from {PROFILES}")
+sys.stdout.write(render_yaml(profile))

@@ -1,0 +1,381 @@
+"""Deployment-manifest rendering: the kustomize plane as code.
+
+The reference ships ~200 kustomize YAML files (components/*/config/: CRD
+bases + conversion patches, RBAC, manager Deployment, webhook service/cert
+plumbing, params.env ConfigMaps, overlays kubeflow/openshift/standalone).
+Instead of a YAML tree we render the same objects from one Python module —
+reviewable, testable, and parameterized by profile — and emit multi-doc YAML
+via `python -m kubeflow_tpu.deploy`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import yaml
+
+from ..api.types import GROUP, VERSIONS
+from ..tpu.topology import ACCELERATORS
+
+PROFILES = ("kubeflow", "openshift", "standalone")
+
+
+def _tpu_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["accelerator", "topology"],
+        "properties": {
+            "accelerator": {
+                "type": "string",
+                "enum": sorted(ACCELERATORS),
+                "description": "TPU generation",
+            },
+            "topology": {
+                "type": "string",
+                "pattern": r"^\d+x\d+(x\d+)?$",
+                "description": "chip topology, e.g. 4x4 (v5e) or 2x2x2 (v5p)",
+            },
+            "slices": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": ">1 enables multi-slice DCN data parallelism",
+            },
+        },
+    }
+
+
+def notebook_crd(conversion_webhook: bool = True) -> dict:
+    """The Notebook CRD: three field-identical versions, v1 storage, webhook
+    conversion through the hub (reference config/crd/bases + patches)."""
+    pod_spec = {
+        "type": "object",
+        "x-kubernetes-preserve-unknown-fields": True,
+        "description": "raw corev1.PodSpec passthrough",
+    }
+    version_schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "template": {
+                        "type": "object",
+                        "properties": {"spec": pod_spec},
+                    },
+                    "tpu": _tpu_schema(),
+                },
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+    versions = []
+    for v in VERSIONS:
+        versions.append(
+            {
+                "name": v,
+                "served": True,
+                "storage": v == "v1",
+                "schema": {"openAPIV3Schema": version_schema},
+                "subresources": {"status": {}},
+            }
+        )
+    crd = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"notebooks.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": "Notebook",
+                "listKind": "NotebookList",
+                "plural": "notebooks",
+                "singular": "notebook",
+            },
+            "scope": "Namespaced",
+            "versions": versions,
+        },
+    }
+    if conversion_webhook:
+        crd["spec"]["conversion"] = {
+            "strategy": "Webhook",
+            "webhook": {
+                "conversionReviewVersions": ["v1"],
+                "clientConfig": {
+                    "service": {
+                        "name": "notebook-controller-webhook",
+                        "namespace": "$(NAMESPACE)",
+                        "path": "/convert",
+                    }
+                },
+            },
+        }
+    return crd
+
+
+def rbac_role() -> dict:
+    """ClusterRole covering both controllers (reference config/rbac/role.yaml
+    union of core + odh markers)."""
+    rules = [
+        {"apiGroups": [GROUP], "resources": ["notebooks"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        {"apiGroups": [GROUP],
+         "resources": ["notebooks/status", "notebooks/finalizers"],
+         "verbs": ["get", "update", "patch"]},
+        {"apiGroups": ["apps"], "resources": ["statefulsets"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        {"apiGroups": [""],
+         "resources": ["services", "serviceaccounts", "secrets", "configmaps",
+                        "events"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        {"apiGroups": [""], "resources": ["pods"],
+         "verbs": ["get", "list", "watch", "delete"]},
+        {"apiGroups": ["networking.k8s.io"], "resources": ["networkpolicies"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        {"apiGroups": ["gateway.networking.k8s.io"],
+         "resources": ["httproutes", "referencegrants"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        {"apiGroups": ["gateway.networking.k8s.io"], "resources": ["gateways"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings", "clusterrolebindings"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"], "resources": ["roles",
+                                                                    "clusterroles"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["image.openshift.io"], "resources": ["imagestreams"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["route.openshift.io"], "resources": ["routes"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["oauth.openshift.io"], "resources": ["oauthclients"],
+         "verbs": ["get", "delete"]},
+        {"apiGroups": ["config.openshift.io"], "resources": ["proxies",
+                                                              "apiservers"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["datasciencepipelinesapplications.opendatahub.io"],
+         "resources": ["datasciencepipelinesapplications"],
+         "verbs": ["get", "list", "watch"]},
+    ]
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "notebook-controller-role"},
+        "rules": rules,
+    }
+
+
+def manager_deployment(profile: str, image: str = "kubeflow-tpu-controller:latest") -> dict:
+    """Manager Deployment (reference config/manager/manager.yaml), env fed by
+    the params ConfigMap."""
+    env = [
+        {"name": "ENABLE_CULLING", "valueFrom": {"configMapKeyRef": {
+            "name": "notebook-controller-params", "key": "ENABLE_CULLING",
+            "optional": True}}},
+        {"name": "CULL_IDLE_TIME", "valueFrom": {"configMapKeyRef": {
+            "name": "notebook-controller-params", "key": "CULL_IDLE_TIME",
+            "optional": True}}},
+        {"name": "IDLENESS_CHECK_PERIOD", "valueFrom": {"configMapKeyRef": {
+            "name": "notebook-controller-params", "key": "IDLENESS_CHECK_PERIOD",
+            "optional": True}}},
+        {"name": "CHECKPOINT_BEFORE_CULL", "valueFrom": {"configMapKeyRef": {
+            "name": "notebook-controller-params", "key": "CHECKPOINT_BEFORE_CULL",
+            "optional": True}}},
+        {"name": "TPU_DEFAULT_IMAGE", "valueFrom": {"configMapKeyRef": {
+            "name": "notebook-controller-params", "key": "TPU_DEFAULT_IMAGE",
+            "optional": True}}},
+        {"name": "K8S_NAMESPACE", "valueFrom": {
+            "fieldRef": {"fieldPath": "metadata.namespace"}}},
+    ]
+    if profile == "openshift":
+        env.append({"name": "SET_PIPELINE_RBAC", "value": "true"})
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": "notebook-controller-deployment",
+            "labels": {"app": "notebook-controller"},
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "notebook-controller"}},
+            "template": {
+                "metadata": {"labels": {"app": "notebook-controller"}},
+                "spec": {
+                    "serviceAccountName": "notebook-controller-sa",
+                    "containers": [
+                        {
+                            "name": "manager",
+                            "image": image,
+                            "command": ["python", "-m", "kubeflow_tpu.main"],
+                            "ports": [
+                                {"name": "metrics", "containerPort": 8080},
+                                {"name": "webhook", "containerPort": 9443},
+                            ],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz", "port": 8081}
+                            },
+                            "readinessProbe": {
+                                "httpGet": {"path": "/readyz", "port": 8081}
+                            },
+                            "resources": {
+                                "requests": {"cpu": "100m", "memory": "128Mi"},
+                                "limits": {"cpu": "500m", "memory": "512Mi"},
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def params_configmap(profile: str) -> dict:
+    data = {
+        "ENABLE_CULLING": "false",
+        "CULL_IDLE_TIME": "1440",
+        "IDLENESS_CHECK_PERIOD": "1",
+        "CHECKPOINT_BEFORE_CULL": "true",
+        "TPU_DEFAULT_IMAGE": "jupyter-tpu-jax:latest",
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "notebook-controller-params"},
+        "data": data,
+    }
+
+
+def webhook_manifests() -> list[dict]:
+    """Mutating + validating webhook configs and the serving Service
+    (reference config/webhook/)."""
+    client_config = {
+        "service": {
+            "name": "notebook-controller-webhook",
+            "namespace": "$(NAMESPACE)",
+        }
+    }
+    return [
+        {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "notebook-controller-mutating"},
+            "webhooks": [
+                {
+                    "name": "mutate-notebook-v1.kubeflow.org",
+                    "admissionReviewVersions": ["v1"],
+                    "sideEffects": "NoneOnDryRun",
+                    "clientConfig": {
+                        **client_config,
+                        "service": {
+                            **client_config["service"],
+                            "path": "/mutate-notebook-v1",
+                        },
+                    },
+                    "rules": [
+                        {
+                            "apiGroups": [GROUP],
+                            "apiVersions": ["v1"],
+                            "operations": ["CREATE", "UPDATE"],
+                            "resources": ["notebooks"],
+                        }
+                    ],
+                }
+            ],
+        },
+        {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "notebook-controller-validating"},
+            "webhooks": [
+                {
+                    "name": "validate-notebook-v1.kubeflow.org",
+                    "admissionReviewVersions": ["v1"],
+                    "sideEffects": "None",
+                    "clientConfig": {
+                        **client_config,
+                        "service": {
+                            **client_config["service"],
+                            "path": "/validate-notebook-v1",
+                        },
+                    },
+                    "rules": [
+                        {
+                            "apiGroups": [GROUP],
+                            "apiVersions": ["v1"],
+                            "operations": ["UPDATE"],
+                            "resources": ["notebooks"],
+                        }
+                    ],
+                }
+            ],
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "notebook-controller-webhook"},
+            "spec": {
+                "selector": {"app": "notebook-controller"},
+                "ports": [{"port": 443, "targetPort": 9443}],
+            },
+        },
+    ]
+
+
+def render_profile(profile: str = "standalone") -> list[dict]:
+    if profile not in PROFILES:
+        raise ValueError(f"unknown profile {profile!r}; choose from {PROFILES}")
+    docs: list[dict] = [
+        notebook_crd(conversion_webhook=profile != "standalone"),
+        rbac_role(),
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "notebook-controller-sa"},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "notebook-controller-binding"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "notebook-controller-role",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "notebook-controller-sa",
+                    "namespace": "$(NAMESPACE)",
+                }
+            ],
+        },
+        params_configmap(profile),
+        manager_deployment(profile),
+    ]
+    if profile != "standalone":
+        docs.extend(webhook_manifests())
+    return docs
+
+
+def render_yaml(profile: str = "standalone") -> str:
+    return yaml.safe_dump_all(render_profile(profile), sort_keys=False)
+
+
+def validate_docs(docs: Iterable[dict]) -> None:
+    """CI-style sanity (reference ci/kustomize.sh analog): every doc has
+    apiVersion/kind/metadata.name, no duplicate identities."""
+    seen = set()
+    for doc in docs:
+        for key in ("apiVersion", "kind"):
+            if not doc.get(key):
+                raise ValueError(f"manifest missing {key}: {doc}")
+        name = doc.get("metadata", {}).get("name")
+        if not name:
+            raise ValueError(f"manifest missing metadata.name: {doc.get('kind')}")
+        identity = (doc["kind"], name)
+        if identity in seen:
+            raise ValueError(f"duplicate manifest {identity}")
+        seen.add(identity)

@@ -1,0 +1,155 @@
+"""Manager entrypoint: `python -m kubeflow_tpu.main`.
+
+The analog of both reference binaries (notebook-controller/main.go:58-148 and
+odh-notebook-controller/main.go:141-347) collapsed into ONE manager with all
+controllers + webhooks — removing the cross-process webhook/controller race
+the reference papers over with the lock annotation (SURVEY.md §7 hard
+parts).  Flags mirror the reference; env vars are the config surface
+(utils/config.py).
+
+Without a real cluster this runs standalone against the in-memory API server
+with the fake data plane — the `--demo` mode used by examples/ and the load
+test; the HTTP side (healthz/readyz/metrics) is real either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+from .api.types import Notebook, TPUSpec
+from .core.culling_controller import setup_culling
+from .core.metrics import NotebookMetrics
+from .core.notebook_controller import setup_core_controllers
+from .kube import ApiServer, FakeCluster, Manager
+from .utils.config import CoreConfig, OdhConfig
+
+
+class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
+    """healthz/readyz ping handlers + Prometheus /metrics
+    (main.go:125-133, metrics on :8080)."""
+
+    manager: Optional[Manager] = None
+    metrics: Optional[NotebookMetrics] = None
+
+    def do_GET(self):  # noqa: N802  (stdlib API)
+        if self.path in ("/healthz", "/readyz"):
+            self._respond(200, "ok", "text/plain")
+        elif self.path == "/metrics":
+            registry = getattr(self.metrics, "registry", None)
+            body = registry.render() if registry is not None else ""
+            self._respond(200, body, "text/plain; version=0.0.4")
+        elif self.path == "/state":
+            api = self.manager.api if self.manager else None
+            body = json.dumps(api.dump() if api else {}, default=str)
+            self._respond(200, body, "application/json")
+        else:
+            self._respond(404, "not found", "text/plain")
+
+    def _respond(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def serve_http(port: int, manager: Manager, metrics: NotebookMetrics):
+    handler = type(
+        "Handler",
+        (HealthAndMetricsHandler,),
+        {"manager": manager, "metrics": metrics},
+    )
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def build_manager(
+    core_cfg: Optional[CoreConfig] = None,
+    odh_cfg: Optional[OdhConfig] = None,
+    with_fake_cluster: bool = True,
+):
+    """Wire the full stack; returns (manager, api, cluster, metrics)."""
+    api = ApiServer()
+    cluster = FakeCluster(api) if with_fake_cluster else None
+    mgr = Manager(api)
+    core_cfg = core_cfg or CoreConfig.from_env()
+    odh_cfg = odh_cfg or OdhConfig.from_env()
+    metrics = NotebookMetrics(api)
+    setup_core_controllers(mgr, core_cfg, metrics)
+    setup_culling(mgr, core_cfg, metrics=metrics)
+    from .odh.controller import setup_odh_controllers
+
+    setup_odh_controllers(mgr, odh_cfg)
+    return mgr, api, cluster, metrics
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="kubeflow-tpu notebook controller")
+    parser.add_argument("--metrics-addr", type=int, default=8080,
+                        help="port for /metrics + health endpoints")
+    parser.add_argument("--demo", action="store_true",
+                        help="create a sample TPU notebook and print state")
+    parser.add_argument("--demo-topology", default="4x4")
+    parser.add_argument("--demo-accelerator", default="v5e")
+    parser.add_argument("--run-seconds", type=float, default=0.0,
+                        help="exit after N seconds (0 = run forever)")
+    parser.add_argument("--debug-log", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug_log else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    mgr, api, cluster, metrics = build_manager()
+    if cluster is not None:
+        cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    server = serve_http(args.metrics_addr, mgr, metrics)
+    mgr.start()
+    logging.info("manager started; metrics on :%d", args.metrics_addr)
+
+    if args.demo and cluster is not None:
+        tpu = TPUSpec(args.demo_accelerator, args.demo_topology)
+        shape = tpu.validate()
+        cluster.add_tpu_slice_nodes(
+            shape.accelerator.gke_label, shape.topology,
+            shape.num_hosts, shape.chips_per_host,
+        )
+        nb = Notebook.new("demo", "default", tpu=tpu)
+        api.create(nb.obj)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            live = api.try_get("Notebook", "default", "demo")
+            if live and live.body.get("status", {}).get("sliceHealth") == "Healthy":
+                break
+            time.sleep(0.05)
+        live = api.get("Notebook", "default", "demo")
+        print(json.dumps(live.body.get("status", {}), indent=2))
+
+    try:
+        if args.run_seconds > 0:
+            time.sleep(args.run_seconds)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        mgr.stop()
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,7 +15,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.attention import attention
-from .transformer import RMSNorm, _dense
+from .transformer import RMSNorm, _dense, _dtype
 
 
 @dataclass(frozen=True)
@@ -43,7 +43,7 @@ class ViTBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        dtype = _dtype(cfg.dtype)
         head_dim = cfg.embed_dim // cfg.num_heads
         h = RMSNorm(dtype=dtype, name="attn_norm")(x)
         q = _dense((cfg.num_heads, head_dim), ("embed", "heads", "kv"), "q", dtype)(h)
@@ -68,7 +68,7 @@ class ViT(nn.Module):
     @nn.compact
     def __call__(self, images):
         cfg = self.cfg
-        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        dtype = _dtype(cfg.dtype)
         x = nn.Conv(
             cfg.embed_dim,
             kernel_size=(cfg.patch_size, cfg.patch_size),

@@ -39,12 +39,31 @@ def logical_to_spec(
     logical_axes: Sequence[Optional[str]],
     rules: Optional[Sequence[tuple[str, object]]] = None,
 ) -> PartitionSpec:
-    """("batch", "seq", "embed") -> PartitionSpec(("data","fsdp"), "sequence",
-    "fsdp")."""
+    """("batch", "seq", "embed") -> PartitionSpec(("data","fsdp"),
+    "sequence", None).
+
+    A physical mesh axis may shard only one dimension; later logical axes
+    skip mesh axes already claimed by earlier ones (the same
+    first-come-first-served resolution flax's rule engine applies), so e.g.
+    "embed" -> "fsdp" yields None here because "batch" already took fsdp."""
     table = rules_dict(rules)
-    return PartitionSpec(
-        *(table.get(axis) if axis is not None else None for axis in logical_axes)
-    )
+    used: set[str] = set()
+    entries = []
+    for axis in logical_axes:
+        mapped = table.get(axis) if axis is not None else None
+        if mapped is None:
+            entries.append(None)
+            continue
+        axes = mapped if isinstance(mapped, tuple) else (mapped,)
+        free = tuple(a for a in axes if a not in used)
+        used.update(free)
+        if not free:
+            entries.append(None)
+        elif len(free) == 1:
+            entries.append(free[0])
+        else:
+            entries.append(free)
+    return PartitionSpec(*entries)
 
 
 def logical_sharding(

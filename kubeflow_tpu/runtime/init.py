@@ -46,7 +46,9 @@ def parse_worker_env(env: Optional[Mapping[str, str]] = None) -> WorkerIdentity:
     hostnames = tuple(
         h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
     )
-    hosts_per_slice = int(env.get("TPU_HOSTS_PER_SLICE", len(hostnames) or 1))
+    hosts_per_slice = int(
+        env.get("TPU_HOSTS_PER_SLICE") or len(hostnames) or 1
+    )
     worker_id = int(env.get("TPU_WORKER_ID", 0) or 0)
     return WorkerIdentity(
         worker_id=worker_id,

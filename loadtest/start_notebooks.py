@@ -1,0 +1,85 @@
+"""Load test: spawn N notebooks, measure notebook-to-ready latency.
+
+The reference load test templates N Notebook CRs and kubectl-applies them,
+measuring nothing (loadtest/start_notebooks.py:1-60).  Ours drives the
+standalone stack and reports the north-star metric BASELINE.md defines:
+notebook-to-ready latency (p50/p95/max), for CPU and TPU shapes.
+
+    python loadtest/start_notebooks.py -l 50 --tpu v5e:4x4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec  # noqa: E402
+from kubeflow_tpu.main import build_manager  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-l", "--count", type=int, default=3,
+                        help="number of notebooks (reference default 3)")
+    parser.add_argument("--namespace", default="loadtest")
+    parser.add_argument("--tpu", default="",
+                        help="accelerator:topology, e.g. v5e:4x4 (default CPU)")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    args = parser.parse_args(argv)
+
+    mgr, api, cluster, _ = build_manager()
+    cluster.add_node("cpu-node", allocatable={"cpu": "512", "memory": "2048Gi"})
+    tpu = None
+    if args.tpu:
+        accel, topology = args.tpu.split(":")
+        tpu = TPUSpec(accel, topology)
+        shape = tpu.validate()
+        cluster.add_tpu_slice_nodes(
+            shape.accelerator.gke_label, shape.topology,
+            shape.num_hosts * args.count, shape.chips_per_host,
+        )
+    mgr.start()
+
+    latencies: list[float] = []
+    t_start = time.perf_counter()
+    for i in range(args.count):
+        name = f"loadtest-nb-{i}"
+        t0 = time.perf_counter()
+        api.create(Notebook.new(name, args.namespace, tpu=tpu).obj)
+        deadline = t0 + args.timeout
+        while time.perf_counter() < deadline:
+            live = api.try_get("Notebook", args.namespace, name)
+            status = (live.body.get("status") or {}) if live else {}
+            expected = tpu.shape.num_hosts if tpu else 1
+            if status.get("readyReplicas") == expected:
+                latencies.append(time.perf_counter() - t0)
+                break
+            time.sleep(0.01)
+        else:
+            print(f"TIMEOUT waiting for {name}", file=sys.stderr)
+            mgr.stop()
+            return 1
+    total = time.perf_counter() - t_start
+    mgr.stop()
+
+    latencies.sort()
+    print(json.dumps({
+        "notebooks": args.count,
+        "tpu": args.tpu or "cpu",
+        "total_s": round(total, 3),
+        "ready_latency_p50_s": round(statistics.median(latencies), 4),
+        "ready_latency_p95_s": round(
+            latencies[max(0, int(len(latencies) * 0.95) - 1)], 4),
+        "ready_latency_max_s": round(latencies[-1], 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,128 @@
+"""Chaos-model validation + fault-injection drills.
+
+Two halves, mirroring the reference's shift-left chaos CI (SURVEY.md §4.6):
+1. the knowledge model (chaos/knowledge/workbenches.yaml) must stay in sync
+   with what the controllers actually create — a drift check;
+2. the declared fault injections actually hold: kill/fail a worker, delete a
+   route, and watch level-triggered reconciliation restore steady state.
+"""
+
+from pathlib import Path
+
+import pytest
+import yaml
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.odh import constants as OC
+from kubeflow_tpu.odh.controller import setup_odh_controllers
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
+
+KNOWLEDGE = Path(__file__).parent.parent / "chaos" / "knowledge" / "workbenches.yaml"
+CENTRAL_NS = "opendatahub"
+
+
+@pytest.fixture()
+def env():
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+    mgr = Manager(api, clock=FakeClock())
+    setup_core_controllers(mgr, CoreConfig())
+    setup_odh_controllers(mgr, OdhConfig(controller_namespace=CENTRAL_NS))
+    return api, cluster, mgr
+
+
+def knowledge():
+    return yaml.safe_load(KNOWLEDGE.read_text())
+
+
+class TestKnowledgeModel:
+    def test_model_parses_and_names_controllers(self):
+        model = knowledge()
+        names = {c["name"] for c in model["controllers"]}
+        assert names == {
+            "notebook-controller", "culling-controller", "odh-notebook-controller",
+        }
+        assert all(c["primary"] == "Notebook" for c in model["controllers"])
+
+    def test_managed_kinds_match_reality(self, env):
+        """Drift check: every kind the stack creates for a TPU+auth notebook
+        is declared in the model, and vice versa for non-optional kinds."""
+        api, _, mgr = env
+        nb = Notebook.new(
+            "drift", "user1", tpu=TPUSpec("v5e", "4x4"),
+            annotations={OC.ANNOTATION_INJECT_AUTH: "true"},
+        )
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        created_kinds = {
+            kind
+            for kind, objs in api.dump().items()
+            if kind not in ("Notebook", "Node", "Pod", "Event")
+            and any(
+                o["metadata"].get("namespace") in ("user1", CENTRAL_NS, "")
+                for o in objs
+            )
+        }
+        model = knowledge()
+        declared = {
+            m["kind"]
+            for c in model["controllers"]
+            for m in c["manages"]
+        }
+        undeclared = created_kinds - declared
+        assert not undeclared, f"created but not in chaos model: {undeclared}"
+
+    def test_steady_state_timeout_declared(self):
+        model = knowledge()
+        assert all(s["timeout_seconds"] <= 60 for s in model["steady_state"])
+
+
+class TestFaultInjection:
+    def _healthy_tpu_nb(self, api, mgr, name="chaos-nb"):
+        nb = Notebook.new(name, "user1", tpu=TPUSpec("v5e", "4x4"))
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        status = api.get("Notebook", "user1", name).body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        return name
+
+    def test_kill_worker_pod_recovers(self, env):
+        api, cluster, mgr = env
+        name = self._healthy_tpu_nb(api, mgr)
+        api.delete("Pod", "user1", f"{name}-2")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "user1", name).body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert status["readyReplicas"] == 4
+
+    def test_failed_worker_degrades_then_restart_recovers(self, env):
+        api, cluster, mgr = env
+        name = self._healthy_tpu_nb(api, mgr)
+        cluster.fail_pod("user1", f"{name}-1")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "user1", name).body["status"]
+        assert status["sliceHealth"] == "Degraded"
+        # slice-atomic restart via the restart annotation
+        live = api.get("Notebook", "user1", name)
+        live.metadata.annotations["notebooks.opendatahub.io/notebook-restart"] = "true"
+        api.update(live)
+        mgr.run_until_idle()
+        status = api.get("Notebook", "user1", name).body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        live = api.get("Notebook", "user1", name)
+        assert "notebooks.opendatahub.io/notebook-restart" not in (
+            live.metadata.annotations
+        )
+
+    def test_delete_route_recreated(self, env):
+        api, _, mgr = env
+        name = self._healthy_tpu_nb(api, mgr)
+        route_name = f"nb-user1-{name}"
+        api.delete("HTTPRoute", CENTRAL_NS, route_name)
+        mgr.run_until_idle()
+        assert api.try_get("HTTPRoute", CENTRAL_NS, route_name) is not None

@@ -28,9 +28,14 @@ class TestMesh:
             make_mesh(MeshConfig(data=3, fsdp=3, sequence=1, tensor=1))
 
     def test_logical_rules(self):
+        # "embed" maps to fsdp, but batch already claimed it -> None
         spec = logical_to_spec(("batch", "seq", "embed"))
         assert spec == jax.sharding.PartitionSpec(
-            ("data", "fsdp"), "sequence", "fsdp"
+            ("data", "fsdp"), "sequence", None
+        )
+        # parameter tree case: no batch dim, embed keeps fsdp
+        assert logical_to_spec(("embed", "mlp")) == jax.sharding.PartitionSpec(
+            "fsdp", "tensor"
         )
 
 

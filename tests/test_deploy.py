@@ -1,0 +1,76 @@
+"""Deployment-plane tests: manifest rendering (kustomize analog), the
+single-manager entrypoint's HTTP surface, and the chaos/CI-style validation
+(reference ci/kustomize.sh + config/ tree)."""
+
+import json
+import urllib.request
+
+import yaml
+
+from kubeflow_tpu.deploy import PROFILES, render_profile, render_yaml, validate_docs
+from kubeflow_tpu.main import build_manager, serve_http
+
+
+class TestManifests:
+    def test_all_profiles_render_and_validate(self):
+        for profile in PROFILES:
+            docs = render_profile(profile)
+            validate_docs(docs)
+            # YAML round-trips
+            parsed = list(yaml.safe_load_all(render_yaml(profile)))
+            assert len(parsed) == len(docs)
+
+    def test_crd_has_three_versions_v1_storage(self):
+        crd = render_profile("openshift")[0]
+        assert crd["kind"] == "CustomResourceDefinition"
+        versions = {v["name"]: v for v in crd["spec"]["versions"]}
+        assert set(versions) == {"v1alpha1", "v1beta1", "v1"}
+        assert versions["v1"]["storage"] is True
+        assert crd["spec"]["conversion"]["strategy"] == "Webhook"
+        tpu = versions["v1"]["schema"]["openAPIV3Schema"]["properties"]["spec"][
+            "properties"]["tpu"]
+        assert set(tpu["properties"]) == {"accelerator", "topology", "slices"}
+
+    def test_standalone_profile_has_no_webhook_configs(self):
+        kinds = {d["kind"] for d in render_profile("standalone")}
+        assert "MutatingWebhookConfiguration" not in kinds
+        kinds_os = {d["kind"] for d in render_profile("openshift")}
+        assert {"MutatingWebhookConfiguration",
+                "ValidatingWebhookConfiguration"} <= kinds_os
+
+    def test_rbac_covers_managed_kinds(self):
+        role = next(
+            d for d in render_profile("openshift") if d["kind"] == "ClusterRole"
+        )
+        resources = {r for rule in role["rules"] for r in rule["resources"]}
+        for needed in ("notebooks", "statefulsets", "services", "httproutes",
+                       "referencegrants", "networkpolicies", "rolebindings"):
+            assert needed in resources, f"RBAC missing {needed}"
+
+
+class TestManagerHTTP:
+    def test_health_metrics_state_endpoints(self):
+        mgr, api, cluster, metrics = build_manager()
+        cluster.add_node("n1")
+        server = serve_http(0, mgr, metrics)
+        port = server.server_address[1]
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as resp:
+                    return resp.status, resp.read().decode()
+
+            assert get("/healthz")[0] == 200
+            assert get("/readyz")[0] == 200
+            status, body = get("/metrics")
+            assert status == 200
+            assert "notebook_create_total" in body or "# TYPE" in body
+            status, body = get("/state")
+            assert status == 200
+            assert "Node" in json.loads(body)
+            assert get("/nope")[0:1] != (200,)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404  # /nope
+        finally:
+            server.shutdown()

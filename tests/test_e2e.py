@@ -1,0 +1,204 @@
+"""e2e phase harness: create -> validate -> update -> delete over a fixture
+matrix, the analog of the reference's real-cluster suite
+(odh e2e/notebook_controller_setup_test.go:55-120: notebookContext list,
+phased TestE2ENotebookController, poll-until helpers) run against the full
+in-memory stack with the threaded manager — the closest thing to a cluster
+this environment has.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core import constants as CC
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.odh import constants as OC
+from kubeflow_tpu.odh.controller import setup_odh_controllers
+from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
+
+CENTRAL_NS = "opendatahub"
+POLL_TIMEOUT_S = 15.0
+POLL_INTERVAL_S = 0.02
+
+
+@dataclass
+class NotebookContext:
+    """One e2e fixture (reference notebookContext, setup_test.go:55-61)."""
+
+    name: str
+    tpu: Optional[TPUSpec] = None
+    annotations: dict = field(default_factory=dict)
+    namespace: str = "e2e"
+
+    @property
+    def expected_hosts(self) -> int:
+        return (self.tpu.shape.num_hosts * self.tpu.slices) if self.tpu else 1
+
+    @property
+    def auth(self) -> bool:
+        return self.annotations.get(OC.ANNOTATION_INJECT_AUTH) == "true"
+
+
+CONTEXTS = [
+    NotebookContext("e2e-cpu"),
+    NotebookContext("e2e-tpu-1chip", tpu=TPUSpec("v5e", "1x1")),
+    NotebookContext("e2e-tpu-multihost", tpu=TPUSpec("v5e", "4x4")),
+    NotebookContext(
+        "e2e-tpu-multislice", tpu=TPUSpec("v5e", "4x4", slices=2)
+    ),
+    NotebookContext(
+        "e2e-tpu-auth",
+        tpu=TPUSpec("v5e", "2x4"),
+        annotations={OC.ANNOTATION_INJECT_AUTH: "true"},
+    ),
+]
+
+
+def wait_for(cond, what: str):
+    """PollUntilContextTimeout analog (e2e helper_test.go:28-56)."""
+    deadline = time.time() + POLL_TIMEOUT_S
+    while time.time() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(POLL_INTERVAL_S)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def stack():
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "256", "memory": "1024Gi"})
+    # enough TPU capacity for every fixture simultaneously
+    cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 16, 4, "v5e-4x4")
+    cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "1x1", 2, 1, "v5e-1x1")
+    cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "2x4", 4, 8, "v5e-2x4")
+    mgr = Manager(api)
+    setup_core_controllers(mgr, CoreConfig())
+    setup_odh_controllers(mgr, OdhConfig(controller_namespace=CENTRAL_NS))
+    mgr.start()
+    yield api, cluster, mgr
+    mgr.stop()
+
+
+@pytest.mark.parametrize("ctx", CONTEXTS, ids=lambda c: c.name)
+class TestE2ENotebookLifecycle:
+    def test_phase_create(self, stack, ctx):
+        api, _, _ = stack
+        api.create(
+            Notebook.new(
+                ctx.name, ctx.namespace, tpu=ctx.tpu, annotations=ctx.annotations
+            ).obj
+        )
+        wait_for(
+            lambda: (nb := api.try_get("Notebook", ctx.namespace, ctx.name))
+            is not None
+            and OC.STOP_ANNOTATION not in nb.metadata.annotations,
+            f"{ctx.name}: reconciliation lock removed",
+        )
+        wait_for(
+            lambda: (nb := api.try_get("Notebook", ctx.namespace, ctx.name))
+            is not None
+            and nb.body.get("status", {}).get("readyReplicas")
+            == ctx.expected_hosts,
+            f"{ctx.name}: {ctx.expected_hosts} ready workers",
+        )
+
+    def test_phase_validate(self, stack, ctx):
+        api, _, _ = stack
+        # workload objects
+        num_slices = ctx.tpu.slices if ctx.tpu else 1
+        for s in range(num_slices):
+            sts_name = (
+                ctx.name if num_slices == 1 else f"{ctx.name}-slice-{s}"
+            )
+            sts = api.get("StatefulSet", ctx.namespace, sts_name)
+            per_slice = ctx.tpu.shape.num_hosts if ctx.tpu else 1
+            assert sts.spec["replicas"] == per_slice
+        assert api.try_get("Service", ctx.namespace, ctx.name) is not None
+        if ctx.tpu:
+            headless = api.get("Service", ctx.namespace, f"{ctx.name}-workers")
+            assert headless.spec["clusterIP"] == "None"
+            status = api.get("Notebook", ctx.namespace, ctx.name).body["status"]
+            assert status["sliceHealth"] == "Healthy"
+            assert len(status["workerStates"]) == ctx.expected_hosts
+            # distributed env on a worker pod
+            sts0 = ctx.name if num_slices == 1 else f"{ctx.name}-slice-0"
+            pod = api.get("Pod", ctx.namespace, f"{sts0}-0")
+            env = {e["name"] for e in pod.spec["containers"][0]["env"]}
+            assert {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+                    "JAX_COORDINATOR_ADDRESS"} <= env
+            if num_slices > 1:
+                assert "MEGASCALE_NUM_SLICES" in env
+        # routing
+        routes = api.list(
+            "HTTPRoute", namespace=CENTRAL_NS,
+            label_selector={"notebook-name": ctx.name},
+        )
+        assert len(routes) == 1
+        backend = routes[0].spec["rules"][0]["backendRefs"][0]
+        assert backend["port"] == (8443 if ctx.auth else 8888)
+        assert (
+            api.try_get("ReferenceGrant", ctx.namespace, OC.REFERENCEGRANT_NAME)
+            is not None
+        )
+        # network policies
+        assert api.try_get(
+            "NetworkPolicy", ctx.namespace, f"{ctx.name}-ctrl-np"
+        ) is not None
+        if ctx.auth:
+            assert api.try_get("ServiceAccount", ctx.namespace, ctx.name) is not None
+            pod_containers = api.get(
+                "Pod", ctx.namespace,
+                f"{ctx.name if (not ctx.tpu or ctx.tpu.slices == 1) else ctx.name + '-slice-0'}-0",
+            ).spec["containers"]
+            assert any(c["name"] == "kube-rbac-proxy" for c in pod_containers)
+
+    def test_phase_update_stop_resume(self, stack, ctx):
+        api, _, _ = stack
+        live = api.get("Notebook", ctx.namespace, ctx.name)
+        live.metadata.annotations[CC.STOP_ANNOTATION] = "2026-07-29T00:00:00Z"
+        api.update(live)
+        wait_for(
+            lambda: all(
+                s.spec["replicas"] == 0
+                for s in api.list("StatefulSet", namespace=ctx.namespace)
+                if s.metadata.labels.get("notebook-name", s.name) == ctx.name
+                or s.name == ctx.name
+            ),
+            f"{ctx.name}: slice-atomic stop",
+        )
+        live = api.get("Notebook", ctx.namespace, ctx.name)
+        del live.metadata.annotations[CC.STOP_ANNOTATION]
+        api.update(live)
+        wait_for(
+            lambda: api.get("Notebook", ctx.namespace, ctx.name)
+            .body.get("status", {})
+            .get("readyReplicas")
+            == ctx.expected_hosts,
+            f"{ctx.name}: resume",
+        )
+
+    def test_phase_delete(self, stack, ctx):
+        api, _, _ = stack
+        api.delete("Notebook", ctx.namespace, ctx.name)
+        wait_for(
+            lambda: api.try_get("Notebook", ctx.namespace, ctx.name) is None,
+            f"{ctx.name}: finalized",
+        )
+        wait_for(
+            lambda: not api.list(
+                "HTTPRoute", namespace=CENTRAL_NS,
+                label_selector={"notebook-name": ctx.name},
+            ),
+            f"{ctx.name}: route cleanup",
+        )
+        assert not [
+            s for s in api.list("StatefulSet", namespace=ctx.namespace)
+            if s.name.startswith(ctx.name)
+        ]
