@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Bench smoke on whatever backend is present (CPU in CI): asserts bench.py
+# emits exactly one valid JSON line.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out=$(python bench.py 2 2>/dev/null | grep '^{')
+echo "$out" | python -c 'import json,sys; d=json.load(sys.stdin); assert {"metric","value","unit","vs_baseline"} <= set(d), d; print("bench smoke ok:", d["metric"])'

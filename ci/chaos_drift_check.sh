@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Chaos knowledge-model drift check (reference
+# .github/workflows/operator_chaos_validation.yaml analog).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/test_chaos.py -q "$@"

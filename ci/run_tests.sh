@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Unit + integration suite on the 8-device virtual CPU mesh
+# (reference .github/workflows unit job analog).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

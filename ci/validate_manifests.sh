@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Manifest build check for every profile (reference ci/kustomize.sh analog).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python - <<'PY'
+from kubeflow_tpu.deploy import PROFILES, render_profile, render_yaml, validate_docs
+for profile in PROFILES:
+    docs = render_profile(profile)
+    validate_docs(docs)
+    render_yaml(profile)
+    print(f"profile {profile}: {len(docs)} manifests ok")
+PY

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Notebook conformance profile (reference conformance/1.7/Makefile analog,
+# retargeted at the notebook subsystem): the e2e phase harness IS the
+# conformance suite — CRD lifecycle, routing, auth, culling semantics.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/test_e2e.py tests/test_odh_routing.py tests/test_culling.py -q
+echo "notebook conformance: PASS"

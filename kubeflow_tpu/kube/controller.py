@@ -247,6 +247,18 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
+        # a reconciler may request shutdown from the worker thread itself
+        # (e.g. the TLS-profile watcher); joining the current thread would
+        # raise, and the loop exits on the event anyway
+        if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=5)
             self._thread = None
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def wait_until_stopped(self, timeout: Optional[float] = None) -> bool:
+        """Block until stop() is called (standalone main loop); True when
+        the stop event fired."""
+        return self._stop.wait(timeout)

@@ -39,7 +39,12 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802  (stdlib API)
         if self.path in ("/healthz", "/readyz"):
-            self._respond(200, "ok", "text/plain")
+            # a stopped manager (TLS-profile restart, fatal error) must fail
+            # probes so the Deployment actually restarts the pod
+            if self.manager is not None and self.manager.stopped:
+                self._respond(503, "manager stopped", "text/plain")
+            else:
+                self._respond(200, "ok", "text/plain")
         elif self.path == "/metrics":
             registry = getattr(self.metrics, "registry", None)
             body = registry.render() if registry is not None else ""
@@ -90,8 +95,29 @@ def build_manager(
     setup_core_controllers(mgr, core_cfg, metrics)
     setup_culling(mgr, core_cfg, metrics=metrics)
     from .odh.controller import setup_odh_controllers
+    from .odh.tls_profile import SecurityProfileWatcher, fetch_apiserver_tls_profile
 
     setup_odh_controllers(mgr, odh_cfg)
+
+    # TLS posture: resolve at startup, restart-on-change (odh main.go:178-214,
+    # 324-340); in standalone mode the "restart" is a manager stop — the
+    # supervising process (Deployment) brings it back with the new profile
+    profile = fetch_apiserver_tls_profile(api)
+    logging.getLogger("kubeflow_tpu").info(
+        "TLS profile: %s (min %s)", profile.source, profile.min_version
+    )
+    watcher = SecurityProfileWatcher(
+        api,
+        profile,
+        on_change=lambda old, new: (
+            logging.getLogger("kubeflow_tpu").warning(
+                "TLS profile changed (%s -> %s); initiating graceful restart",
+                old.min_version, new.min_version,
+            ),
+            mgr.stop(),
+        ),
+    )
+    watcher.setup(mgr)
     return mgr, api, cluster, metrics
 
 
@@ -137,18 +163,22 @@ def main(argv: Optional[list[str]] = None) -> int:
         live = api.get("Notebook", "default", "demo")
         print(json.dumps(live.body.get("status", {}), indent=2))
 
+    exit_code = 0
     try:
-        if args.run_seconds > 0:
-            time.sleep(args.run_seconds)
-        else:
-            while True:
-                time.sleep(3600)
+        # exits when run_seconds elapses OR the manager stops itself (e.g.
+        # TLS-profile change) — a non-zero exit makes the Deployment restart
+        # the pod with the new posture
+        timeout = args.run_seconds if args.run_seconds > 0 else None
+        stopped = mgr.wait_until_stopped(timeout)
+        if stopped and timeout is None:
+            logging.warning("manager stopped itself; exiting for restart")
+            exit_code = 1
     except KeyboardInterrupt:
         pass
     finally:
         mgr.stop()
         server.shutdown()
-    return 0
+    return exit_code
 
 
 if __name__ == "__main__":

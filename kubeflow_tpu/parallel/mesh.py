@@ -84,11 +84,19 @@ def make_mesh(
             config.sequence,
             config.tensor,
         )
-        device_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice,
-            dcn_mesh_shape=(config.num_slices, 1, 1, 1),
-            devices=devices,
-        )
+        if devices and devices[0].platform == "cpu":
+            # virtual CPU devices carry no slice_index attribute; emulate the
+            # hybrid layout (slice-major outermost on the data axis) so the
+            # multi-slice program still compiles in dry runs.  On real TPUs a
+            # ValueError from create_hybrid_device_mesh is a genuine
+            # misconfiguration and must propagate.
+            device_array = np.asarray(devices).reshape(config.shape)
+        else:
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice,
+                dcn_mesh_shape=(config.num_slices, 1, 1, 1),
+                devices=devices,
+            )
     else:
         try:
             device_array = mesh_utils.create_device_mesh(

@@ -27,6 +27,17 @@ class TestMesh:
         with pytest.raises(ValueError):
             make_mesh(MeshConfig(data=3, fsdp=3, sequence=1, tensor=1))
 
+    def test_multislice_mesh_trains(self):
+        # BASELINE config #5 shape: DCN data parallel across 2 slices
+        mesh = make_mesh(MeshConfig(data=4, fsdp=2, num_slices=2))
+        setup = setup_training(TINY, mesh, batch_shape=(8, 64))
+        batch = {
+            "inputs": jnp.ones((8, 64), jnp.int32),
+            "targets": jnp.ones((8, 64), jnp.int32),
+        }
+        _, metrics = setup.train_step(setup.state, batch)
+        assert 0.0 < float(metrics["loss"]) < 20.0
+
     def test_logical_rules(self):
         # "embed" maps to fsdp, but batch already claimed it -> None
         spec = logical_to_spec(("batch", "seq", "embed"))

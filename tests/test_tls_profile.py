@@ -1,0 +1,76 @@
+"""TLS security-profile negotiation tests (odh main.go:178-214,324-340)."""
+
+from kubeflow_tpu.kube import ApiServer, KubeObject, Manager, ObjectMeta
+from kubeflow_tpu.odh.tls_profile import (
+    HARDENED_FALLBACK,
+    INTERMEDIATE_CIPHERS,
+    SecurityProfileWatcher,
+    fetch_apiserver_tls_profile,
+)
+from kubeflow_tpu.utils.clock import FakeClock
+
+
+def apiserver_cr(profile: dict) -> KubeObject:
+    return KubeObject(
+        api_version="config.openshift.io/v1",
+        kind="APIServer",
+        metadata=ObjectMeta(name="cluster"),
+        body={"spec": {"tlsSecurityProfile": profile}},
+    )
+
+
+class TestFetch:
+    def test_fallback_without_cr(self):
+        profile = fetch_apiserver_tls_profile(ApiServer())
+        assert profile == HARDENED_FALLBACK
+        assert profile.min_version == "VersionTLS12"
+        assert profile.ciphers == INTERMEDIATE_CIPHERS
+
+    def test_named_profiles(self):
+        api = ApiServer()
+        api.create(apiserver_cr({"type": "Modern"}))
+        profile = fetch_apiserver_tls_profile(api)
+        assert profile.min_version == "VersionTLS13"
+        assert profile.source == "apiserver"
+
+    def test_custom_profile(self):
+        api = ApiServer()
+        api.create(apiserver_cr({
+            "type": "Custom",
+            "custom": {
+                "minTLSVersion": "VersionTLS13",
+                "ciphers": ["TLS_AES_256_GCM_SHA384"],
+            },
+        }))
+        profile = fetch_apiserver_tls_profile(api)
+        assert profile.ciphers == ("TLS_AES_256_GCM_SHA384",)
+
+
+class TestWatcher:
+    def test_profile_change_fires_restart(self):
+        api = ApiServer()
+        api.create(apiserver_cr({"type": "Intermediate"}))
+        mgr = Manager(api, clock=FakeClock())
+        initial = fetch_apiserver_tls_profile(api)
+        changes = []
+        watcher = SecurityProfileWatcher(
+            api, initial, lambda old, new: changes.append((old, new))
+        )
+        watcher.setup(mgr)
+        mgr.run_until_idle()
+        assert not changes  # unchanged profile -> no restart
+
+        cr = api.get("APIServer", "", "cluster")
+        cr.spec["tlsSecurityProfile"] = {"type": "Modern"}
+        api.update(cr)
+        mgr.run_until_idle()
+        assert len(changes) == 1
+        old, new = changes[0]
+        assert old.min_version == "VersionTLS12"
+        assert new.min_version == "VersionTLS13"
+        # fires once (the process restarts; no repeat notifications)
+        cr = api.get("APIServer", "", "cluster")
+        cr.spec["tlsSecurityProfile"] = {"type": "Old"}
+        api.update(cr)
+        mgr.run_until_idle()
+        assert len(changes) == 1
