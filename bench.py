@@ -37,7 +37,7 @@ def main() -> None:
     accel = accelerator_from_device_kind(devices[0].device_kind)
 
     config = BENCH_CHIP
-    batch, seq = 16, 2048
+    batch, seq = 24, 2048
     if backend == "cpu":  # CI smoke: tiny shapes, still one honest JSON line
         from kubeflow_tpu.models.configs import TINY
 

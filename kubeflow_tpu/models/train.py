@@ -66,9 +66,71 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(nll)
 
 
+def chunked_cross_entropy(
+    hidden: jax.Array,
+    targets: jax.Array,
+    head_kernel: jax.Array,
+    num_chunks: int,
+    softcap: float = 0.0,
+) -> jax.Array:
+    """Mean NLL computed per sequence chunk without ever materializing the
+    full [tokens, vocab] fp32 logits.
+
+    Each chunk's logits are produced, reduced to NLL, and (thanks to
+    `jax.checkpoint`) recomputed in the backward pass — peak HBM for the
+    loss drops from tokens*vocab*4B to tokens/num_chunks*vocab*4B, which is
+    what lets single-chip batches grow past the logits wall.  hidden:
+    [B, S, D]; head_kernel: [D, V] (transposed embed table when tied)."""
+    batch, seq, dim = hidden.shape
+    tokens = batch * seq
+    if tokens % num_chunks != 0:
+        raise ValueError(f"{tokens} tokens not divisible by {num_chunks} chunks")
+    h = hidden.reshape(num_chunks, tokens // num_chunks, dim)
+    t = targets.reshape(num_chunks, tokens // num_chunks)
+
+    @jax.checkpoint
+    def chunk_nll(h_c, t_c):
+        # bf16 matmul with fp32 accumulation, matching the dense lm_head
+        logits = jnp.einsum(
+            "nd,dv->nv",
+            h_c,
+            head_kernel.astype(h_c.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if softcap > 0.0:
+            logits = jnp.tanh(logits / softcap) * softcap
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.take_along_axis(logp, t_c[..., None], axis=-1))
+
+    def body(carry, xs):
+        h_c, t_c = xs
+        return carry + chunk_nll(h_c, t_c), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (h, t))
+    return total / tokens
+
+
 def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES):
+    cfg = getattr(model, "cfg", None)
+    loss_chunks = getattr(cfg, "loss_chunks", 0) or 0
+
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         def loss_fn(params):
+            if loss_chunks > 0:
+                hidden = model.apply(
+                    {"params": params}, batch["inputs"], return_hidden=True
+                )
+                if cfg.tie_embeddings:
+                    kernel = nn.unbox(params["embed"]["embedding"]).T
+                else:
+                    kernel = nn.unbox(params["lm_head"]["kernel"])
+                return chunked_cross_entropy(
+                    hidden,
+                    batch["targets"],
+                    kernel,
+                    loss_chunks,
+                    cfg.logits_softcap,
+                )
             logits = model.apply({"params": params}, batch["inputs"])
             return cross_entropy_loss(logits, batch["targets"])
 

@@ -171,7 +171,7 @@ class Transformer(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.cfg
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
@@ -210,6 +210,11 @@ class Transformer(nn.Module):
                 x = layer_cls(cfg, self.mesh, name=f"layer_{i}")(x, positions)
 
         x = RMSNorm(cfg.norm_eps, dtype, name="final_norm")(x)
+        if return_hidden:
+            # chunked-loss path: the caller applies the LM head per chunk
+            # (train.chunked_cross_entropy) so [tokens, vocab] fp32 logits
+            # are never resident all at once
+            return x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(pdtype))
         else:

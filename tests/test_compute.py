@@ -112,6 +112,34 @@ class TestTraining:
                 first = float(metrics["loss"])
         assert float(metrics["loss"]) < first  # memorizes the fixed batch
 
+    def test_chunked_loss_matches_dense(self):
+        mesh = make_mesh(MeshConfig(data=8))
+        key = jax.random.PRNGKey(0)
+        inputs = jax.random.randint(key, (8, 64), 0, TINY.vocab_size)
+        batch = {"inputs": inputs, "targets": jnp.roll(inputs, -1, axis=1)}
+        dense = setup_training(TINY, mesh, batch_shape=(8, 64))
+        chunked = setup_training(
+            TINY.with_(loss_chunks=4), mesh, batch_shape=(8, 64)
+        )
+        _, md = dense.train_step(dense.state, batch)
+        _, mc = chunked.train_step(chunked.state, batch)
+        assert abs(float(md["loss"]) - float(mc["loss"])) < 1e-4
+        assert abs(float(md["grad_norm"]) - float(mc["grad_norm"])) < 1e-2
+
+    def test_chunked_loss_tied_embeddings(self):
+        mesh = make_mesh(MeshConfig(data=8))
+        cfg = TINY.with_(tie_embeddings=True, logits_softcap=30.0)
+        key = jax.random.PRNGKey(0)
+        inputs = jax.random.randint(key, (8, 64), 0, cfg.vocab_size)
+        batch = {"inputs": inputs, "targets": jnp.roll(inputs, -1, axis=1)}
+        dense = setup_training(cfg, mesh, batch_shape=(8, 64))
+        chunked = setup_training(
+            cfg.with_(loss_chunks=4), mesh, batch_shape=(8, 64)
+        )
+        _, md = dense.train_step(dense.state, batch)
+        _, mc = chunked.train_step(chunked.state, batch)
+        assert abs(float(md["loss"]) - float(mc["loss"])) < 1e-4
+
     def test_ring_and_dense_training_agree(self):
         mesh_sp = make_mesh(MeshConfig(data=2, fsdp=1, sequence=4, tensor=1))
         mesh_dp = make_mesh(MeshConfig(data=8, fsdp=1, sequence=1, tensor=1))
