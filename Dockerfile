@@ -1,0 +1,26 @@
+# Manager image for the kubeflow-tpu notebook controller.
+# The analog of the reference's component Dockerfiles
+# (components/notebook-controller/Dockerfile, odh-notebook-controller/Dockerfile):
+# one process serving both reconcilers plus the admission webhooks.
+#
+#   docker build -t kubeflow-tpu-notebook-controller .
+#   kubectl apply -f <(python -m kubeflow_tpu.deploy --profile standalone)
+FROM python:3.12-slim
+
+WORKDIR /opt/app
+COPY pyproject.toml README.md ./
+COPY kubeflow_tpu ./kubeflow_tpu
+RUN pip install --no-cache-dir pyyaml cryptography && \
+    pip install --no-cache-dir --no-deps .
+
+# run as non-root (restricted PodSecurity), like the reference manager images
+RUN useradd --uid 1001 --no-create-home controller
+USER 1001
+
+# metrics+health on 8080, admission webhooks on 9443 (serving certs are
+# mounted at /tmp/k8s-webhook-server/serving-certs by the Deployment,
+# matching controller-runtime's default cert-dir layout)
+EXPOSE 8080 9443
+ENTRYPOINT ["python", "-m", "kubeflow_tpu.main", "--in-cluster", \
+            "--cert-dir", "/tmp/k8s-webhook-server/serving-certs", \
+            "--enable-leader-election"]

@@ -1,0 +1,98 @@
+"""Demo: the shipped manager CLI reconciling a cluster over real sockets.
+
+Stands up a wire-protocol apiserver (kube/wire.py) + fake data plane in this
+process — the "cluster" — then launches `python -m kubeflow_tpu.main
+--kubeconfig ...` as a SEPARATE process, which connects over HTTP, acquires
+the leader Lease, starts informers, and reconciles a TPU notebook to
+Healthy.  The same CLI pointed at a real cluster's kubeconfig does the same
+against real Kubernetes.
+
+    python examples/run_real_cluster.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec  # noqa: E402
+from kubeflow_tpu.kube import FakeCluster  # noqa: E402
+from kubeflow_tpu.kube.store import ApiServer  # noqa: E402
+from kubeflow_tpu.kube.wire import KubeApiWireServer  # noqa: E402
+
+
+def main() -> int:
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    tpu = TPUSpec("v5e", "4x4")
+    shape = tpu.validate()
+    cluster.add_tpu_slice_nodes(shape.accelerator.gke_label, shape.topology,
+                                shape.num_hosts, shape.chips_per_host)
+    srv = KubeApiWireServer(api, token="demo-token").start()
+    print(f"wire apiserver: {srv.url}")
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        json.dump({
+            "apiVersion": "v1", "kind": "Config", "current-context": "demo",
+            "contexts": [{"name": "demo",
+                          "context": {"cluster": "demo", "user": "demo"}}],
+            "clusters": [{"name": "demo", "cluster": {"server": srv.url}}],
+            "users": [{"name": "demo", "user": {"token": "demo-token"}}],
+        }, f)
+        kubeconfig = f.name
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.main",
+         "--kubeconfig", kubeconfig, "--enable-leader-election",
+         "--leader-election-namespace", "default",
+         "--webhook-port", "-1", "--metrics-addr", "18080",
+         "--run-seconds", "120"],
+        env=env)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if api.try_get("Lease", "default",
+                           "kubeflow-tpu-notebook-controller"):
+                break
+            time.sleep(0.1)
+        lease = api.get("Lease", "default", "kubeflow-tpu-notebook-controller")
+        print("leader:", lease.body["spec"]["holderIdentity"])
+
+        api.create(Notebook.new("demo-tpu", "default",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+        deadline = time.time() + 30
+        nb = None
+        while time.time() < deadline:
+            nb = api.try_get("Notebook", "default", "demo-tpu")
+            if nb and nb.body.get("status", {}).get("sliceHealth") == "Healthy":
+                break
+            time.sleep(0.2)
+        status = (nb.body.get("status", {}) if nb else {})
+        print(json.dumps({k: status.get(k) for k in
+                          ("sliceHealth", "readyReplicas")}, indent=2))
+        ok = status.get("sliceHealth") == "Healthy"
+        print("RESULT:", "OK" if ok else "FAILED")
+        return 0 if ok else 1
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        srv.stop()
+        os.unlink(kubeconfig)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -158,6 +158,10 @@ def rbac_role() -> dict:
         {"apiGroups": ["datasciencepipelinesapplications.opendatahub.io"],
          "resources": ["datasciencepipelinesapplications"],
          "verbs": ["get", "list", "watch"]},
+        # leader election (main.py --enable-leader-election; reference
+        # leader-election RBAC in config/rbac/leader_election_role.yaml)
+        {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch"]},
     ]
     return {
         "apiVersion": "rbac.authorization.k8s.io/v1",
@@ -199,7 +203,10 @@ def manager_deployment(profile: str, image: str = "kubeflow-tpu-controller:lates
             "labels": {"app": "notebook-controller"},
         },
         "spec": {
-            "replicas": 1,
+            # two replicas double-reconcile without election; the manager
+            # runs --enable-leader-election so the standby is a hot spare
+            # (notebook-controller/main.go:91-93)
+            "replicas": 2,
             "selector": {"matchLabels": {"app": "notebook-controller"}},
             "template": {
                 "metadata": {"labels": {"app": "notebook-controller"}},
@@ -209,23 +216,42 @@ def manager_deployment(profile: str, image: str = "kubeflow-tpu-controller:lates
                         {
                             "name": "manager",
                             "image": image,
-                            "command": ["python", "-m", "kubeflow_tpu.main"],
+                            "command": [
+                                "python", "-m", "kubeflow_tpu.main",
+                                "--in-cluster",
+                                "--enable-leader-election",
+                                "--cert-dir",
+                                "/tmp/k8s-webhook-server/serving-certs",
+                            ],
                             "ports": [
                                 {"name": "metrics", "containerPort": 8080},
                                 {"name": "webhook", "containerPort": 9443},
                             ],
                             "livenessProbe": {
-                                "httpGet": {"path": "/healthz", "port": 8081}
+                                "httpGet": {"path": "/healthz", "port": 8080}
                             },
                             "readinessProbe": {
-                                "httpGet": {"path": "/readyz", "port": 8081}
+                                "httpGet": {"path": "/readyz", "port": 8080}
                             },
+                            "volumeMounts": [{
+                                "name": "serving-certs",
+                                "mountPath":
+                                    "/tmp/k8s-webhook-server/serving-certs",
+                                "readOnly": True,
+                            }],
                             "resources": {
                                 "requests": {"cpu": "100m", "memory": "128Mi"},
                                 "limits": {"cpu": "500m", "memory": "512Mi"},
                             },
                         }
                     ],
+                    "volumes": [{
+                        "name": "serving-certs",
+                        "secret": {
+                            "secretName": "notebook-controller-webhook-certs",
+                            "optional": True,
+                        },
+                    }],
                 },
             },
         },

@@ -8,13 +8,16 @@ from .errors import (
     ApiError,
     ConflictError,
     ForbiddenError,
+    GoneError,
     InvalidError,
     NotFoundError,
+    ServerError,
     is_already_exists,
     is_conflict,
     is_not_found,
     retry_on_conflict,
 )
+from .leader import LeaderElector
 from .events import EventRecorder
 from .meta import (
     KubeObject,
@@ -36,10 +39,13 @@ __all__ = [
     "EventType",
     "FakeCluster",
     "ForbiddenError",
+    "GoneError",
     "InvalidError",
     "KubeObject",
+    "LeaderElector",
     "Manager",
     "NotFoundError",
+    "ServerError",
     "ObjectMeta",
     "OwnerReference",
     "Reconciler",

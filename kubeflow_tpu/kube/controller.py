@@ -148,6 +148,17 @@ class Manager:
         """Manual enqueue (tests, resync ticks)."""
         self._enqueue(reg_name, req)
 
+    def watched_kinds(self) -> list[str]:
+        """Every kind any controller watches — the informer set a real-cluster
+        backend must stream (controller-runtime derives the same from
+        For/Owns/Watches wiring)."""
+        kinds: set[str] = set()
+        for reg in self._registrations:
+            kinds.add(reg.for_kind)
+            kinds.update(reg.owns)
+            kinds.update(spec.kind for spec in reg.watches)
+        return sorted(kinds)
+
     def enqueue_all(self, reg_name: Optional[str] = None) -> None:
         """Resync: enqueue every existing primary object (informer re-list)."""
         for reg in self._registrations:

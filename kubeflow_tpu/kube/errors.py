@@ -39,6 +39,19 @@ class ForbiddenError(ApiError):
     reason = "Forbidden"
 
 
+class GoneError(ApiError):
+    """HTTP 410: requested watch resourceVersion fell out of the history
+    window — the client must relist (client-go reflector does the same)."""
+
+    reason = "Expired"
+
+
+class ServerError(ApiError):
+    """Transport/5xx failure talking to a real apiserver."""
+
+    reason = "InternalError"
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, NotFoundError)
 

@@ -17,10 +17,13 @@ from dataclasses import dataclass
 from enum import Enum
 from typing import Callable, Iterable, Optional
 
+from collections import deque
+
 from .errors import (
     AlreadyExistsError,
     ConflictError,
     ForbiddenError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -78,18 +81,60 @@ class ApiServer:
         self._watchers: list[Callable[[WatchEvent], None]] = []
         self._mutating: list[AdmissionHook] = []
         self._validating: list[AdmissionHook] = []
+        # bounded event history so watches can resume from a resourceVersion
+        # (the apiserver's etcd watch cache; too-old rv -> 410 Gone and the
+        # client relists, exactly client-go reflector behavior)
+        self._history: deque[WatchEvent] = deque(maxlen=2048)
 
     # -- watch / admission registration --------------------------------------
     def watch(self, fn: Callable[[WatchEvent], None]) -> None:
         with self._lock:
             self._watchers.append(fn)
 
+    def unwatch(self, fn: Callable[[WatchEvent], None]) -> None:
+        with self._lock:
+            if fn in self._watchers:
+                self._watchers.remove(fn)
+
+    def subscribe(self, fn: Callable[[WatchEvent], None],
+                  since_rv: Optional[int] = None) -> None:
+        """Register a watcher, first replaying history newer than `since_rv`
+        atomically (no events can be missed between replay and live stream).
+        since_rv=None starts live-only; raises GoneError when since_rv
+        predates the retained window."""
+        with self._lock:
+            if since_rv is not None:
+                oldest_live = self._history[0].obj.metadata.resource_version \
+                    if self._history else self._rv_counter + 1
+                # since_rv older than both the window start and at least one
+                # evicted event means we cannot prove nothing was missed
+                if since_rv < oldest_live - 1 and len(self._history) == self._history.maxlen:
+                    raise GoneError(
+                        f"resourceVersion {since_rv} is too old "
+                        f"(history starts at {oldest_live})"
+                    )
+                for ev in self._history:
+                    if ev.obj.metadata.resource_version > since_rv:
+                        fn(WatchEvent(ev.type, ev.obj.deepcopy()))
+            self._watchers.append(fn)
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv_counter
+
     def register_admission(self, hook: AdmissionHook) -> None:
         with self._lock:
             (self._mutating if hook.mutating else self._validating).append(hook)
 
     def _notify(self, ev: WatchEvent) -> None:
-        for fn in list(self._watchers):
+        # history append + fan-out under the (reentrant) lock so subscribe()'s
+        # replay-then-register is atomic with live delivery; callbacks must
+        # only enqueue or re-enter this ApiServer (same thread, RLock-safe)
+        with self._lock:
+            self._history.append(WatchEvent(ev.type, ev.obj.deepcopy()))
+            watchers = list(self._watchers)
+        for fn in watchers:
             fn(WatchEvent(ev.type, ev.obj.deepcopy()))
 
     def _next_rv(self) -> int:
@@ -126,6 +171,18 @@ class ApiServer:
                 out.append(obj.deepcopy())
             return sorted(out, key=lambda o: (o.namespace, o.name))
 
+    def list_with_rv(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> tuple[list[KubeObject], int]:
+        """List + the cluster resourceVersion as one atomic snapshot, so a
+        list-then-watch client cannot miss events that land between the list
+        and reading the rv (the apiserver returns both in one response)."""
+        with self._lock:
+            return self.list(kind, namespace, label_selector), self._rv_counter
+
     # -- admission ------------------------------------------------------------
     def _admit(
         self, op: str, old: Optional[KubeObject], obj: KubeObject
@@ -142,16 +199,19 @@ class ApiServer:
 
     # -- writes ---------------------------------------------------------------
     def create(self, obj: KubeObject) -> KubeObject:
+        obj = obj.deepcopy()
         with self._lock:
-            obj = obj.deepcopy()
             if not obj.metadata.name and obj.metadata.generate_name:
                 self._name_counter += 1
                 obj.metadata.name = f"{obj.metadata.generate_name}{self._name_counter:05x}"
-            if not obj.metadata.name:
-                raise InvalidError("metadata.name or generateName required")
-            # admission first: a mutating hook may rewrite metadata, and the
-            # store must be keyed by the post-admission identity
-            obj = self._admit("CREATE", None, obj)
+        if not obj.metadata.name:
+            raise InvalidError("metadata.name or generateName required")
+        # admission OUTSIDE the store lock (as the apiserver runs webhook
+        # callouts outside the etcd txn): a remote AdmissionReview handler may
+        # re-enter this ApiServer from another thread.  Mutating hooks may
+        # rewrite metadata, and the store must key the post-admission identity.
+        obj = self._admit("CREATE", None, obj)
+        with self._lock:
             key = (obj.metadata.namespace, obj.metadata.name)
             kind_store = self._objects.setdefault(obj.kind, {})
             if key in kind_store:
@@ -174,41 +234,54 @@ class ApiServer:
         the /status subresource the reference writes via Status().Update()
         (notebook_controller.go:312).
         """
+        obj = obj.deepcopy()
+        key = (obj.metadata.namespace, obj.metadata.name)
         with self._lock:
-            obj = obj.deepcopy()
-            key = (obj.metadata.namespace, obj.metadata.name)
             kind_store = self._objects.setdefault(obj.kind, {})
             old = kind_store.get(key)
             if old is None:
                 raise NotFoundError(f"{obj.kind} {key[0]}/{key[1]} not found")
-            if not obj.metadata.resource_version:
-                raise InvalidError(
-                    f"{obj.kind} {key[0]}/{key[1]}: resourceVersion must be "
-                    "specified for an update (read-modify-write required)"
-                )
-            if obj.metadata.resource_version != old.metadata.resource_version:
-                raise ConflictError(
-                    f"{obj.kind} {key[0]}/{key[1]}: resourceVersion "
-                    f"{obj.metadata.resource_version} != {old.metadata.resource_version}"
-                )
-            if subresource == "status":
-                merged = old.deepcopy()
-                merged.body["status"] = copy.deepcopy(obj.body.get("status", {}))
+            old = old.deepcopy()
+        if not obj.metadata.resource_version:
+            raise InvalidError(
+                f"{obj.kind} {key[0]}/{key[1]}: resourceVersion must be "
+                "specified for an update (read-modify-write required)"
+            )
+        if obj.metadata.resource_version != old.metadata.resource_version:
+            raise ConflictError(
+                f"{obj.kind} {key[0]}/{key[1]}: resourceVersion "
+                f"{obj.metadata.resource_version} != {old.metadata.resource_version}"
+            )
+        if subresource == "status":
+            merged = old.deepcopy()
+            merged.body["status"] = copy.deepcopy(obj.body.get("status", {}))
+        else:
+            merged = obj
+            # status writes only through the status subresource
+            if "status" in old.body:
+                merged.body["status"] = copy.deepcopy(old.body["status"])
+            elif "status" in merged.body:
+                del merged.body["status"]
+            # admission outside the lock (see create()); the commit below
+            # re-checks the resourceVersion so a write that raced the
+            # callout still conflicts, matching apiserver semantics
+            merged = self._admit("UPDATE", old, merged)
+            # name/namespace are immutable on update; keep keying sound
+            merged.metadata.name = old.metadata.name
+            merged.metadata.namespace = old.metadata.namespace
+            if merged.body.get("spec") != old.body.get("spec"):
+                merged.metadata.generation = old.metadata.generation + 1
             else:
-                merged = obj
-                # status writes only through the status subresource
-                if "status" in old.body:
-                    merged.body["status"] = copy.deepcopy(old.body["status"])
-                elif "status" in merged.body:
-                    del merged.body["status"]
-                merged = self._admit("UPDATE", old, merged)
-                # name/namespace are immutable on update; keep keying sound
-                merged.metadata.name = old.metadata.name
-                merged.metadata.namespace = old.metadata.namespace
-                if merged.body.get("spec") != old.body.get("spec"):
-                    merged.metadata.generation = old.metadata.generation + 1
-                else:
-                    merged.metadata.generation = old.metadata.generation
+                merged.metadata.generation = old.metadata.generation
+        with self._lock:
+            current = self._objects.get(obj.kind, {}).get(key)
+            if current is None:
+                raise NotFoundError(f"{obj.kind} {key[0]}/{key[1]} not found")
+            if current.metadata.resource_version != old.metadata.resource_version:
+                raise ConflictError(
+                    f"{obj.kind} {key[0]}/{key[1]}: object changed during "
+                    "admission"
+                )
             # immutable fields
             merged.metadata.uid = old.metadata.uid
             merged.metadata.creation_timestamp = old.metadata.creation_timestamp
@@ -235,15 +308,21 @@ class ApiServer:
     ) -> KubeObject:
         """RFC 7386 merge patch; `None` values delete keys.  Used by the ODH
         controller's lock removal (merge-patch with null annotation value,
-        odh notebook_controller.go:516-523).  Holds the (reentrant) lock
-        across read+write: a merge patch never conflicts, matching the
-        apiserver."""
-        with self._lock:
+        odh notebook_controller.go:516-523).  Retries internally on conflict
+        so callers never see one — the apiserver does the same for patch
+        requests (it re-reads and re-applies server-side)."""
+        last: Exception | None = None
+        for _ in range(16):
             current = self.get(kind, namespace, name)
             merged_dict = _json_merge(current.to_dict(), patch)
             merged = KubeObject.from_dict(merged_dict)
             merged.metadata.resource_version = current.metadata.resource_version
-            return self.update(merged)
+            try:
+                return self.update(merged)
+            except ConflictError as err:
+                last = err
+        assert last is not None
+        raise last
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
@@ -269,6 +348,9 @@ class ApiServer:
             obj = self._objects.get(kind, {}).pop((namespace, name), None)
             if obj is None:
                 return
+            # deletion bumps the cluster resourceVersion (as in etcd) so the
+            # DELETED watch event is ordered in the history window
+            obj.metadata.resource_version = self._next_rv()
         self._notify(WatchEvent(EventType.DELETED, obj.deepcopy()))
         self._garbage_collect(obj)
 

@@ -7,9 +7,16 @@ the reference papers over with the lock annotation (SURVEY.md §7 hard
 parts).  Flags mirror the reference; env vars are the config surface
 (utils/config.py).
 
-Without a real cluster this runs standalone against the in-memory API server
-with the fake data plane — the `--demo` mode used by examples/ and the load
-test; the HTTP side (healthz/readyz/metrics) is real either way.
+Backends:
+- real cluster: `--kubeconfig PATH` or `--in-cluster` builds a KubeClient
+  speaking the Kubernetes REST API (watches, optimistic concurrency, status
+  subresource), starts informers for every watched kind, serves the
+  admission webhooks over HTTPS (--webhook-port/--cert-dir, odh
+  main.go:285-311), and optionally gates on Lease leader election
+  (--enable-leader-election, main.go:91-93).
+- standalone: the in-memory API server with the fake data plane — the
+  `--demo` mode used by examples/ and the load test.
+The healthz/readyz/metrics HTTP side is real either way.
 """
 
 from __future__ import annotations
@@ -18,6 +25,8 @@ import argparse
 import http.server
 import json
 import logging
+import os
+import socket
 import threading
 import time
 from typing import Optional
@@ -26,7 +35,7 @@ from .api.types import Notebook, TPUSpec
 from .core.culling_controller import setup_culling
 from .core.metrics import NotebookMetrics
 from .core.notebook_controller import setup_core_controllers
-from .kube import ApiServer, FakeCluster, Manager
+from .kube import ApiServer, FakeCluster, LeaderElector, Manager
 from .utils.config import CoreConfig, OdhConfig
 
 
@@ -74,7 +83,9 @@ def serve_http(port: int, manager: Manager, metrics: NotebookMetrics):
         (HealthAndMetricsHandler,),
         {"manager": manager, "metrics": metrics},
     )
-    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+    # all interfaces: the kubelet probes the pod IP and Prometheus scrapes
+    # :8080 from outside the pod (reference serves metrics the same way)
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server
@@ -84,10 +95,16 @@ def build_manager(
     core_cfg: Optional[CoreConfig] = None,
     odh_cfg: Optional[OdhConfig] = None,
     with_fake_cluster: bool = True,
+    api=None,
 ):
-    """Wire the full stack; returns (manager, api, cluster, metrics)."""
-    api = ApiServer()
-    cluster = FakeCluster(api) if with_fake_cluster else None
+    """Wire the full stack; returns (manager, api, cluster, metrics).
+
+    `api` may be a KubeClient (real cluster) or None (in-memory standalone);
+    both expose the same read/write/watch surface."""
+    real_cluster = api is not None
+    if api is None:
+        api = ApiServer()
+    cluster = FakeCluster(api) if (with_fake_cluster and not real_cluster) else None
     mgr = Manager(api)
     core_cfg = core_cfg or CoreConfig.from_env()
     odh_cfg = odh_cfg or OdhConfig.from_env()
@@ -121,10 +138,68 @@ def build_manager(
     return mgr, api, cluster, metrics
 
 
+def build_real_backend(args):
+    """KubeClient from --kubeconfig/--in-cluster with qps/burst knobs
+    (notebook-controller/main.go:71-89)."""
+    from .kube.client import KubeClient, RestConfig
+
+    if args.kubeconfig:
+        cfg = RestConfig.from_kubeconfig(args.kubeconfig)
+    else:
+        cfg = RestConfig.in_cluster()
+    cfg.qps = args.qps
+    cfg.burst = args.burst
+    return KubeClient(cfg)
+
+
+def start_webhook_server(api, args):
+    """Serve collected AdmissionHooks over HTTPS (odh main.go:285-311).
+    Certs come from --cert-dir (tls.crt/tls.key, the serving-cert layout);
+    absent certs are minted dev-style like envtest."""
+    hooks = getattr(api, "admission_hooks", None)
+    if not hooks or args.webhook_port < 0:
+        return None
+    from .odh.webhook_server import AdmissionReviewServer
+
+    cert = os.path.join(args.cert_dir, "tls.crt") if args.cert_dir else ""
+    if cert and os.path.exists(cert):
+        server = AdmissionReviewServer(
+            hooks, cert_file=cert,
+            key_file=os.path.join(args.cert_dir, "tls.key"),
+            host="0.0.0.0", port=args.webhook_port)
+    else:
+        from .kube.certs import mint_serving_cert
+
+        logging.warning("no serving certs in %r; minting a self-signed pair",
+                        args.cert_dir)
+        server = AdmissionReviewServer(
+            hooks, bundle=mint_serving_cert(),
+            host="0.0.0.0", port=args.webhook_port)
+    server.start()
+    logging.info("webhook server on %s", server.url)
+    return server
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="kubeflow-tpu notebook controller")
     parser.add_argument("--metrics-addr", type=int, default=8080,
                         help="port for /metrics + health endpoints")
+    parser.add_argument("--kubeconfig", default="",
+                        help="path to a kubeconfig; reconcile that cluster")
+    parser.add_argument("--in-cluster", action="store_true",
+                        help="use the ServiceAccount token mount")
+    parser.add_argument("--qps", type=float, default=0.0,
+                        help="client-side request rate limit (0 = unlimited)")
+    parser.add_argument("--burst", type=int, default=0,
+                        help="client-side burst size")
+    parser.add_argument("--webhook-port", type=int, default=9443,
+                        help="admission webhook HTTPS port (-1 = disabled)")
+    parser.add_argument("--cert-dir", default="",
+                        help="dir with tls.crt/tls.key for the webhook server")
+    parser.add_argument("--enable-leader-election", action="store_true",
+                        help="gate reconciling on a coordination.k8s.io Lease")
+    parser.add_argument("--leader-election-namespace", default="",
+                        help="namespace for the election Lease")
     parser.add_argument("--demo", action="store_true",
                         help="create a sample TPU notebook and print state")
     parser.add_argument("--demo-topology", default="4x4")
@@ -138,12 +213,38 @@ def main(argv: Optional[list[str]] = None) -> int:
         level=logging.DEBUG if args.debug_log else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    mgr, api, cluster, metrics = build_manager()
+    real = bool(args.kubeconfig or args.in_cluster)
+    backend = build_real_backend(args) if real else None
+    mgr, api, cluster, metrics = build_manager(api=backend)
     if cluster is not None:
         cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
     server = serve_http(args.metrics_addr, mgr, metrics)
-    mgr.start()
-    logging.info("manager started; metrics on :%d", args.metrics_addr)
+    webhook_server = start_webhook_server(api, args) if real else None
+
+    def start_reconciling():
+        if real:
+            api.start_informers(mgr.watched_kinds())
+        mgr.start()
+        logging.info("manager started; metrics on :%d", args.metrics_addr)
+
+    elector: Optional[LeaderElector] = None
+    if args.enable_leader_election:
+        from .utils.config import OdhConfig as _Odh
+
+        elector = LeaderElector(
+            api,
+            lease_name="kubeflow-tpu-notebook-controller",
+            namespace=args.leader_election_namespace
+            or _Odh.from_env().controller_namespace,
+            identity=f"{socket.gethostname()}-{os.getpid()}",
+        )
+        elector.start_background(
+            on_started=start_reconciling,
+            on_stopped=mgr.stop,  # lost lease -> exit 1 -> pod restart
+        )
+        logging.info("leader election enabled; waiting for lease")
+    else:
+        start_reconciling()
 
     if args.demo and cluster is not None:
         tpu = TPUSpec(args.demo_accelerator, args.demo_topology)
@@ -176,7 +277,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if elector is not None:
+            elector.stop()
         mgr.stop()
+        if webhook_server is not None:
+            webhook_server.stop()
+        if real:
+            api.stop_informers()
         server.shutdown()
     return exit_code
 

@@ -14,6 +14,23 @@ from dataclasses import dataclass, field
 from typing import Mapping, Optional
 
 
+SA_NAMESPACE_FILE = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+
+
+def detect_namespace(default: str = "default") -> str:
+    """Controller namespace: K8S_NAMESPACE env var, else the in-cluster
+    ServiceAccount token mount, else `default` (odh main.go:127-139).
+    The single source of truth — kube.client re-exports this."""
+    ns = os.environ.get("K8S_NAMESPACE", "")
+    if ns:
+        return ns
+    try:
+        with open(SA_NAMESPACE_FILE) as f:
+            return f.read().strip() or default
+    except OSError:
+        return default
+
+
 def _bool(env: Mapping[str, str], key: str, default: bool) -> bool:
     v = env.get(key)
     if v is None:
@@ -95,7 +112,10 @@ class OdhConfig:
             gateway_url=env.get("GATEWAY_URL", ""),
             gateway_name=env.get("NOTEBOOK_GATEWAY_NAME", "data-science-gateway"),
             gateway_namespace=env.get("NOTEBOOK_GATEWAY_NAMESPACE", "openshift-ingress"),
-            controller_namespace=env.get("K8S_NAMESPACE", "opendatahub"),
+            # namespace detection: K8S_NAMESPACE, else the in-cluster SA
+            # mount, else the dev default (odh main.go:127-139)
+            controller_namespace=env.get("K8S_NAMESPACE", "")
+            or detect_namespace("opendatahub"),
             kube_rbac_proxy_image=env.get("KUBE_RBAC_PROXY_IMAGE", "kube-rbac-proxy:latest"),
             tpu_default_image=env.get("TPU_DEFAULT_IMAGE", "jupyter-tpu-jax:latest"),
         )

@@ -1,0 +1,98 @@
+"""Lease-based leader election (notebook-controller/main.go:91-93,
+odh main.go:221-222): two managers, one reconciles; failover on expiry."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubeflow_tpu.kube import ApiServer
+from kubeflow_tpu.kube.client import KubeClient, RestConfig
+from kubeflow_tpu.kube.leader import LeaderElector
+from kubeflow_tpu.kube.wire import KubeApiWireServer
+from kubeflow_tpu.utils.clock import FakeClock
+
+
+def make_elector(api, ident, clock, **kw):
+    return LeaderElector(
+        api, lease_name="test-mgr", namespace="system", identity=ident,
+        lease_duration_s=15, renew_period_s=10, clock=clock, **kw)
+
+
+class TestLeaderElection:
+    def test_first_candidate_acquires(self):
+        api, clock = ApiServer(), FakeClock()
+        a = make_elector(api, "mgr-a", clock)
+        assert a.try_acquire_or_renew()
+        lease = api.get("Lease", "system", "test-mgr")
+        assert lease.body["spec"]["holderIdentity"] == "mgr-a"
+
+    def test_second_candidate_blocked_while_lease_fresh(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_elector(api, "mgr-a", clock), make_elector(api, "mgr-b", clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        clock.advance(10)  # within the 15s lease: a renews, b still blocked
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+
+    def test_failover_after_expiry(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_elector(api, "mgr-a", clock), make_elector(api, "mgr-b", clock)
+        assert a.try_acquire_or_renew()
+        clock.advance(16)  # a died: no renew for > leaseDuration
+        assert b.try_acquire_or_renew(), "stale lease must be taken over"
+        lease = api.get("Lease", "system", "test-mgr")
+        assert lease.body["spec"]["holderIdentity"] == "mgr-b"
+        assert lease.body["spec"]["leaseTransitions"] == 1
+        # the deposed leader observes it lost
+        assert not a.try_acquire_or_renew()
+
+    def test_graceful_release_enables_immediate_takeover(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_elector(api, "mgr-a", clock), make_elector(api, "mgr-b", clock)
+        assert a.try_acquire_or_renew()
+        a.release()
+        assert b.try_acquire_or_renew(), \
+            "released lease (zeroed renewTime) is immediately acquirable"
+
+    def test_election_over_the_wire(self):
+        """The same protocol against a real-socket apiserver."""
+        api = ApiServer()
+        srv = KubeApiWireServer(api).start()
+        try:
+            clock = FakeClock()
+            client_a = KubeClient(RestConfig(server=srv.url))
+            client_b = KubeClient(RestConfig(server=srv.url))
+            a = make_elector(client_a, "mgr-a", clock)
+            b = make_elector(client_b, "mgr-b", clock)
+            assert a.try_acquire_or_renew()
+            assert not b.try_acquire_or_renew()
+            clock.advance(20)
+            assert b.try_acquire_or_renew()
+        finally:
+            srv.stop()
+
+    def test_background_run_invokes_callbacks(self):
+        api = ApiServer()
+        started, stopped = [], []
+        elector = LeaderElector(api, "test-mgr", "system", "solo",
+                                lease_duration_s=0.5, renew_period_s=0.05,
+                                retry_period_s=0.05)
+        elector.start_background(lambda: started.append(1),
+                                 lambda: stopped.append(1))
+        deadline = time.time() + 5
+        while not started and time.time() < deadline:
+            time.sleep(0.01)
+        assert started, "elector never started leading"
+        # usurp the lease out from under it -> on_stopped must fire
+        lease = api.get("Lease", "system", "test-mgr")
+        lease.body["spec"]["holderIdentity"] = "other"
+        lease.body["spec"]["renewTime"] = "2099-01-01T00:00:00.000000Z"
+        api.update(lease)
+        deadline = time.time() + 5
+        while not stopped and time.time() < deadline:
+            time.sleep(0.01)
+        elector.stop()
+        assert stopped, "losing the lease must invoke on_stopped_leading"
