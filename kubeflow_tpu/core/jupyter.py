@@ -29,11 +29,20 @@ class HttpJupyterClient:
     """Production transport (getNotebookResourceResponse, :244-274): in-cluster
     Service DNS, or the kubectl proxy path under DEV."""
 
-    def __init__(self, cluster_domain: str = "cluster.local", dev: bool = False):
+    def __init__(self, cluster_domain: str = "cluster.local", dev: bool = False,
+                 base_url: str = ""):
         self.cluster_domain = cluster_domain
         self.dev = dev
+        # base_url overrides host resolution (a third transport next to
+        # in-cluster DNS and the DEV kubectl-proxy path): tests and
+        # port-forward setups point it at a concrete host:port while keeping
+        # the /notebook/{ns}/{name} path contract
+        self.base_url = base_url.rstrip("/")
 
     def _url(self, name: str, namespace: str, resource: str) -> str:
+        if self.base_url:
+            return (f"{self.base_url}/notebook/{namespace}/{name}"
+                    f"/api/{resource}")
         if self.dev:
             # port name must match generate_service's "http-notebook" (the
             # reference's dev path addresses "http-{name}", which only works

@@ -32,6 +32,11 @@ class TransformerConfig:
     logits_softcap: float = 0.0      # gemma-style tanh softcap; 0 = off
     loss_chunks: int = 0             # >0: chunked CE — never materializes
                                      # the full [tokens, vocab] fp32 logits
+    remat_policy: str = "nothing"    # nothing | dots | none — what the
+                                     # per-layer checkpoint may keep (see
+                                     # models.transformer._REMAT_POLICIES)
+    flash_block_q: int = 0           # Pallas flash tile sizes; 0 = kernel
+    flash_block_k: int = 0           # defaults (tuned per-chip in bench)
 
     def with_(self, **kw) -> "TransformerConfig":
         return replace(self, **kw)

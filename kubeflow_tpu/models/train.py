@@ -48,13 +48,18 @@ def default_optimizer(
     total_steps: int = 10_000,
     weight_decay: float = 0.1,
     max_grad_norm: float = 1.0,
+    mu_dtype: Optional[str] = None,
 ) -> optax.GradientTransformation:
+    """AdamW with warmup-cosine.  mu_dtype="bfloat16" halves the
+    first-moment HBM (the second moment stays fp32 for numerics) — the
+    standard knob for fitting bigger batches on one chip."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
     return optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
-        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 

@@ -27,6 +27,17 @@ from ..ops.attention import attention
 from ..ops.ring_attention import ring_attention
 from .configs import TransformerConfig
 
+# What each layer's checkpoint may keep across fwd->bwd (HBM-for-FLOPs
+# dial; MaxText exposes the same choice as remat_policy):
+#   nothing — recompute everything (min HBM, max recompute)
+#   dots    — keep matmul outputs with no batch dims (weights-side products)
+#   none    — save all residuals (no recompute; only fits small models)
+_REMAT_POLICIES = {
+    "nothing": lambda: jax.checkpoint_policies.nothing_saveable,
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "none": lambda: jax.checkpoint_policies.everything_saveable,
+}
+
 
 def _dtype(name: str):
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
@@ -126,7 +137,9 @@ class Attention(nn.Module):
             out = ring_attention(q, k, v, self.mesh, causal=True)
         else:
             impl = cfg.attention_impl if cfg.attention_impl != "ring" else "auto"
-            out = attention(q, k, v, causal=True, impl=impl)
+            out = attention(q, k, v, causal=True, impl=impl,
+                            block_q=cfg.flash_block_q,
+                            block_k=cfg.flash_block_k)
         out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "kv"))
         return _dense(
             cfg.embed_dim, ("heads", "kv", "embed"), "out",
@@ -195,7 +208,7 @@ class Transformer(nn.Module):
                 DecoderLayer,
                 prevent_cse=not cfg.scan_layers,
                 static_argnums=(),
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=_REMAT_POLICIES[cfg.remat_policy](),
             )
         if cfg.scan_layers:
             x, _ = nn.scan(

@@ -67,16 +67,38 @@ def _pallas_flash():
     return kernel
 
 
+@functools.cache
+def _block_sizes(block_q: int, block_k: int, q_len: int, kv_len: int):
+    """Pallas tile config; clamped to the sequence so short sequences and
+    tuned tiles compose.  The same tiling is used for the dq/dkv backward
+    passes — one knob pair, applied consistently."""
+    if not block_q and not block_k:
+        return None
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    bq = min(block_q or 512, q_len)
+    bk = min(block_k or 512, kv_len)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk,
+        block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
+    block_q: int = 0,
+    block_k: int = 0,
 ) -> jax.Array:
     """Pallas TPU flash attention (expects [b, h, s, d]; we carry
     [b, s, h, d] and transpose at the boundary — XLA folds the transposes
-    into the surrounding copies)."""
+    into the surrounding copies).  block_q/block_k override the kernel's
+    default VMEM tiling (0 = kernel default)."""
     *_, head_dim = q.shape
     scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
     k = _repeat_kv(k, q.shape[2])
@@ -87,6 +109,7 @@ def flash_attention(
         v.transpose(0, 2, 1, 3),
         causal=causal,
         sm_scale=scale,
+        block_sizes=_block_sizes(block_q, block_k, q.shape[1], k.shape[1]),
     )
     return out.transpose(0, 2, 1, 3)
 
@@ -98,6 +121,8 @@ def attention(
     causal: bool = True,
     impl: str = "auto",
     softmax_scale: Optional[float] = None,
+    block_q: int = 0,
+    block_k: int = 0,
 ) -> jax.Array:
     """Dispatch: flash on TPU when the shape fits the kernel's tiling
     (seq multiple of the 128-lane block, head_dim >= 128-friendly), else XLA.
@@ -107,7 +132,9 @@ def attention(
         seq_ok = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
         impl = "flash" if (on_tpu and seq_ok) else "xla"
     if impl == "flash":
-        return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale,
+                               block_q=block_q, block_k=block_k)
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
     raise ValueError(f"unknown attention impl {impl!r}")

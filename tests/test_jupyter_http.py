@@ -1,0 +1,195 @@
+"""The real Jupyter HTTP probe path, exercised against a live server.
+
+Round 1 only ever drove culling through FakeJupyterState; here a local HTTP
+server speaks the actual kernels/terminals REST shapes
+(culling_controller.go:244-336, KernelStatus :63-85) and
+`HttpJupyterClient` probes it over a real socket — including the culling
+end-to-end: probe -> idle -> stop annotation -> STS to 0 (the flow the
+reference verifies on a live cluster, odh e2e/notebook_creation_test.go:31-83).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.culling_controller import setup_culling
+from kubeflow_tpu.core.jupyter import HttpJupyterClient
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+
+def iso(t: float) -> str:
+    import time as _time
+
+    return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(t))
+
+
+class JupyterServer:
+    """Speaks GET /notebook/{ns}/{name}/api/{kernels|terminals}."""
+
+    def __init__(self):
+        self.kernels: dict[tuple[str, str], object] = {}
+        self.terminals: dict[tuple[str, str], object] = {}
+        self.status_code = 200
+        self.raw_body: bytes | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                parts = [p for p in self.path.split("/") if p]
+                # notebook/{ns}/{name}/api/{resource}
+                if len(parts) != 5 or parts[0] != "notebook" or parts[3] != "api":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                ns, name, resource = parts[1], parts[2], parts[4]
+                store = outer.kernels if resource == "kernels" else outer.terminals
+                body = (outer.raw_body if outer.raw_body is not None
+                        else json.dumps(store.get((ns, name), [])).encode())
+                self.send_response(outer.status_code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def server():
+    srv = JupyterServer()
+    yield srv
+    srv.stop()
+
+
+class TestHttpJupyterClient:
+    def test_parses_kernels_over_http(self, server):
+        server.kernels[("user1", "wb")] = [{
+            "id": "k1", "name": "python3",
+            "last_activity": "2026-07-29T10:00:00.533016Z",
+            "execution_state": "idle", "connections": 1,
+        }]
+        client = HttpJupyterClient(base_url=server.url)
+        kernels = client.get_kernels("wb", "user1")
+        assert kernels is not None and kernels[0]["execution_state"] == "idle"
+        assert client.get_terminals("wb", "user1") == []
+
+    def test_non_200_returns_none(self, server):
+        server.status_code = 503
+        client = HttpJupyterClient(base_url=server.url)
+        assert client.get_kernels("wb", "user1") is None
+
+    def test_malformed_json_returns_none(self, server):
+        server.raw_body = b"{not json"
+        client = HttpJupyterClient(base_url=server.url)
+        assert client.get_kernels("wb", "user1") is None
+
+    def test_non_list_json_returns_none(self, server):
+        server.raw_body = b'{"message": "forbidden"}'
+        client = HttpJupyterClient(base_url=server.url)
+        assert client.get_kernels("wb", "user1") is None
+
+    def test_unreachable_server_returns_none(self):
+        client = HttpJupyterClient(base_url="http://127.0.0.1:1")
+        assert client.get_kernels("wb", "user1") is None
+
+
+class TestCullingOverHttp:
+    """probe -> idle -> stop annotation -> STS 0, with the production HTTP
+    transport end to end."""
+
+    @pytest.fixture()
+    def env(self, server):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1", allocatable={"cpu": "32", "memory": "64Gi"})
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                         idleness_check_period_min=1)
+        setup_core_controllers(mgr, cfg)
+        jupyter = HttpJupyterClient(base_url=server.url)
+        setup_culling(mgr, cfg, jupyter=jupyter)
+        return api, mgr, clock
+
+    def test_active_notebook_not_culled(self, server, env):
+        api, mgr, clock = env
+        server.kernels[("user1", "wb")] = [{
+            "id": "k1", "name": "python3",
+            "last_activity": iso(clock.now()),
+            "execution_state": "busy", "connections": 1,
+        }]
+        api.create(Notebook.new("wb", "user1").obj)
+        mgr.run_until_idle()
+        clock.advance(120)
+        # keep the kernel's activity fresh as time advances
+        server.kernels[("user1", "wb")][0]["last_activity"] = iso(clock.now())
+        mgr.run_until_idle()
+        nb = api.get("Notebook", "user1", "wb")
+        assert C.STOP_ANNOTATION not in nb.annotations
+        assert api.get("StatefulSet", "user1", "wb").spec["replicas"] == 1
+
+    def test_idle_notebook_culled_to_zero(self, server, env):
+        api, mgr, clock = env
+        t0 = clock.now()
+        server.kernels[("user1", "wb")] = [{
+            "id": "k1", "name": "python3",
+            "last_activity": iso(t0),
+            "execution_state": "idle", "connections": 0,
+        }]
+        api.create(Notebook.new("wb", "user1").obj)
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "wb").spec["replicas"] == 1
+        # idle past CULL_IDLE_TIME (60 min), probed each check period
+        for _ in range(65):
+            mgr.advance(60)
+        nb = api.get("Notebook", "user1", "wb")
+        assert C.STOP_ANNOTATION in nb.annotations, "idle notebook not culled"
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "wb").spec["replicas"] == 0
+
+    def test_probe_failure_leaves_activity_stale_then_culls(self, server, env):
+        """Reference parity: a failed probe does NOT refresh last-activity
+        (updateTimestampFromKernelsActivity returns early on empty/nil,
+        culling_controller.go:382-385), so a notebook whose Jupyter API is
+        unreachable for longer than CULL_IDLE_TIME is culled — but not
+        before the idle window expires."""
+        api, mgr, clock = env
+        server.status_code = 500  # jupyter unreachable
+        api.create(Notebook.new("wb", "user1").obj)
+        mgr.run_until_idle()
+        # within the window: still running
+        for _ in range(30):
+            mgr.advance(60)
+        assert api.get("StatefulSet", "user1", "wb").spec["replicas"] == 1
+        # past CULL_IDLE_TIME with no successful probe: culled
+        for _ in range(35):
+            mgr.advance(60)
+        nb = api.get("Notebook", "user1", "wb")
+        assert C.STOP_ANNOTATION in nb.annotations
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "wb").spec["replicas"] == 0

@@ -1,0 +1,294 @@
+"""The reference's envtest scenario catalog, ported.
+
+Scenario families pinned by the reference's ~2k-line integration spec and
+Makefile that round 1 did not cover (VERDICT #5):
+- recreate-on-delete + drift-restore for EVERY owned object kind
+  (odh notebook_controller_test.go:152,658,955)
+- the SET_PIPELINE_RBAC=false/true double suite run (odh Makefile:112-117)
+- long-name notebooks through the routing plane (:556 — 48-char name)
+- the full kube-rbac-proxy object set end to end (:995-1230)
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.odh import constants as C
+from kubeflow_tpu.odh.controller import setup_odh_controllers
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
+
+CENTRAL_NS = "opendatahub"
+
+
+def build_env(odh_cfg: OdhConfig | None = None):
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    mgr = Manager(api, clock=FakeClock())
+    cfg = odh_cfg or OdhConfig(controller_namespace=CENTRAL_NS)
+    setup_core_controllers(mgr, CoreConfig())
+    setup_odh_controllers(mgr, cfg)
+    return api, cluster, mgr, cfg
+
+
+@pytest.fixture()
+def env():
+    return build_env()
+
+
+def create_nb(api, mgr, name="wb", ns="user1", annotations=None, labels=None):
+    nb = Notebook.new(name, ns, annotations=annotations)
+    if labels:
+        nb.obj.metadata.labels.update(labels)
+    api.create(nb.obj)
+    mgr.run_until_idle()
+    return nb
+
+
+# -- recreate-on-delete for every owned kind ----------------------------------
+
+# (kind, namespace-template, name-template) for each object the controllers
+# own for a plain notebook; {ns}/{name} are the notebook's coordinates
+OWNED_OBJECTS = [
+    ("StatefulSet", "{ns}", "{name}"),
+    ("Service", "{ns}", "{name}"),
+    ("ConfigMap", "{ns}", C.WORKBENCH_TRUSTED_CA_BUNDLE_CONFIGMAP),
+    ("NetworkPolicy", "{ns}", "{name}-ctrl-np"),
+    ("NetworkPolicy", "{ns}",
+     "{name}" + C.KUBE_RBAC_PROXY_NETWORK_POLICY_SUFFIX),
+    ("HTTPRoute", CENTRAL_NS, "nb-{ns}-{name}"),
+    ("ReferenceGrant", "{ns}", C.REFERENCEGRANT_NAME),
+]
+
+
+class TestRecreateOnDelete:
+    """Level-triggered recovery: every owned object comes back after a manual
+    delete (reference asserts this per kind, e.g. :152 HTTPRoute, :658
+    second-notebook HTTPRoute, :955 NetworkPolicy)."""
+
+    @pytest.fixture()
+    def populated(self, env):
+        api, _, mgr, _ = env
+        # CA bundle source (must hold a structurally valid PEM cert — the
+        # builder PEM-validates, ca_bundle.valid_pem_certificate) in the
+        # NOTEBOOK namespace, where the reference reads it
+        from kubeflow_tpu.kube import KubeObject, ObjectMeta
+        from kubeflow_tpu.kube.certs import mint_serving_cert
+
+        api.create(KubeObject(
+            "v1", "ConfigMap",
+            ObjectMeta(name=C.ODH_TRUSTED_CA_BUNDLE_CONFIGMAP,
+                       namespace="user1"),
+            body={"data": {
+                C.TRUSTED_CA_BUNDLE_FILE:
+                    mint_serving_cert().ca_cert_pem.decode()}}))
+        create_nb(api, mgr)
+        return api, mgr
+
+    @pytest.mark.parametrize("kind,ns_tpl,name_tpl", OWNED_OBJECTS,
+                             ids=[f"{k}:{n}" for k, _, n in OWNED_OBJECTS])
+    def test_object_recreated(self, populated, kind, ns_tpl, name_tpl):
+        api, mgr = populated
+        ns = ns_tpl.format(ns="user1", name="wb")
+        name = name_tpl.format(ns="user1", name="wb")
+        assert api.try_get(kind, ns, name) is not None, \
+            f"{kind} {ns}/{name} was never created"
+        api.delete(kind, ns, name)
+        mgr.run_until_idle()
+        assert api.try_get(kind, ns, name) is not None, \
+            f"{kind} {ns}/{name} not recreated after delete"
+
+    def test_statefulset_drift_restored(self, populated):
+        api, mgr = populated
+        sts = api.get("StatefulSet", "user1", "wb")
+        sts.spec["replicas"] = 7
+        api.update(sts)
+        mgr.run_until_idle()
+        assert api.get("StatefulSet", "user1", "wb").spec["replicas"] == 1
+
+    def test_all_owned_objects_garbage_collected_on_notebook_delete(
+            self, populated):
+        api, mgr = populated
+        api.delete("Notebook", "user1", "wb")
+        mgr.run_until_idle()
+        assert api.try_get("Notebook", "user1", "wb") is None
+        for kind, ns_tpl, name_tpl in OWNED_OBJECTS:
+            if name_tpl == C.WORKBENCH_TRUSTED_CA_BUNDLE_CONFIGMAP:
+                continue  # namespace-shared, not per-notebook
+            ns = ns_tpl.format(ns="user1", name="wb")
+            name = name_tpl.format(ns="user1", name="wb")
+            assert api.try_get(kind, ns, name) is None, \
+                f"{kind} {ns}/{name} leaked after notebook deletion"
+
+
+# -- SET_PIPELINE_RBAC both modes (odh Makefile:112-117) ----------------------
+
+
+class TestPipelineRbacBothModes:
+    def _run(self, enabled: bool):
+        api, _, mgr, _ = build_env(OdhConfig(
+            controller_namespace=CENTRAL_NS, set_pipeline_rbac=enabled))
+        if enabled:
+            # the Role the binding targets must exist (checkRoleExists,
+            # notebook_rbac.go:61-86)
+            from kubeflow_tpu.kube import KubeObject, ObjectMeta
+
+            api.create(KubeObject(
+                "rbac.authorization.k8s.io/v1", "Role",
+                ObjectMeta(name=C.PIPELINE_ROLE_NAME, namespace="user1"),
+                body={"rules": []}))
+        create_nb(api, mgr)
+        return api
+
+    def test_rolebinding_created_when_enabled(self):
+        api = self._run(True)
+        rb = api.try_get("RoleBinding", "user1", "elyra-pipelines-wb")
+        assert rb is not None
+        assert rb.body["roleRef"]["name"] == C.PIPELINE_ROLE_NAME
+        assert rb.body["subjects"][0]["name"] == "wb"
+
+    def test_no_rolebinding_when_disabled(self):
+        api = self._run(False)
+        assert api.try_get("RoleBinding", "user1", "elyra-pipelines-wb") is None
+
+
+# -- long-name notebooks through the routing plane (:556) ---------------------
+
+
+class TestLongNameRouting:
+    NAME_48 = "test-notebook-with-a-very-long-name-thats-48char"
+
+    def test_48char_name_routes_end_to_end(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, name=self.NAME_48)
+        route_name = f"nb-user1-{self.NAME_48}"
+        if len(route_name) <= 63:
+            route = api.get("HTTPRoute", CENTRAL_NS, route_name)
+        else:
+            routes = api.list("HTTPRoute", CENTRAL_NS,
+                              {"notebook-name": self.NAME_48})
+            assert len(routes) == 1
+            route = routes[0]
+        rule = route.spec["rules"][0]
+        assert rule["matches"][0]["path"]["value"] == \
+            f"/notebook/user1/{self.NAME_48}"
+        assert rule["backendRefs"][0]["name"] == self.NAME_48
+        grant = api.get("ReferenceGrant", "user1", C.REFERENCEGRANT_NAME)
+        assert grant.spec["from"][0]["namespace"] == CENTRAL_NS
+
+    def test_over_63_char_route_uses_generate_name_and_cleans_up(self, env):
+        api, _, mgr, _ = env
+        name = "n" * 60  # route prefix nb-user1- pushes it past 63
+        create_nb(api, mgr, name=name)
+        routes = api.list("HTTPRoute", CENTRAL_NS, {"notebook-name": name})
+        assert len(routes) == 1 and len(routes[0].name) <= 63
+        api.delete("Notebook", "user1", name)
+        mgr.run_until_idle()
+        assert api.list("HTTPRoute", CENTRAL_NS, {"notebook-name": name}) == []
+
+
+# -- kube-rbac-proxy full object set (:995-1230) ------------------------------
+
+
+class TestKubeRbacProxyObjectSet:
+    @pytest.fixture()
+    def auth_env(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr, name="auth-nb",
+                  annotations={C.ANNOTATION_INJECT_AUTH: "true"})
+        return api, mgr
+
+    def test_sidecar_injected(self, auth_env):
+        api, _ = auth_env
+        nb = api.get("Notebook", "user1", "auth-nb")
+        containers = nb.spec["template"]["spec"]["containers"]
+        sidecar = next(c for c in containers if c["name"] == "kube-rbac-proxy")
+        assert any(p["containerPort"] == C.KUBE_RBAC_PROXY_PORT
+                   for p in sidecar["ports"])
+
+    def test_dedicated_service_account(self, auth_env):
+        api, _ = auth_env
+        sa = api.get("ServiceAccount", "user1", "auth-nb")
+        assert sa.metadata.owner_references[0].name == "auth-nb"
+
+    def test_proxy_service_with_serving_cert(self, auth_env):
+        api, _ = auth_env
+        svc = api.get("Service", "user1",
+                      "auth-nb" + C.KUBE_RBAC_PROXY_SERVICE_SUFFIX)
+        assert svc.annotations[C.SERVING_CERT_ANNOTATION] == \
+            "auth-nb" + C.KUBE_RBAC_PROXY_TLS_SECRET_SUFFIX
+        assert svc.spec["ports"][0]["port"] == C.KUBE_RBAC_PROXY_PORT
+
+    def test_sar_configmap_scoped_to_notebook(self, auth_env):
+        api, _ = auth_env
+        cm = api.get("ConfigMap", "user1",
+                     "auth-nb" + C.KUBE_RBAC_PROXY_CONFIG_SUFFIX)
+        cfg = cm.body["data"][C.KUBE_RBAC_PROXY_CONFIG_FILE]
+        assert "resource: notebooks" in cfg
+        assert "name: auth-nb" in cfg
+
+    def test_cluster_role_binding_to_auth_delegator(self, auth_env):
+        api, _ = auth_env
+        crbs = [o for o in api.list("ClusterRoleBinding")
+                if "auth-nb" in o.name]
+        assert len(crbs) == 1
+        assert crbs[0].body["roleRef"]["name"] == "system:auth-delegator"
+
+    def test_route_targets_proxy_port(self, auth_env):
+        api, _ = auth_env
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-auth-nb")
+        backend = route.spec["rules"][0]["backendRefs"][0]
+        assert backend["port"] == C.KUBE_RBAC_PROXY_PORT
+        assert backend["name"] == "auth-nb" + C.KUBE_RBAC_PROXY_SERVICE_SUFFIX
+
+    def test_route_modification_restored(self, auth_env):
+        api, mgr = auth_env
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-auth-nb")
+        route.spec["rules"][0]["backendRefs"][0]["name"] = "hacked"
+        api.update(route)
+        mgr.run_until_idle()
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-auth-nb")
+        assert route.spec["rules"][0]["backendRefs"][0]["name"] == \
+            "auth-nb" + C.KUBE_RBAC_PROXY_SERVICE_SUFFIX
+
+    def test_proxy_objects_recreated_after_delete(self, auth_env):
+        api, mgr = auth_env
+        for kind, name in [
+            ("Service", "auth-nb" + C.KUBE_RBAC_PROXY_SERVICE_SUFFIX),
+            ("ConfigMap", "auth-nb" + C.KUBE_RBAC_PROXY_CONFIG_SUFFIX),
+        ]:
+            api.delete(kind, "user1", name)
+            mgr.run_until_idle()
+            assert api.try_get(kind, "user1", name) is not None, \
+                f"{kind} {name} not recreated"
+
+    def test_crb_cleaned_up_on_notebook_delete(self, auth_env):
+        api, mgr = auth_env
+        api.delete("Notebook", "user1", "auth-nb")
+        mgr.run_until_idle()
+        assert [o for o in api.list("ClusterRoleBinding")
+                if "auth-nb" in o.name] == []
+
+    def test_lock_removed_after_auth_objects_ready(self, auth_env):
+        api, _ = auth_env
+        nb = api.get("Notebook", "user1", "auth-nb")
+        assert C.STOP_ANNOTATION not in nb.annotations, \
+            "reconciliation lock must be removed once objects are ready"
+        sts = api.get("StatefulSet", "user1", "auth-nb")
+        assert sts.spec["replicas"] == 1
+
+    def test_auth_mode_switch_replaces_route(self, auth_env):
+        """Turning inject-auth off must swap the proxy route for the plain
+        one (EnsureConflictingHTTPRouteAbsent, notebook_route.go:268-325)."""
+        api, mgr = auth_env
+        api.merge_patch("Notebook", "user1", "auth-nb", {
+            "metadata": {"annotations": {C.ANNOTATION_INJECT_AUTH: "false"}}})
+        mgr.run_until_idle()
+        route = api.get("HTTPRoute", CENTRAL_NS, "nb-user1-auth-nb")
+        backend = route.spec["rules"][0]["backendRefs"][0]
+        assert backend["name"] == "auth-nb"
+        assert backend["port"] == 8888
