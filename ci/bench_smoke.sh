@@ -1,7 +1,11 @@
 #!/usr/bin/env bash
 # Bench smoke on whatever backend is present (CPU in CI): asserts bench.py
-# emits exactly one valid JSON line.
+# emits exactly one valid JSON line.  On TPU, first gate the bench hot path:
+# the Pallas flash kernel must match the XLA reference (fwd + grads) across
+# the block-size configs the bench uses — a tiling/numerics bug fails here
+# before any MFU number is recorded (ci/flash_numerics.py).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+python ci/flash_numerics.py
 out=$(python bench.py 2 2>/dev/null | grep '^{')
 echo "$out" | python -c 'import json,sys; d=json.load(sys.stdin); assert {"metric","value","unit","vs_baseline"} <= set(d), d; print("bench smoke ok:", d["metric"])'

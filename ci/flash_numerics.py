@@ -1,0 +1,101 @@
+"""Flash-attention numerics gate: Pallas kernel vs XLA reference on TPU.
+
+Run on the real chip before every bench (ci/bench_smoke.sh): for the shapes
+and block-size configs the bench hot path uses, assert forward outputs AND
+input gradients of `ops.attention.flash_attention` match `xla_attention`
+within bf16 tolerance.  Exits non-zero on mismatch so a kernel/tiling bug
+can never ship inside a tuned BENCH_CHIP config.
+
+The reference has no analog (its hot path is an HTTP probe); this is the
+TPU-native equivalent of pinning the data plane before tuning it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import flash_attention, xla_attention
+
+# (batch, seq, q_heads, kv_heads, head_dim) — BENCH_CHIP attention shape
+# (12 heads x 128) plus a GQA variant and a short-seq edge case.
+SHAPES = [
+    (2, 2048, 12, 12, 128),
+    (2, 1024, 16, 4, 128),
+    (2, 256, 4, 4, 128),
+]
+# block_q/block_k configs the MFU sweep explores (0 = kernel default).
+BLOCKS = [(0, 0), (256, 256), (512, 512), (1024, 1024), (512, 1024)]
+
+
+def _max_err(a: jax.Array, b: jax.Array) -> float:
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+def check(batch, seq, heads, kv_heads, head_dim, block_q, block_k) -> list[str]:
+    key = jax.random.PRNGKey(seq + heads + block_q)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    shape_q = (batch, seq, heads, head_dim)
+    shape_kv = (batch, seq, kv_heads, head_dim)
+    q = jax.random.normal(kq, shape_q, jnp.bfloat16)
+    k = jax.random.normal(kk, shape_kv, jnp.bfloat16)
+    v = jax.random.normal(kv, shape_kv, jnp.bfloat16)
+    cot = jax.random.normal(kg, shape_q, jnp.bfloat16)
+
+    def fwd_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=block_q, block_k=block_k)
+
+    def fwd_xla(q, k, v):
+        return xla_attention(q, k, v, causal=True)
+
+    out_f, vjp_f = jax.vjp(jax.jit(fwd_flash), q, k, v)
+    out_x, vjp_x = jax.vjp(jax.jit(fwd_xla), q, k, v)
+    grads_f = vjp_f(cot)
+    grads_x = vjp_x(cot)
+
+    # bf16 inputs, fp32 softmax accumulation in both paths: outputs agree to
+    # bf16 resolution; gradients accumulate one extra matmul of rounding.
+    failures = []
+    err = _max_err(out_f, out_x)
+    if err > 3e-2:
+        failures.append(f"fwd max_err={err:.4f}")
+    for name, gf, gx in zip("qkv", grads_f, grads_x):
+        err = _max_err(gf, gx)
+        if err > 6e-2:
+            failures.append(f"d{name} max_err={err:.4f}")
+    return failures
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print("flash numerics: no TPU backend, skipping (pallas kernel is TPU-only)")
+        return 0
+    bad = 0
+    for batch, seq, heads, kv_heads, head_dim in SHAPES:
+        for block_q, block_k in BLOCKS:
+            if block_q > seq or block_k > seq:
+                continue
+            failures = check(batch, seq, heads, kv_heads, head_dim, block_q, block_k)
+            tag = (
+                f"b{batch} s{seq} h{heads}/{kv_heads} d{head_dim} "
+                f"blocks=({block_q},{block_k})"
+            )
+            if failures:
+                bad += 1
+                print(f"FAIL {tag}: {'; '.join(failures)}")
+            else:
+                print(f"ok   {tag}")
+    if bad:
+        print(f"flash numerics: {bad} config(s) FAILED")
+        return 1
+    print("flash numerics: all configs match the XLA reference")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,20 @@ class TestAttention:
         g_ref = jax.grad(lambda a: jnp.sum(xla_attention(a, k, v) ** 2))(q)
         np.testing.assert_allclose(g_ring, g_ref, atol=1e-4)
 
+    def test_flash_block_sizes_clamped(self):
+        # the Pallas tile config must clamp to the sequence so short
+        # sequences and tuned tiles compose (ops/attention.py:_block_sizes);
+        # numerics across these configs are gated on the real chip by
+        # ci/flash_numerics.py
+        from kubeflow_tpu.ops.attention import _block_sizes
+
+        assert _block_sizes(0, 0, 2048, 2048) is None
+        bs = _block_sizes(512, 1024, 256, 256)
+        assert bs.block_q == 256 and bs.block_k == 256
+        bs = _block_sizes(256, 512, 2048, 2048)
+        assert (bs.block_q, bs.block_k, bs.block_k_major) == (256, 512, 512)
+        assert (bs.block_q_dq, bs.block_k_dkv) == (256, 512)
+
     def test_rope_rotation_invariance(self):
         # same relative offset -> same attention scores
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 1, 16))
