@@ -170,6 +170,20 @@ class Notebook:
         return Notebook(self.obj.deepcopy())
 
 
+def convert_notebook_dict(obj: dict, desired_api_version: str) -> dict:
+    """Dict-level conversion for the webhook server's /convert endpoint and
+    the wire apiserver's converter hook (reference: the CRD conversion
+    webhook, api/v1/notebook_conversion.go:25-69).  Preserves metadata —
+    uid/resourceVersion must survive conversion or optimistic concurrency
+    breaks on version-crossing clients."""
+    group, _, version = desired_api_version.partition("/")
+    if group != GROUP or not version:
+        raise InvalidError(
+            f"cannot convert {obj.get('apiVersion')!r} to "
+            f"{desired_api_version!r}: not a {GROUP} version")
+    return Notebook(KubeObject.from_dict(obj)).convert_to(version).obj.to_dict()
+
+
 def notebook_status(
     ready_replicas: int,
     conditions: list[dict],

@@ -273,16 +273,25 @@ class EventReemitReconciler:
     users see workload failures with `kubectl describe notebook`
     (notebook_controller.go:99-122, nbNameFromInvolvedObject :705)."""
 
+    # dedup window: a long-lived controller must not grow an unbounded UID
+    # set; Events past this window have long aged out of the queue (the
+    # apiserver TTLs them at 1h), so re-seeing one is a full relist — and
+    # re-emitting after a relist is level-triggered-correct, merely chatty
+    MAX_EMITTED = 8192
+
     def __init__(self, api: ApiServer, recorder: EventRecorder):
         self.api = api
         self.recorder = recorder
-        self._emitted: set[str] = set()
+        from collections import OrderedDict
+
+        self._emitted: "OrderedDict[str, None]" = OrderedDict()
 
     def reconcile(self, req: Request) -> Result:
         ev = self.api.try_get("Event", req.namespace, req.name)
         if ev is None:
             return Result()
         if ev.metadata.uid in self._emitted:
+            self._emitted.move_to_end(ev.metadata.uid)
             return Result()
         involved = ev.body.get("involvedObject", {})
         nb_name = self._notebook_for(req.namespace, involved)
@@ -291,7 +300,9 @@ class EventReemitReconciler:
         nb = self.api.try_get("Notebook", req.namespace, nb_name)
         if nb is None:
             return Result()
-        self._emitted.add(ev.metadata.uid)
+        self._emitted[ev.metadata.uid] = None
+        while len(self._emitted) > self.MAX_EMITTED:
+            self._emitted.popitem(last=False)
         self.recorder.event(
             nb,
             ev.body.get("type", "Normal"),

@@ -188,8 +188,13 @@ class KubeClient:
         self._ssl_ctx = self._build_ssl() if self._tls else None
 
     def _build_ssl(self) -> ssl.SSLContext:
+        """Verification is dropped ONLY on explicit opt-in
+        (insecure-skip-tls-verify), as in client-go; a kubeconfig without
+        certificate-authority data falls back to the system trust roots and
+        fails the handshake loudly rather than silently accepting any cert
+        while still sending the bearer token."""
         ctx = ssl.create_default_context(cafile=self.config.ca_file or None)
-        if self.config.insecure_skip_verify or not self.config.ca_file:
+        if self.config.insecure_skip_verify:
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
         if self.config.client_cert_file:
@@ -350,7 +355,12 @@ class KubeClient:
         self._informers.clear()
 
     def _informer_loop(self, inf: _Informer) -> None:
-        """List-then-watch reflector with relist on 410/stream end."""
+        """List-then-watch reflector.  A clean stream end (idle timeout,
+        server close) resumes the watch from the newest resourceVersion seen
+        on the stream, as client-go does; a full relist — which re-dispatches
+        ADDED for every object — happens only on 410 Gone or a transport
+        error, so controllers are not re-reconciling the whole cluster every
+        watch_timeout_s."""
         info = self.scheme_registry.by_kind(inf.kind)
         while not inf.stop.is_set():
             try:
@@ -368,9 +378,10 @@ class KubeClient:
                     if key not in fresh:
                         self._dispatch(WatchEvent(EventType.DELETED, gone))
                 inf.known = fresh
-                self._watch_stream(info, rv, inf)
+                while not inf.stop.is_set():
+                    rv = self._watch_stream(info, rv, inf)
             except GoneError:
-                continue  # relist immediately
+                continue  # history window lost: relist
             except ApiError as err:
                 logger.warning("informer %s: %s; backing off", inf.kind, err)
                 inf.stop.wait(1.0)
@@ -380,7 +391,9 @@ class KubeClient:
                 logger.exception("informer %s crashed; restarting", inf.kind)
                 inf.stop.wait(1.0)
 
-    def _watch_stream(self, info, rv: int, inf: _Informer) -> None:
+    def _watch_stream(self, info, rv: int, inf: _Informer) -> int:
+        """Stream watch events from `rv`; returns the newest resourceVersion
+        seen so the caller can resume without a relist."""
         qs = urlencode({"watch": "true", "resourceVersion": str(rv)})
         path = f"{info.collection_path(None)}?{qs}"
         self.limiter.acquire()
@@ -395,20 +408,25 @@ class KubeClient:
                 try:
                     line = resp.readline()
                 except (TimeoutError, OSError, http.client.HTTPException):
-                    return  # idle timeout or teardown: relist-and-rewatch
+                    return rv  # idle timeout or teardown: resume from rv
                 if not line:
-                    return  # server closed the stream
+                    return rv  # server closed the stream: resume from rv
                 line = line.strip()
                 if not line:
                     continue
                 ev = json.loads(line)
                 etype = EventType(ev["type"])
                 obj = KubeObject.from_dict(ev["object"])
+                try:
+                    rv = max(rv, int(obj.metadata.resource_version or 0))
+                except ValueError:
+                    pass  # opaque RV (a real apiserver may send one): keep last
                 if etype is EventType.DELETED:
                     inf.known.pop((obj.namespace, obj.name), None)
                 else:
                     inf.known[(obj.namespace, obj.name)] = obj
                 self._dispatch(WatchEvent(etype, obj))
+            return rv
         finally:
             inf.conn = None
             conn.close()

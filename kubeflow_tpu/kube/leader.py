@@ -43,6 +43,7 @@ class LeaderElector:
         lease_duration_s: float = 15.0,
         renew_period_s: float = 10.0,
         retry_period_s: float = 2.0,
+        renew_deadline_s: Optional[float] = None,
         clock: Optional[Clock] = None,
     ) -> None:
         self.api = api
@@ -52,6 +53,19 @@ class LeaderElector:
         self.lease_duration_s = lease_duration_s
         self.renew_period_s = renew_period_s
         self.retry_period_s = retry_period_s
+        # client-go requires RenewDeadline < LeaseDuration: the deposed
+        # leader must stop reconciling BEFORE a rival can legally take over
+        # (renew + duration elapsed), or the single-writer guarantee breaks
+        # for the gap.  The derived default leaves two retry rounds of
+        # margin, clamped so it stays < lease_duration for short leases.
+        if renew_deadline_s is None:
+            renew_deadline_s = max(lease_duration_s - 2 * retry_period_s,
+                                   lease_duration_s * 0.6)
+        elif renew_deadline_s >= lease_duration_s:
+            raise ValueError(
+                f"renew_deadline_s ({renew_deadline_s}) must be < "
+                f"lease_duration_s ({lease_duration_s})")
+        self.renew_deadline_s = renew_deadline_s
         self.clock = clock or Clock()
         self.is_leader = False
         self._stop = threading.Event()
@@ -146,19 +160,20 @@ class LeaderElector:
                     started = True
                     on_started_leading()
             elif started:
-                # a transient renew failure must not abdicate while our own
-                # lease is still valid — client-go retries until the renew
-                # deadline; give up only once the lease has actually expired
-                # (or another holder demonstrably took it, which surfaces as
-                # the expiry passing without a successful renew)
-                if self.clock.now() - last_ok > self.lease_duration_s:
-                    logger.error("leadership lost for %s", self.identity)
+                # a transient renew failure must not abdicate immediately —
+                # client-go retries until the renew DEADLINE, which is
+                # strictly shorter than the lease duration so we stop
+                # reconciling before any rival may legally take over
+                if self.clock.now() - last_ok > self.renew_deadline_s:
+                    logger.error("renew deadline passed; leadership lost "
+                                 "for %s", self.identity)
                     if on_stopped_leading:
                         on_stopped_leading()
                     return
                 logger.warning(
                     "lease renew failed for %s; retrying within the "
-                    "%.0fs lease window", self.identity, self.lease_duration_s)
+                    "%.0fs renew deadline", self.identity,
+                    self.renew_deadline_s)
             self._stop.wait(self.renew_period_s if leader
                             else self.retry_period_s)
         if started:

@@ -91,9 +91,21 @@ class Scheme:
         self._by_path: dict[tuple[str, str, str], ResourceInfo] = {}
         for info in _BUILTIN:
             self.register(info)
+        # Notebook serves three versions (reference CRD: v1 storage, all
+        # served — api/v1/notebook_types.go:65-68); the extra versions are
+        # path aliases so /apis/kubeflow.org/v1beta1/... routes, while
+        # by_kind (the storage version clients default to) stays v1.
+        for v in ("v1alpha1", "v1beta1"):
+            self.register_served(ResourceInfo("Notebook", "kubeflow.org", v,
+                                              "notebooks"))
 
     def register(self, info: ResourceInfo) -> None:
         self._by_kind[info.kind] = info
+        self._by_path[(info.group, info.version, info.plural)] = info
+
+    def register_served(self, info: ResourceInfo) -> None:
+        """Register an additional served version: routable by path, but not
+        the kind's storage/default version."""
         self._by_path[(info.group, info.version, info.plural)] = info
 
     def by_kind(self, kind: str) -> ResourceInfo:

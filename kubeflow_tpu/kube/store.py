@@ -225,7 +225,49 @@ class ApiServer:
             kind_store[key] = obj
             stored = obj.deepcopy()
         self._notify(WatchEvent(EventType.ADDED, stored))
+        # real k8s GC collects dependents whose owners are already gone (a
+        # reconciler racing a cascade delete can create one — the GC's
+        # attemptToDeleteItem handles exactly this); doing it synchronously
+        # at create keeps the in-memory cluster deterministic
+        self._collect_dangling_owners(stored)
         return stored
+
+    def _collect_dangling_owners(self, obj: KubeObject) -> None:
+        """Strip ownerReferences whose owner no longer exists (by uid);
+        delete the object outright when no live owner remains — the
+        delete-racing-recreate fence that real GC provides.  Runs only at
+        create, so a conflict must retry against fresh state here — a
+        swallowed conflict would leave a dangling ref forever (and turn a
+        later owner-deletion into a strip instead of a delete)."""
+        if not obj.metadata.owner_references:
+            return
+        for _ in range(16):
+            try:
+                current = self.get(obj.kind, obj.namespace, obj.name)
+            except NotFoundError:
+                return  # someone else deleted it; done
+            refs = current.metadata.owner_references
+            with self._lock:
+                live = [
+                    r for r in refs
+                    if (owner := self._objects.get(r.kind, {}).get(
+                        (current.namespace, r.name))) is not None
+                    and owner.metadata.uid == r.uid
+                    and owner.metadata.deletion_timestamp is None
+                ]
+            if len(live) == len(refs):
+                return
+            try:
+                if live:
+                    current.metadata.owner_references = live
+                    self.update(current)
+                else:
+                    self.delete(current.kind, current.namespace, current.name)
+                return
+            except NotFoundError:
+                return
+            except ConflictError:
+                continue  # concurrent writer; recompute from fresh state
 
     def update(self, obj: KubeObject, subresource: str = "") -> KubeObject:
         """Full-object update with optimistic concurrency.
@@ -304,18 +346,30 @@ class ApiServer:
         return self.update(obj, subresource="status")
 
     def merge_patch(
-        self, kind: str, namespace: str, name: str, patch: dict
+        self, kind: str, namespace: str, name: str, patch: dict,
+        view_out=None, view_in=None,
     ) -> KubeObject:
         """RFC 7386 merge patch; `None` values delete keys.  Used by the ODH
         controller's lock removal (merge-patch with null annotation value,
         odh notebook_controller.go:516-523).  Retries internally on conflict
         so callers never see one — the apiserver does the same for patch
-        requests (it re-reads and re-applies server-side)."""
+        requests (it re-reads and re-applies server-side).
+
+        view_out/view_in let the wire server apply the patch to a different
+        API-version VIEW of the object (convert out, merge, convert back) —
+        the apiserver's cross-version patch flow — without duplicating this
+        retry loop: view_out(dict)->dict runs before the merge, view_in
+        (KubeObject)->KubeObject after."""
         last: Exception | None = None
         for _ in range(16):
             current = self.get(kind, namespace, name)
-            merged_dict = _json_merge(current.to_dict(), patch)
+            base = current.to_dict()
+            if view_out is not None:
+                base = view_out(base)
+            merged_dict = _json_merge(base, patch)
             merged = KubeObject.from_dict(merged_dict)
+            if view_in is not None:
+                merged = view_in(merged)
             merged.metadata.resource_version = current.metadata.resource_version
             try:
                 return self.update(merged)

@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from .errors import ApiError, GoneError
+from .errors import ApiError, GoneError, ServerError
 from .meta import KubeObject
 from .resources import DEFAULT_SCHEME, ResourceInfo, Scheme
 from .store import ApiServer, WatchEvent, match_labels
@@ -116,6 +116,10 @@ class _WireHandler(BaseHTTPRequestHandler):
     api: ApiServer = None  # type: ignore[assignment]
     scheme: Scheme = None  # type: ignore[assignment]
     token: Optional[str] = None
+    # multi-version kinds: (obj_dict, desired_apiVersion) -> obj_dict.  A
+    # real apiserver calls the CRD's conversion webhook here; wiring a
+    # RemoteConverter (odh/webhook_server.py) reproduces that callout.
+    converter = None  # Optional[Callable[[dict, str], dict]]
 
     # -- plumbing -------------------------------------------------------------
     def log_message(self, *args):  # route through logging, not stderr
@@ -149,6 +153,20 @@ class _WireHandler(BaseHTTPRequestHandler):
         if rt is None:
             self._send_json(404, status_body(
                 404, "NotFound", f"unknown path {parsed.path}"))
+            return None
+        # an alias (non-storage) version is servable only through a
+        # conversion webhook; without one the version is not served —
+        # mislabeling storage objects would be worse than the 404
+        try:
+            storage = self.scheme.by_kind(rt.info.kind).api_version
+        except KeyError:
+            storage = rt.info.api_version
+        if rt.info.api_version != storage and self.converter is None:
+            self._send_json(404, status_body(
+                404, "NotFound",
+                f"version {rt.info.api_version} not served "
+                "(no conversion webhook configured)"))
+            return None
         return rt
 
     def _query(self) -> dict[str, str]:
@@ -161,6 +179,50 @@ class _WireHandler(BaseHTTPRequestHandler):
             return False
         return True
 
+    # -- version conversion ---------------------------------------------------
+    def _convert_out(self, d: dict, rt: "_Route") -> dict:
+        """Storage version -> the version the request path asked for."""
+        desired = rt.info.api_version
+        if self.converter is None or d.get("apiVersion") == desired:
+            return d
+        try:
+            return type(self).converter(d, desired)
+        except Exception as err:  # conversion webhook failure -> 500 Status
+            raise ServerError(f"conversion to {desired} failed: {err}") from err
+
+    def _convert_out_many(self, items: list[dict], rt: "_Route") -> list[dict]:
+        """List conversion in ONE webhook callout when the converter can
+        batch (kube-apiserver sends a whole list as a single
+        ConversionReview; N round-trips for N items would multiply list
+        latency by N)."""
+        desired = rt.info.api_version
+        need = [d for d in items if d.get("apiVersion") != desired]
+        if self.converter is None or not need:
+            return items
+        batch = getattr(type(self).converter, "convert_many", None)
+        if batch is None:
+            return [self._convert_out(d, rt) for d in items]
+        try:
+            converted = iter(batch(need, desired))
+        except Exception as err:
+            raise ServerError(f"conversion to {desired} failed: {err}") from err
+        return [next(converted) if d.get("apiVersion") != desired else d
+                for d in items]
+
+    def _convert_in(self, obj: KubeObject, rt: "_Route") -> KubeObject:
+        """Request-path version -> the kind's storage version before the
+        store sees it (what the apiserver does on every write)."""
+        storage = self.scheme.by_kind(rt.info.kind).api_version
+        if self.converter is None or obj.api_version == storage:
+            return obj
+        try:
+            return KubeObject.from_dict(
+                type(self).converter(obj.to_dict(), storage))
+        except ApiError:
+            raise
+        except Exception as err:
+            raise ServerError(f"conversion to {storage} failed: {err}") from err
+
     # -- verbs ----------------------------------------------------------------
     def do_GET(self):  # noqa: N802
         if not self._guard():
@@ -172,7 +234,7 @@ class _WireHandler(BaseHTTPRequestHandler):
         try:
             if rt.name is not None:
                 obj = self.api.get(rt.info.kind, rt.namespace or "", rt.name)
-                self._send_json(200, obj.to_dict())
+                self._send_json(200, self._convert_out(obj.to_dict(), rt))
             elif q.get("watch") in ("true", "1"):
                 self._serve_watch(rt, q)
             else:
@@ -183,7 +245,8 @@ class _WireHandler(BaseHTTPRequestHandler):
                     "kind": f"{rt.info.kind}List",
                     "apiVersion": rt.info.api_version,
                     "metadata": {"resourceVersion": str(rv)},
-                    "items": [o.to_dict() for o in items],
+                    "items": self._convert_out_many(
+                        [o.to_dict() for o in items], rt),
                 })
         except ApiError as err:
             self._send_error_status(err)
@@ -201,8 +264,8 @@ class _WireHandler(BaseHTTPRequestHandler):
             obj.api_version = obj.api_version or rt.info.api_version
             if rt.namespace:
                 obj.metadata.namespace = rt.namespace
-            created = self.api.create(obj)
-            self._send_json(201, created.to_dict())
+            created = self.api.create(self._convert_in(obj, rt))
+            self._send_json(201, self._convert_out(created.to_dict(), rt))
         except ApiError as err:
             self._send_error_status(err)
 
@@ -220,12 +283,14 @@ class _WireHandler(BaseHTTPRequestHandler):
             body = self._read_body()
             obj = KubeObject.from_dict(body)
             obj.kind = rt.info.kind
+            obj.api_version = obj.api_version or rt.info.api_version
             if rt.namespace:
                 obj.metadata.namespace = rt.namespace
             if rt.name:
                 obj.metadata.name = rt.name
-            updated = self.api.update(obj, subresource=rt.subresource)
-            self._send_json(200, updated.to_dict())
+            updated = self.api.update(self._convert_in(obj, rt),
+                                      subresource=rt.subresource)
+            self._send_json(200, self._convert_out(updated.to_dict(), rt))
         except ApiError as err:
             self._send_error_status(err)
 
@@ -244,9 +309,20 @@ class _WireHandler(BaseHTTPRequestHandler):
             patch = self._read_body()
             # strategic-merge from kubectl degrades to merge semantics here;
             # the controllers only send RFC 7386 merge patches
-            updated = self.api.merge_patch(
-                rt.info.kind, rt.namespace or "", rt.name, patch)
-            self._send_json(200, updated.to_dict())
+            storage = self.scheme.by_kind(rt.info.kind).api_version
+            if self.converter is not None and rt.info.api_version != storage:
+                # cross-version patch: the patch applies to the REQUEST-
+                # version view, and the result converts back to storage — a
+                # verbatim merge would smuggle the request apiVersion (and
+                # any version-specific fields) into the stored object
+                updated = self.api.merge_patch(
+                    rt.info.kind, rt.namespace or "", rt.name, patch,
+                    view_out=lambda d: self._convert_out(d, rt),
+                    view_in=lambda o: self._convert_in(o, rt))
+            else:
+                updated = self.api.merge_patch(
+                    rt.info.kind, rt.namespace or "", rt.name, patch)
+            self._send_json(200, self._convert_out(updated.to_dict(), rt))
         except ApiError as err:
             self._send_error_status(err)
 
@@ -296,8 +372,12 @@ class _WireHandler(BaseHTTPRequestHandler):
                     continue
                 if ev is None:
                     break
+                try:
+                    out_obj = self._convert_out(ev.obj.to_dict(), rt)
+                except ApiError:
+                    continue  # conversion failure drops the event, not the stream
                 line = json.dumps(
-                    {"type": ev.type.value, "object": ev.obj.to_dict()}
+                    {"type": ev.type.value, "object": out_obj}
                 ).encode() + b"\n"
                 self.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
                 self.wfile.flush()
@@ -315,10 +395,12 @@ class KubeApiWireServer:
     def __init__(self, api: ApiServer, scheme: Optional[Scheme] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None,
-                 ssl_context: Optional[ssl.SSLContext] = None) -> None:
+                 ssl_context: Optional[ssl.SSLContext] = None,
+                 converter=None) -> None:
         self.api = api
         handler = type("Handler", (_WireHandler,), {
             "api": api, "scheme": scheme or DEFAULT_SCHEME, "token": token,
+            "converter": staticmethod(converter) if converter else None,
         })
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True

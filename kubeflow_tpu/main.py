@@ -45,6 +45,7 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
 
     manager: Optional[Manager] = None
     metrics: Optional[NotebookMetrics] = None
+    expose_state: bool = False  # /state dumps Secrets — loopback/debug only
 
     def do_GET(self):  # noqa: N802  (stdlib API)
         if self.path in ("/healthz", "/readyz"):
@@ -58,9 +59,12 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
             registry = getattr(self.metrics, "registry", None)
             body = registry.render() if registry is not None else ""
             self._respond(200, body, "text/plain; version=0.0.4")
-        elif self.path == "/state":
+        elif self.path == "/state" and self.expose_state:
             api = self.manager.api if self.manager else None
-            body = json.dumps(api.dump() if api else {}, default=str)
+            # the real-cluster KubeClient has no dump(); only the in-memory
+            # store can be exported
+            dump = getattr(api, "dump", None)
+            body = json.dumps(dump() if callable(dump) else {}, default=str)
             self._respond(200, body, "application/json")
         else:
             self._respond(404, "not found", "text/plain")
@@ -77,14 +81,17 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
-def serve_http(port: int, manager: Manager, metrics: NotebookMetrics):
+def serve_http(port: int, manager: Manager, metrics: NotebookMetrics,
+               expose_state: bool = False):
+    """Health + metrics on all interfaces (the kubelet probes the pod IP and
+    Prometheus scrapes :8080 from outside the pod, as in the reference).
+    The /state debug dump — which includes Secret data — is served only when
+    `expose_state` is set (--expose-state, standalone/demo use)."""
     handler = type(
         "Handler",
         (HealthAndMetricsHandler,),
-        {"manager": manager, "metrics": metrics},
+        {"manager": manager, "metrics": metrics, "expose_state": expose_state},
     )
-    # all interfaces: the kubelet probes the pod IP and Prometheus scrapes
-    # :8080 from outside the pod (reference serves metrics the same way)
     server = http.server.ThreadingHTTPServer(("0.0.0.0", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
@@ -206,6 +213,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--demo-accelerator", default="v5e")
     parser.add_argument("--run-seconds", type=float, default=0.0,
                         help="exit after N seconds (0 = run forever)")
+    parser.add_argument("--expose-state", action="store_true",
+                        help="serve the /state object-store dump (includes "
+                             "Secret data; standalone/debug only)")
     parser.add_argument("--debug-log", action="store_true")
     args = parser.parse_args(argv)
 
@@ -218,7 +228,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     mgr, api, cluster, metrics = build_manager(api=backend)
     if cluster is not None:
         cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
-    server = serve_http(args.metrics_addr, mgr, metrics)
+    if args.expose_state and real:
+        logging.warning("--expose-state ignored with a real cluster backend "
+                        "(the KubeClient has no store to dump; /state stays 404)")
+    server = serve_http(args.metrics_addr, mgr, metrics,
+                        expose_state=args.expose_state and not real)
     webhook_server = start_webhook_server(api, args) if real else None
 
     def start_reconciling():

@@ -32,6 +32,8 @@ from ..kube.jsonpatch import apply_patch, diff
 
 logger = logging.getLogger("kubeflow_tpu.odh.webhook_server")
 
+_NOTEBOOK_CONVERT = object()  # sentinel: default to the Notebook converter
+
 
 def handle_admission_review(hooks: list[AdmissionHook], path: str,
                             review: dict) -> dict:
@@ -70,9 +72,36 @@ def handle_admission_review(hooks: list[AdmissionHook], path: str,
     }
 
 
+def handle_conversion_review(review: dict, convert_fn) -> dict:
+    """ConversionReview v1: convert request.objects to desiredAPIVersion.
+
+    The other half of the CRD's `spec.conversion` clause
+    (deploy/manifests.py renders path /convert) — what kube-apiserver calls
+    on every read/write of a non-storage version.  Reference:
+    notebook-controller/api/v1/notebook_conversion.go:25-69 + the
+    conversion-webhook patches under its config/crd/."""
+    req = review.get("request") or {}
+    uid = req.get("uid", "")
+    desired = req.get("desiredAPIVersion", "")
+    try:
+        converted = [convert_fn(o, desired) for o in req.get("objects") or []]
+        response = {"uid": uid, "convertedObjects": converted,
+                    "result": {"status": "Success"}}
+    except Exception as err:  # a Failure result, not a dead connection
+        logger.exception("conversion to %s failed", desired)
+        response = {"uid": uid,
+                    "result": {"status": "Failure", "message": str(err)}}
+    return {
+        "apiVersion": review.get("apiVersion") or "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "response": response,
+    }
+
+
 class _AdmissionHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     hooks: list[AdmissionHook] = []
+    convert_fn = None  # (obj_dict, desired_api_version) -> obj_dict
 
     def log_message(self, *args):
         logger.debug("%s", args)
@@ -81,7 +110,10 @@ class _AdmissionHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         try:
             review = json.loads(self.rfile.read(length) or b"{}")
-            out = handle_admission_review(self.hooks, self.path, review)
+            if self.path == "/convert" and self.convert_fn is not None:
+                out = handle_conversion_review(review, type(self).convert_fn)
+            else:
+                out = handle_admission_review(self.hooks, self.path, review)
             data = json.dumps(out).encode()
             self.send_response(200)
         except Exception as err:  # a broken review must not kill the server
@@ -107,10 +139,18 @@ class AdmissionReviewServer:
     def __init__(self, hooks: list[AdmissionHook],
                  bundle: Optional[CertBundle] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 cert_file: str = "", key_file: str = "") -> None:
+                 cert_file: str = "", key_file: str = "",
+                 convert_fn=_NOTEBOOK_CONVERT) -> None:
         self.hooks = hooks
         self.bundle = bundle
-        handler = type("Handler", (_AdmissionHandler,), {"hooks": hooks})
+        if convert_fn is _NOTEBOOK_CONVERT:
+            from ..api.types import convert_notebook_dict
+
+            convert_fn = convert_notebook_dict
+        handler = type("Handler", (_AdmissionHandler,), {
+            "hooks": hooks,
+            "convert_fn": staticmethod(convert_fn) if convert_fn else None,
+        })
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -144,6 +184,24 @@ class AdmissionReviewServer:
             self._thread.join(timeout=5)
 
 
+def _webhook_client_ssl(ca_pem: Optional[bytes],
+                        insecure_skip_verify: bool) -> ssl.SSLContext:
+    """Verified-by-default client TLS for webhook callouts.  A provided CA
+    is trusted with full hostname checking (minted serving certs carry the
+    host IP SAN, kube/certs.py); skipping verification is an explicit
+    opt-in, mirroring kubeconfig's insecure-skip-tls-verify."""
+    if insecure_skip_verify:
+        return ssl._create_unverified_context()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.check_hostname = True
+    if ca_pem is not None:
+        ctx.load_verify_locations(cadata=ca_pem.decode())
+    else:
+        ctx.load_default_certs()
+    return ctx
+
+
 class RemoteAdmissionHook:
     """ApiServer-side callout to a remote AdmissionReview endpoint.
 
@@ -156,24 +214,15 @@ class RemoteAdmissionHook:
                  ca_pem: Optional[bytes] = None,
                  kinds: tuple[str, ...] = ("Notebook",),
                  operations: tuple[str, ...] = ("CREATE", "UPDATE"),
-                 timeout_s: float = 10.0) -> None:
+                 timeout_s: float = 10.0,
+                 insecure_skip_verify: bool = False) -> None:
         self.endpoint = url.rstrip("/") + path
         self.path = path
         self.mutating = mutating
         self.kinds = kinds
         self.operations = operations
         self.timeout_s = timeout_s
-        if ca_pem is not None:
-            self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-            self._ctx.check_hostname = False
-            import tempfile
-
-            with tempfile.NamedTemporaryFile(suffix=".pem") as f:
-                f.write(ca_pem)
-                f.flush()
-                self._ctx.load_verify_locations(f.name)
-        else:
-            self._ctx = ssl._create_unverified_context()  # tests only
+        self._ctx = _webhook_client_ssl(ca_pem, insecure_skip_verify)
 
     def __call__(self, op: str, old: Optional[KubeObject],
                  obj: KubeObject) -> Optional[KubeObject]:
@@ -211,5 +260,58 @@ class RemoteAdmissionHook:
             name=name or self.path.lstrip("/"))
 
 
-__all__ = ["AdmissionReviewServer", "RemoteAdmissionHook",
-           "handle_admission_review"]
+class RemoteConverter:
+    """Apiserver-side ConversionReview callout to /convert.
+
+    Plugs into KubeApiWireServer(converter=...) so version-crossing reads
+    and writes go over the wire to the webhook server — the CRD
+    `spec.conversion` choreography end to end, like kube-apiserver with a
+    Webhook conversion strategy."""
+
+    def __init__(self, url: str, ca_pem: Optional[bytes] = None,
+                 timeout_s: float = 10.0,
+                 insecure_skip_verify: bool = False) -> None:
+        self.endpoint = url.rstrip("/") + "/convert"
+        self.timeout_s = timeout_s
+        self._ctx = _webhook_client_ssl(ca_pem, insecure_skip_verify)
+        self._uid = 0
+
+    def __call__(self, obj: dict, desired_api_version: str) -> dict:
+        return self.convert_many([obj], desired_api_version)[0]
+
+    def convert_many(self, objs: list[dict],
+                     desired_api_version: str) -> list[dict]:
+        """One ConversionReview for the whole batch — the apiserver converts
+        an entire LIST in a single callout, and so does the wire server
+        (kube/wire.py _convert_out_many)."""
+        self._uid += 1
+        review = {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "ConversionReview",
+            "request": {
+                "uid": f"conv-{self._uid}",
+                "desiredAPIVersion": desired_api_version,
+                "objects": objs,
+            },
+        }
+        req = urllib.request.Request(
+            self.endpoint, data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                    context=self._ctx) as resp:
+            out = json.loads(resp.read())
+        response = out.get("response") or {}
+        result = response.get("result") or {}
+        if result.get("status") != "Success":
+            raise RuntimeError(
+                f"conversion webhook failed: {result.get('message', result)}")
+        converted = response.get("convertedObjects") or []
+        if len(converted) != len(objs):
+            raise RuntimeError(
+                f"conversion webhook returned {len(converted)} objects "
+                f"for {len(objs)}")
+        return converted
+
+
+__all__ = ["AdmissionReviewServer", "RemoteAdmissionHook", "RemoteConverter",
+           "handle_admission_review", "handle_conversion_review"]

@@ -17,13 +17,21 @@ from typing import Mapping, Optional
 SA_NAMESPACE_FILE = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
 
 
-def detect_namespace(default: str = "default") -> str:
+def detect_namespace(default: str = "default",
+                     env: Optional[Mapping[str, str]] = None) -> str:
     """Controller namespace: K8S_NAMESPACE env var, else the in-cluster
     ServiceAccount token mount, else `default` (odh main.go:127-139).
-    The single source of truth — kube.client re-exports this."""
-    ns = os.environ.get("K8S_NAMESPACE", "")
+    The single source of truth — kube.client re-exports this.  Passing an
+    explicit `env` mapping keeps the lookup hermetic (an empty mapping never
+    falls through to os.environ or the SA mount — tests with from_env({})
+    must not pick up ambient cluster state)."""
+    hermetic = env is not None
+    env = env if env is not None else os.environ
+    ns = env.get("K8S_NAMESPACE", "")
     if ns:
         return ns
+    if hermetic:
+        return default
     try:
         with open(SA_NAMESPACE_FILE) as f:
             return f.read().strip() or default
@@ -103,6 +111,7 @@ class OdhConfig:
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "OdhConfig":
+        explicit = env is not None
         env = env if env is not None else os.environ
         return cls(
             set_pipeline_rbac=_bool(env, "SET_PIPELINE_RBAC", False),
@@ -113,9 +122,10 @@ class OdhConfig:
             gateway_name=env.get("NOTEBOOK_GATEWAY_NAME", "data-science-gateway"),
             gateway_namespace=env.get("NOTEBOOK_GATEWAY_NAMESPACE", "openshift-ingress"),
             # namespace detection: K8S_NAMESPACE, else the in-cluster SA
-            # mount, else the dev default (odh main.go:127-139)
-            controller_namespace=env.get("K8S_NAMESPACE", "")
-            or detect_namespace("opendatahub"),
+            # mount, else the dev default (odh main.go:127-139); an explicit
+            # mapping stays hermetic (no ambient os.environ / SA-mount reads)
+            controller_namespace=detect_namespace(
+                "opendatahub", env=env if explicit else None),
             kube_rbac_proxy_image=env.get("KUBE_RBAC_PROXY_IMAGE", "kube-rbac-proxy:latest"),
             tpu_default_image=env.get("TPU_DEFAULT_IMAGE", "jupyter-tpu-jax:latest"),
         )

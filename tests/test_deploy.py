@@ -52,7 +52,7 @@ class TestManagerHTTP:
     def test_health_metrics_state_endpoints(self):
         mgr, api, cluster, metrics = build_manager()
         cluster.add_node("n1")
-        server = serve_http(0, mgr, metrics)
+        server = serve_http(0, mgr, metrics, expose_state=True)
         port = server.server_address[1]
         try:
             def get(path):

@@ -198,7 +198,13 @@ class TestE2ENotebookLifecycle:
             ),
             f"{ctx.name}: route cleanup",
         )
-        assert not [
-            s for s in api.list("StatefulSet", namespace=ctx.namespace)
-            if s.name.startswith(ctx.name)
-        ]
+        # polled like every other phase check: a reconcile that raced the
+        # cascade may briefly recreate a slice STS; the store's dangling-
+        # owner GC (kube/store.py _collect_dangling_owners) must reap it
+        wait_for(
+            lambda: not [
+                s for s in api.list("StatefulSet", namespace=ctx.namespace)
+                if s.name.startswith(ctx.name)
+            ],
+            f"{ctx.name}: owned StatefulSets garbage-collected",
+        )

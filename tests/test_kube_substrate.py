@@ -142,6 +142,48 @@ class TestApiServer:
         with pytest.raises(NotFoundError):
             api.get("StatefulSet", "default", "nb")
 
+    def test_create_with_dead_owner_is_collected(self):
+        """Regression: a reconciler racing a cascade delete can create a
+        dependent AFTER the owner finalized (the e2e multislice leak).
+        Real GC reaps dependents with dangling owner refs; the store must
+        do the same at create."""
+        api = ApiServer()
+        owner = api.create(mk("Notebook", "nb"))
+        api.delete("Notebook", "default", "nb")
+        child = mk("StatefulSet", "nb-slice-1", api_version="apps/v1")
+        child.metadata.owner_references = [owner.owner_reference()]
+        api.create(child)  # create succeeds (201), as on a real apiserver
+        with pytest.raises(NotFoundError):
+            api.get("StatefulSet", "default", "nb-slice-1")
+
+    def test_create_with_terminating_owner_is_collected(self):
+        """An owner mid-termination (finalizers pending) must also fence new
+        dependents — the cascade at finalize would otherwise race them."""
+        api = ApiServer()
+        nb = mk("Notebook", "nb")
+        nb.metadata.finalizers = ["odh.opendatahub.io/cleanup"]
+        owner = api.create(nb)
+        api.delete("Notebook", "default", "nb")  # terminating, not gone
+        child = mk("StatefulSet", "nb-slice-1", api_version="apps/v1")
+        child.metadata.owner_references = [owner.owner_reference()]
+        api.create(child)
+        with pytest.raises(NotFoundError):
+            api.get("StatefulSet", "default", "nb-slice-1")
+
+    def test_create_with_one_dead_one_live_owner_strips_ref(self):
+        api = ApiServer()
+        dead = api.create(mk("Notebook", "dead"))
+        api.delete("Notebook", "default", "dead")
+        live_owner = api.create(mk("Notebook", "alive"))
+        child = mk("ReferenceGrant", "shared")
+        child.metadata.owner_references = [
+            dead.owner_reference(controller=False),
+            live_owner.owner_reference(controller=False),
+        ]
+        api.create(child)
+        got = api.get("ReferenceGrant", "default", "shared")
+        assert [r.name for r in got.metadata.owner_references] == ["alive"]
+
     def test_admission_mutating_and_validating(self):
         api = ApiServer()
 
