@@ -98,10 +98,16 @@ LLAMA2_350M = TransformerConfig(
 
 # tuned single-chip bench config (~0.47B params): wider layers (K=1536)
 # keep the MXU fed — measured ~1.7x the MFU of the 1024-wide proxy on one
-# v5e through this image's remote-compile path.  Flash attention never
-# materializes the fp32 [B,H,S,S] scores, which is what lets the batch
-# reach 24 with fp32 master weights + Adam in 16 GiB HBM (XLA attention
-# wins at batch<=16 but OOMs beyond).
+# v5e through this image's remote-compile path.  The round-3 sweep
+# (ci/mfu_sweep.py, results in ci/sweep_results.jsonl) settled the rest:
+#   - chunked CE (loss_chunks=32) never materializes the [B*S, 32k] fp32
+#     logits (~6 GiB at batch 48) — the single knob that moves the batch
+#     from 24 to 48;
+#   - Pallas flash tiles 256x256 beat the kernel's 512 defaults by 39% at
+#     batch 48 (0.3196 vs 0.2303 MFU) — smaller tiles double-buffer better
+#     in VMEM at this head_dim;
+#   - bf16 first-moment (bench.py passes mu_dtype) frees ~0.9 GiB;
+#   - batch 50+ and every larger tile combination OOM 16 GiB HBM.
 BENCH_CHIP = TransformerConfig(
     num_layers=10,
     embed_dim=1536,
@@ -111,6 +117,9 @@ BENCH_CHIP = TransformerConfig(
     mlp_dim=6144,
     max_seq_len=2048,
     attention_impl="flash",
+    loss_chunks=32,
+    flash_block_q=256,
+    flash_block_k=256,
 )
 
 # CI/test config: tiny but structurally identical (GQA, scan, remat)
