@@ -1,0 +1,196 @@
+"""Black-box behavioral conformance: drive ANY implementation over the wire.
+
+Speaks ONLY the Kubernetes REST protocol to a server URL — no imports from
+the implementation — and certifies the externally observable Notebook
+contract:
+
+  1. CRD lifecycle: a created Notebook yields a StatefulSet named after it
+     (labels `notebook-name`), a ClusterIP Service on port 80 -> 8888, and
+     a status with readyReplicas + conditions.
+  2. The annotation protocol: setting `kubeflow-resource-stopped` scales the
+     workload to 0 replicas (slice-atomically for TPU notebooks); removing
+     it restores scale; `notebooks.opendatahub.io/notebook-restart: "true"`
+     is cleared by the controller after acting.
+  3. TPU topology contract: `spec.tpu` renders one indexed StatefulSet per
+     slice with `replicas = hosts(topology)`, a headless worker Service,
+     `TPU_WORKER_HOSTNAMES`/`TPU_WORKER_ID` env and `google.com/tpu`
+     resources on the worker containers.
+  4. Deletion: removing the Notebook removes the rendered objects.
+
+Usage:  python conformance/behavior.py --server http://HOST:PORT [--namespace ns]
+The driver for a standalone run is conformance/run.sh, which boots the
+shipped manager with --serve-api and points this script at it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+STOP = "kubeflow-resource-stopped"
+RESTART = "notebooks.opendatahub.io/notebook-restart"
+
+
+class Client:
+    def __init__(self, server: str, namespace: str):
+        self.server = server.rstrip("/")
+        self.ns = namespace
+
+    def req(self, method, path, body=None, ctype="application/json"):
+        req = urllib.request.Request(
+            self.server + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": ctype}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                raw = resp.read()
+                return resp.status, json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as err:
+            raw = err.read()
+            return err.code, json.loads(raw) if raw else {}
+
+    def nb_path(self, name=""):
+        base = f"/apis/kubeflow.org/v1/namespaces/{self.ns}/notebooks"
+        return f"{base}/{name}" if name else base
+
+    def sts(self, name):
+        return self.req("GET",
+                        f"/apis/apps/v1/namespaces/{self.ns}/statefulsets/{name}")
+
+    def svc(self, name):
+        return self.req("GET",
+                        f"/api/v1/namespaces/{self.ns}/services/{name}")
+
+
+def wait(predicate, timeout=30.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(0.25)
+    raise AssertionError(f"CONFORMANCE FAIL: timed out waiting for {what}")
+
+
+def check_cpu_lifecycle(c: Client) -> None:
+    name = "conf-cpu"
+    status, _ = c.req("POST", c.nb_path(), {
+        "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+        "metadata": {"name": name},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "workbench:latest"}]}}},
+    })
+    assert status == 201, f"create returned {status}"
+    # 1. workload rendering
+    sts = wait(lambda: c.sts(name)[1] if c.sts(name)[0] == 200 else None,
+               what="StatefulSet")
+    labels = sts["spec"]["template"]["metadata"]["labels"]
+    assert labels.get("notebook-name") == name, labels
+    assert sts["spec"]["replicas"] == 1, sts["spec"].get("replicas")
+    svc = wait(lambda: c.svc(name)[1] if c.svc(name)[0] == 200 else None,
+               what="Service")
+    port = svc["spec"]["ports"][0]
+    assert (port["port"], port["targetPort"]) == (80, 8888), port
+    # status contract
+    wait(lambda: "readyReplicas" in (c.req("GET", c.nb_path(name))[1]
+                                     .get("status") or {}),
+         what="status.readyReplicas")
+    # 2. stop/resume annotation protocol
+    code, live = c.req("PATCH", c.nb_path(name),
+                       {"metadata": {"annotations":
+                                     {STOP: "2026-01-01T00:00:00Z"}}},
+                       ctype="application/merge-patch+json")
+    assert code == 200, (code, live)
+    wait(lambda: c.sts(name)[1].get("spec", {}).get("replicas") == 0,
+         what="scale to zero on stop annotation")
+    c.req("PATCH", c.nb_path(name), {"metadata": {"annotations": {STOP: None}}},
+          ctype="application/merge-patch+json")
+    wait(lambda: c.sts(name)[1].get("spec", {}).get("replicas") == 1,
+         what="scale up on stop-annotation removal")
+    # restart annotation is acted on + cleared
+    c.req("PATCH", c.nb_path(name),
+          {"metadata": {"annotations": {RESTART: "true"}}},
+          ctype="application/merge-patch+json")
+    wait(lambda: RESTART not in (c.req("GET", c.nb_path(name))[1]
+                                 .get("metadata", {}).get("annotations") or {}),
+         what="restart annotation cleared by controller")
+    # 4. deletion
+    c.req("DELETE", c.nb_path(name))
+    wait(lambda: c.req("GET", c.nb_path(name))[0] == 404,
+         what="notebook finalized")
+    wait(lambda: c.sts(name)[0] == 404, what="StatefulSet cleanup")
+    print("PASS cpu lifecycle + annotation protocol")
+
+
+def check_tpu_topology(c: Client) -> None:
+    name = "conf-tpu"
+    slices = 2
+    status, _ = c.req("POST", c.nb_path(), {
+        "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+        "metadata": {"name": name},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "2x4", "slices": slices},
+            "template": {"spec": {"containers": [
+                {"name": name, "image": "workbench:latest"}]}},
+        },
+    })
+    assert status == 201, f"create returned {status}"
+    for i in range(slices):
+        sts = wait(lambda i=i: c.sts(f"{name}-slice-{i}")[1]
+                   if c.sts(f"{name}-slice-{i}")[0] == 200 else None,
+                   what=f"slice-{i} StatefulSet")
+        spec = sts["spec"]
+        assert spec["serviceName"] == f"{name}-workers", spec.get("serviceName")
+        containers = spec["template"]["spec"]["containers"]
+        wb = next(ct for ct in containers if ct["name"] == name)
+        env = {e["name"]: e for e in wb.get("env", [])}
+        assert "TPU_WORKER_HOSTNAMES" in env, sorted(env)
+        assert "TPU_WORKER_ID" in env, sorted(env)
+        limits = wb.get("resources", {}).get("limits", {})
+        assert "google.com/tpu" in limits, limits
+    headless = wait(
+        lambda: c.svc(f"{name}-workers")[1]
+        if c.svc(f"{name}-workers")[0] == 200 else None,
+        what="headless worker Service")
+    assert headless["spec"].get("clusterIP") == "None", headless["spec"]
+    # slice-atomic stop: ALL slices go to 0
+    c.req("PATCH", c.nb_path(name),
+          {"metadata": {"annotations": {STOP: "2026-01-01T00:00:00Z"}}},
+          ctype="application/merge-patch+json")
+    wait(lambda: all(
+        c.sts(f"{name}-slice-{i}")[1].get("spec", {}).get("replicas") == 0
+        for i in range(slices)), what="slice-atomic stop")
+    c.req("DELETE", c.nb_path(name))
+    wait(lambda: c.req("GET", c.nb_path(name))[0] == 404,
+         what="tpu notebook finalized")
+    wait(lambda: all(c.sts(f"{name}-slice-{i}")[0] == 404
+                     for i in range(slices)),
+         what="slice StatefulSet cleanup")
+    print("PASS tpu topology + slice-atomic semantics")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--server", required=True)
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--skip-tpu", action="store_true",
+                        help="cluster has no TPU nodes")
+    args = parser.parse_args()
+    c = Client(args.server, args.namespace)
+    check_cpu_lifecycle(c)
+    if not args.skip_tpu:
+        check_tpu_topology(c)
+    print("behavioral conformance: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as err:
+        print(err)
+        sys.exit(1)

@@ -1,0 +1,145 @@
+"""Rendered-object goldens: the conformance contract for what a conformant
+controller must create.
+
+For two canonical Notebook inputs (a CPU workbench with auth, and a 2-slice
+TPU workbench), the COMMITTED goldens record the full normalized object set
+a conformant implementation renders — names, labels, ports, env injection,
+topology wiring, network policy shape — plus the deployment manifests for
+every profile.  `python conformance/check_goldens.py` re-renders with the
+current implementation and diffs; any drift fails.  `--update` regenerates
+(a contract change, to be reviewed like one).  Reference analog:
+conformance/1.7/Makefile:16-30 (an external expected-artifact contract, not
+a re-run of the implementation's own tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+GOLDEN_DIR = Path(__file__).resolve().parent / "goldens"
+
+# dynamic/server-assigned fields stripped before comparison
+VOLATILE_META = ("uid", "resourceVersion", "creationTimestamp", "generation",
+                 "deletionTimestamp")
+
+
+def normalize(obj: dict) -> dict:
+    obj = json.loads(json.dumps(obj))  # deep copy
+    meta = obj.get("metadata", {})
+    for k in VOLATILE_META:
+        meta.pop(k, None)
+    for ref in meta.get("ownerReferences", []) or []:
+        ref.pop("uid", None)
+    obj.pop("status", None)
+    # annotations stamped with wall-clock times
+    ann = meta.get("annotations") or {}
+    for k in list(ann):
+        if "last-activity" in k or "last_activity" in k:
+            ann[k] = "<timestamp>"
+    return obj
+
+
+def sort_key(obj: dict) -> tuple:
+    return (obj.get("kind", ""), obj.get("metadata", {}).get("namespace", ""),
+            obj.get("metadata", {}).get("name", ""))
+
+
+def render_workbench_objects() -> dict[str, list[dict]]:
+    """Drive the full manager over the two canonical inputs and collect
+    every object the controllers render."""
+    from kubeflow_tpu.api.types import Notebook, TPUSpec
+    from kubeflow_tpu.main import build_manager
+    from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
+
+    out: dict[str, list[dict]] = {}
+    scenarios = {
+        "cpu-auth-workbench": dict(
+            name="wb-cpu", tpu=None,
+            annotations={"notebooks.opendatahub.io/inject-auth": "true"},
+        ),
+        "tpu-multislice-workbench": dict(
+            name="wb-tpu", tpu=TPUSpec("v5e", "2x4", slices=2),
+            annotations={},
+        ),
+    }
+    for label, sc in scenarios.items():
+        core_cfg = CoreConfig.from_env({})
+        odh_cfg = OdhConfig.from_env({})
+        mgr, api, cluster, _ = build_manager(core_cfg, odh_cfg)
+        if sc["tpu"] is not None:
+            shape = sc["tpu"].shape
+            cluster.add_tpu_slice_nodes(
+                shape.accelerator.gke_label, shape.topology,
+                shape.num_hosts * sc["tpu"].slices, shape.chips_per_host)
+        else:
+            cluster.add_node("n1", allocatable={"cpu": "8", "memory": "32Gi"})
+        nb = Notebook.new(sc["name"], "user-ns", tpu=sc["tpu"],
+                          annotations=sc["annotations"])
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        objects = []
+        for kind, items in api.dump().items():
+            for item in items:
+                if kind in ("Node", "Namespace", "Event", "Lease"):
+                    continue  # infrastructure, not rendered contract
+                if kind == "Pod":
+                    continue  # kubelet's output, not the controller's
+                objects.append(normalize(item))
+        out[label] = sorted(objects, key=sort_key)
+    return out
+
+
+def render_manifests() -> dict[str, list[dict]]:
+    from kubeflow_tpu.deploy.manifests import render_profile
+
+    return {profile: [normalize(d) for d in render_profile(profile)]
+            for profile in ("standalone", "kubeflow", "openshift")}
+
+
+def collect() -> dict[str, dict]:
+    return {
+        "workbench_objects.json": render_workbench_objects(),
+        "deploy_manifests.json": render_manifests(),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--update", action="store_true",
+                        help="regenerate the goldens (contract change)")
+    args = parser.parse_args()
+    GOLDEN_DIR.mkdir(exist_ok=True)
+    failures = 0
+    for fname, data in collect().items():
+        rendered = json.dumps(data, indent=1, sort_keys=True) + "\n"
+        path = GOLDEN_DIR / fname
+        if args.update:
+            path.write_text(rendered)
+            print(f"UPDATED {fname}")
+            continue
+        if not path.exists():
+            print(f"FAIL {fname}: golden missing (run with --update)")
+            failures += 1
+            continue
+        golden = path.read_text()
+        if golden != rendered:
+            failures += 1
+            diff = difflib.unified_diff(
+                golden.splitlines(), rendered.splitlines(),
+                fromfile=f"goldens/{fname}", tofile="rendered", lineterm="", n=2)
+            print(f"FAIL {fname}: rendered objects drifted from the contract:")
+            for line in list(diff)[:60]:
+                print("  " + line)
+        else:
+            print(f"PASS {fname}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -275,8 +275,23 @@ class ApiServer:
         subresource="status" skips admission and generation bump, matching
         the /status subresource the reference writes via Status().Update()
         (notebook_controller.go:312).
-        """
-        obj = obj.deepcopy()
+
+        An EMPTY resourceVersion means "no precondition" (real-apiserver
+        semantics): the write must replace unconditionally even under
+        concurrency, so a commit-time conflict retries against fresh state
+        — the analog of GuaranteedUpdate's internal retry."""
+        if not obj.metadata.resource_version:
+            last: Exception | None = None
+            for _ in range(16):
+                try:
+                    return self._update_once(obj.deepcopy(), subresource)
+                except ConflictError as err:
+                    last = err  # racer committed between read and CAS
+            assert last is not None
+            raise last
+        return self._update_once(obj.deepcopy(), subresource)
+
+    def _update_once(self, obj: KubeObject, subresource: str) -> KubeObject:
         key = (obj.metadata.namespace, obj.metadata.name)
         with self._lock:
             kind_store = self._objects.setdefault(obj.kind, {})
@@ -285,10 +300,11 @@ class ApiServer:
                 raise NotFoundError(f"{obj.kind} {key[0]}/{key[1]} not found")
             old = old.deepcopy()
         if not obj.metadata.resource_version:
-            raise InvalidError(
-                f"{obj.kind} {key[0]}/{key[1]}: resourceVersion must be "
-                "specified for an update (read-modify-write required)"
-            )
+            # real-apiserver semantics: an empty resourceVersion on update
+            # means "no precondition" — the write replaces unconditionally
+            # (clients that want optimistic concurrency send the RV they
+            # read; all in-repo controllers do)
+            obj.metadata.resource_version = old.metadata.resource_version
         if obj.metadata.resource_version != old.metadata.resource_version:
             raise ConflictError(
                 f"{obj.kind} {key[0]}/{key[1]}: resourceVersion "

@@ -216,6 +216,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--expose-state", action="store_true",
                         help="serve the /state object-store dump (includes "
                              "Secret data; standalone/debug only)")
+    parser.add_argument("--serve-api", type=int, default=-1, metavar="PORT",
+                        help="standalone mode: serve the in-memory store "
+                             "over the Kubernetes REST wire protocol on "
+                             "PORT (0 = ephemeral; used by the conformance "
+                             "profile's black-box runner)")
     parser.add_argument("--debug-log", action="store_true")
     args = parser.parse_args(argv)
 
@@ -234,6 +239,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     server = serve_http(args.metrics_addr, mgr, metrics,
                         expose_state=args.expose_state and not real)
     webhook_server = start_webhook_server(api, args) if real else None
+    wire_server = None
+    if args.serve_api >= 0 and real:
+        logging.warning("--serve-api ignored with a real cluster backend "
+                        "(there is no in-memory store to serve)")
+    if args.serve_api >= 0 and not real:
+        from .api.types import convert_notebook_dict
+        from .kube.wire import KubeApiWireServer
+
+        wire_server = KubeApiWireServer(
+            api, host="127.0.0.1", port=args.serve_api,
+            converter=convert_notebook_dict).start()
+        logging.info("wire apiserver on %s", wire_server.url)
+        print(f"WIRE_API={wire_server.url}", flush=True)
 
     def start_reconciling():
         if real:
@@ -294,6 +312,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         if elector is not None:
             elector.stop()
         mgr.stop()
+        if wire_server is not None:
+            wire_server.stop()
         if webhook_server is not None:
             webhook_server.stop()
         if real:

@@ -107,13 +107,18 @@ class TestApiServer:
         with pytest.raises(NotFoundError):
             api.get("Notebook", "default", "nb")
 
-    def test_update_without_resource_version_rejected(self):
-        from kubeflow_tpu.kube import InvalidError
+    def test_update_without_resource_version_is_unconditional(self):
+        """Real-apiserver semantics (verified by the golden fixtures): an
+        empty resourceVersion on update means 'no precondition' — the write
+        replaces unconditionally instead of being rejected."""
         api = ApiServer()
-        api.create(mk("ConfigMap", "a"))
+        created = api.create(mk("ConfigMap", "a"))
         fresh = mk("ConfigMap", "a")  # no resourceVersion
-        with pytest.raises(InvalidError):
-            api.update(fresh)
+        fresh.metadata.labels["unconditional"] = "yes"
+        updated = api.update(fresh)
+        assert updated.metadata.labels["unconditional"] == "yes"
+        assert updated.metadata.resource_version != \
+            created.metadata.resource_version
 
     def test_gc_waits_for_last_owner(self):
         api = ApiServer()
