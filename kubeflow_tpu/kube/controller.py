@@ -21,7 +21,7 @@ from typing import Callable, Optional, Protocol
 
 from ..utils.clock import Clock
 from .meta import KubeObject
-from .store import ApiServer, EventType, WatchEvent
+from .store import ApiServer, WatchEvent
 
 logger = logging.getLogger("kubeflow_tpu.kube")
 

@@ -15,7 +15,7 @@ import copy
 import threading
 from dataclasses import dataclass
 from enum import Enum
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 from collections import deque
 

@@ -228,6 +228,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         level=logging.DEBUG if args.debug_log else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # webhook spans leave the process when OTEL_EXPORTER_OTLP_ENDPOINT is
+    # set (odh main wires real OTel the same way; default stays noop)
+    from .utils.tracing import setup_exporter_from_env
+
+    otlp_exporter = setup_exporter_from_env()
     real = bool(args.kubeconfig or args.in_cluster)
     backend = build_real_backend(args) if real else None
     mgr, api, cluster, metrics = build_manager(api=backend)
@@ -318,6 +323,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             webhook_server.stop()
         if real:
             api.stop_informers()
+        if otlp_exporter is not None:
+            otlp_exporter.shutdown()
         server.shutdown()
     return exit_code
 

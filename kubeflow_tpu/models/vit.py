@@ -9,7 +9,6 @@ bidirectional (causal=False) through the same ops.attention dispatch.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

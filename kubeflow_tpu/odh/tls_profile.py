@@ -12,9 +12,9 @@ servers reload with the new posture.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable
 
-from ..kube import ApiServer, KubeObject, Manager, Request, Result
+from ..kube import ApiServer, Manager, Request, Result
 
 # Mozilla Intermediate (odh main.go:70-78) — the hardened fallback
 INTERMEDIATE_CIPHERS = (

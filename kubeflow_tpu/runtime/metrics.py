@@ -14,7 +14,6 @@ from typing import Optional
 import jax
 
 from ..models.configs import TransformerConfig
-from ..tpu.topology import ACCELERATORS
 
 
 def hbm_usage_bytes() -> dict[str, int]:

@@ -5,7 +5,7 @@ the reference uses (pkg/metrics/metrics.go:13-64)."""
 from __future__ import annotations
 
 import threading
-from typing import Callable, Optional
+from typing import Callable
 
 
 class _Metric:

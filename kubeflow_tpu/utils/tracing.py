@@ -1,4 +1,4 @@
-"""Minimal OpenTelemetry-style tracing.
+"""Minimal OpenTelemetry-style tracing with OTLP/HTTP export.
 
 The reference traces its mutating webhook with OTel — a lazily-created tracer
 (sync.OnceValue, notebook_mutating_webhook.go:74-76), a root span per
@@ -6,16 +6,25 @@ admission with notebook attributes (:366-373), child spans, and span events
 that the test suite asserts on via an in-memory exporter
 (opentelemetry_test.go:26-78).  We keep the same shape: a process-global
 provider that defaults to noop, swappable for an InMemorySpanExporter in
-tests — tracing as a test observability channel.
+tests — tracing as a test observability channel — plus an OtlpHttpExporter
+(the OTLP/HTTP JSON protocol, POST {endpoint}/v1/traces) so spans leave the
+process in production: set OTEL_EXPORTER_OTLP_ENDPOINT and the manager
+wires it at startup (setup_exporter_from_env).
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import logging
+import os
 import threading
 import time
+import urllib.request
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
+
+logger = logging.getLogger("kubeflow_tpu.tracing")
 
 
 @dataclass
@@ -34,6 +43,9 @@ class Span:
     start_time: float = 0.0
     end_time: float = 0.0
     recording: bool = True
+    # W3C-style ids (hex): all spans of one trace share trace_id
+    trace_id: str = ""
+    span_id: str = ""
 
     def add_event(self, name: str, attributes: Optional[dict] = None) -> None:
         if self.recording:
@@ -97,11 +109,14 @@ class Tracer:
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
+        parent = stack[-1] if stack else None
         span = Span(
             name=name,
             attributes=dict(attributes or {}),
-            parent=stack[-1] if stack else None,
+            parent=parent,
             start_time=time.time(),
+            trace_id=parent.trace_id if parent else os.urandom(16).hex(),
+            span_id=os.urandom(8).hex(),
         )
         stack.append(span)
         try:
@@ -112,15 +127,136 @@ class Tracer:
             exporter.export(span)
 
 
+def _otlp_value(v) -> dict:
+    """Encode one attribute value as an OTLP AnyValue."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(attrs: dict) -> list[dict]:
+    return [{"key": k, "value": _otlp_value(v)} for k, v in attrs.items()]
+
+
+def _nanos(t: float) -> str:
+    return str(int(t * 1e9))
+
+
+class OtlpHttpExporter:
+    """OTLP/HTTP JSON span exporter: POST {endpoint}/v1/traces.
+
+    The production counterpart of the test InMemorySpanExporter — the
+    reference's webhook tracing is real OpenTelemetry with a pluggable
+    provider (notebook_mutating_webhook.go:74-76); this speaks the OTLP
+    wire format any collector accepts.  Spans are buffered and flushed by a
+    background thread (batch span processor shape); export failures are
+    logged and dropped — tracing must never take down the control plane."""
+
+    def __init__(self, endpoint: str, service_name: str = "kubeflow-tpu",
+                 headers: Optional[dict] = None,
+                 flush_interval_s: float = 5.0, max_batch: int = 512,
+                 timeout_s: float = 10.0) -> None:
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service_name = service_name
+        self.headers = dict(headers or {})
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._buffer: list[Span] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="otlp-exporter")
+        self._thread.start()
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._buffer.append(span)
+            full = len(self._buffer) >= self.max_batch
+        if full:
+            self.flush()
+
+    def encode(self, spans: list[Span]) -> dict:
+        """ExportTraceServiceRequest JSON for a batch of finished spans."""
+        return {"resourceSpans": [{
+            "resource": {"attributes": _otlp_attrs(
+                {"service.name": self.service_name})},
+            "scopeSpans": [{
+                "scope": {"name": "kubeflow_tpu.utils.tracing"},
+                "spans": [{
+                    "traceId": s.trace_id,
+                    "spanId": s.span_id,
+                    **({"parentSpanId": s.parent.span_id}
+                       if s.parent is not None else {}),
+                    "name": s.name,
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": _nanos(s.start_time),
+                    "endTimeUnixNano": _nanos(s.end_time),
+                    "attributes": _otlp_attrs(s.attributes),
+                    "events": [{
+                        "timeUnixNano": _nanos(e.timestamp),
+                        "name": e.name,
+                        "attributes": _otlp_attrs(e.attributes),
+                    } for e in s.events],
+                } for s in spans],
+            }],
+        }]}
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+        if not batch:
+            return
+        body = json.dumps(self.encode(batch)).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json", **self.headers})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except Exception as err:  # noqa: BLE001 — drop, never crash
+            logger.warning("OTLP export of %d spans failed: %s",
+                           len(batch), err)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.flush_interval_s):
+            self.flush()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.timeout_s)
+        self.flush()
+
+
 _provider_lock = threading.Lock()
-_exporter: Optional[InMemorySpanExporter] = None
+_exporter = None  # anything with .export(Span)
 
 
-def set_exporter(exporter: Optional[InMemorySpanExporter]) -> None:
-    """Install the process-wide exporter (tests); None restores noop."""
+def set_exporter(exporter) -> None:
+    """Install the process-wide exporter (InMemorySpanExporter in tests,
+    OtlpHttpExporter in production); None restores noop."""
     global _exporter
     with _provider_lock:
         _exporter = exporter
+
+
+def setup_exporter_from_env(env=None):
+    """Install an OtlpHttpExporter when OTEL_EXPORTER_OTLP_ENDPOINT is set
+    (the standard OTel env contract; OTEL_SERVICE_NAME optional).  Returns
+    the exporter (caller owns shutdown()) or None."""
+    env = env if env is not None else os.environ
+    endpoint = env.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
+    if not endpoint:
+        return None
+    exporter = OtlpHttpExporter(
+        endpoint, service_name=env.get("OTEL_SERVICE_NAME", "kubeflow-tpu"))
+    set_exporter(exporter)
+    logger.info("OTLP trace export -> %s", exporter.url)
+    return exporter
 
 
 def get_tracer(name: str) -> Tracer:

@@ -5,7 +5,6 @@ from __future__ import annotations
 
 import time
 
-import pytest
 
 from kubeflow_tpu.kube import ApiServer
 from kubeflow_tpu.kube.client import KubeClient, RestConfig

@@ -21,8 +21,7 @@ import urllib.request
 
 import pytest
 
-from kubeflow_tpu.api.types import Notebook, TPUSpec
-from kubeflow_tpu.core.culling_controller import setup_culling
+from kubeflow_tpu.api.types import Notebook
 from kubeflow_tpu.core.metrics import NotebookMetrics
 from kubeflow_tpu.core.notebook_controller import setup_core_controllers
 from kubeflow_tpu.kube import (
@@ -39,7 +38,7 @@ from kubeflow_tpu.kube import (
 from kubeflow_tpu.kube.certs import mint_serving_cert
 from kubeflow_tpu.kube.client import KubeClient, RateLimiter, RestConfig
 from kubeflow_tpu.kube.jsonpatch import apply_patch, diff
-from kubeflow_tpu.kube.store import EventType, WatchEvent
+from kubeflow_tpu.kube.store import EventType
 from kubeflow_tpu.kube.wire import KubeApiWireServer, parse_label_selector
 from kubeflow_tpu.odh.webhook import (
     NotebookMutatingWebhook,
