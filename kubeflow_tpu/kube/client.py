@@ -162,6 +162,7 @@ class _Informer:
     thread: threading.Thread
     stop: threading.Event = field(default_factory=threading.Event)
     conn: Optional[http.client.HTTPConnection] = None  # live watch stream
+    namespace: Optional[str] = None  # None = cluster-wide
     # last-known objects, mutated only by this informer's thread — used to
     # synthesize DELETED events for objects that vanished while the watch
     # was down (client-go's DeletedFinalStateUnknown)
@@ -296,6 +297,16 @@ class KubeClient:
                           content_type="application/merge-patch+json")
         return KubeObject.from_dict(d)
 
+    def json_patch(self, kind: str, namespace: str, name: str,
+                   ops: list) -> KubeObject:
+        """RFC 6902 patch (client-go types.JSONPatchType); `test` ops carry
+        preconditions the server answers 422 for on mismatch."""
+        info = self.scheme_registry.by_kind(kind)
+        d = self._request("PATCH", info.object_path(namespace or None, name),
+                          body=ops,
+                          content_type="application/json-patch+json")
+        return KubeObject.from_dict(d)
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
         info = self.scheme_registry.by_kind(kind)
         self._request("DELETE", info.object_path(namespace or None, name))
@@ -325,11 +336,18 @@ class KubeClient:
             except Exception:  # watcher bugs must not kill the informer
                 logger.exception("watch callback failed for %s", ev.obj.key())
 
-    def start_informers(self, kinds: list[str]) -> None:
+    def start_informers(self, kinds: list[str],
+                        namespace: Optional[str] = None) -> None:
+        """List-and-watch reflectors.  `namespace` scopes every informer to
+        one namespace (client-go cache.Options.DefaultNamespaces) — a
+        single-tenant deployment should not list/watch the whole cluster."""
         for kind in kinds:
             if kind in self._informers:
                 continue
-            inf = _Informer(kind, thread=None)  # type: ignore[arg-type]
+            info = self.scheme_registry.by_kind(kind)
+            ns = namespace if info.namespaced else None
+            inf = _Informer(kind, thread=None,  # type: ignore[arg-type]
+                            namespace=ns)
             inf.thread = threading.Thread(
                 target=self._informer_loop, args=(inf,),
                 daemon=True, name=f"informer-{kind.lower()}")
@@ -362,16 +380,26 @@ class KubeClient:
         error, so controllers are not re-reconciling the whole cluster every
         watch_timeout_s."""
         info = self.scheme_registry.by_kind(inf.kind)
+        path = info.collection_path(inf.namespace)
         while not inf.stop.is_set():
             try:
-                listing = self._request("GET", info.collection_path(None))
-                rv = int(listing.get("metadata", {})
-                         .get("resourceVersion", 0) or 0)
+                # paginated relist (client-go's pager, 500/page): a large
+                # cluster must not be materialized in one response
+                rv = 0
                 fresh: dict[tuple[str, str], KubeObject] = {}
-                for item in listing.get("items", []):
-                    obj = KubeObject.from_dict(item)
-                    fresh[(obj.namespace, obj.name)] = obj
-                    self._dispatch(WatchEvent(EventType.ADDED, obj))
+                params: dict[str, str] = {"limit": "500"}
+                while True:
+                    listing = self._request(
+                        "GET", f"{path}?{urlencode(params)}")
+                    meta = listing.get("metadata", {})
+                    rv = int(meta.get("resourceVersion", 0) or 0)
+                    for item in listing.get("items", []):
+                        obj = KubeObject.from_dict(item)
+                        fresh[(obj.namespace, obj.name)] = obj
+                        self._dispatch(WatchEvent(EventType.ADDED, obj))
+                    if not meta.get("continue"):
+                        break
+                    params["continue"] = meta["continue"]
                 # objects that vanished while the watch was down get a
                 # synthetic DELETED with their last-known state
                 for key, gone in inf.known.items():
@@ -395,7 +423,7 @@ class KubeClient:
         """Stream watch events from `rv`; returns the newest resourceVersion
         seen so the caller can resume without a relist."""
         qs = urlencode({"watch": "true", "resourceVersion": str(rv)})
-        path = f"{info.collection_path(None)}?{qs}"
+        path = f"{info.collection_path(inf.namespace)}?{qs}"
         self.limiter.acquire()
         conn = self._connect(timeout=self.watch_timeout_s)
         inf.conn = conn

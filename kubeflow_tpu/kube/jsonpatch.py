@@ -59,6 +59,18 @@ def diff(old: Any, new: Any, path: str = "") -> list[dict]:
     return []
 
 
+class PatchTestFailed(ValueError):
+    """An RFC 6902 `test` op did not match — the apiserver surfaces this as
+    an Invalid (422) response."""
+
+
+def _resolve(doc: Any, tokens: list[str]) -> Any:
+    cur = doc
+    for t in tokens:
+        cur = cur[int(t)] if isinstance(cur, list) else cur[t]
+    return cur
+
+
 def apply_patch(doc: Any, ops: list[dict]) -> Any:
     doc = copy.deepcopy(doc)
     for op in ops:
@@ -68,15 +80,30 @@ def apply_patch(doc: Any, ops: list[dict]) -> Any:
 
 
 def _apply_one(doc: Any, op: dict, tokens: list[str]) -> Any:
-    if not tokens:  # whole-document op
-        if op["op"] in ("add", "replace"):
-            return copy.deepcopy(op["value"])
-        raise ValueError(f"cannot {op['op']} whole document")
-    parent = doc
-    for t in tokens[:-1]:
-        parent = parent[int(t)] if isinstance(parent, list) else parent[t]
-    last = tokens[-1]
     kind = op["op"]
+    if kind == "test":
+        try:
+            actual = _resolve(doc, tokens)
+        except (KeyError, IndexError, TypeError):
+            raise PatchTestFailed(f"test path {op['path']!r} missing") from None
+        if actual != op.get("value"):
+            raise PatchTestFailed(
+                f"test failed at {op['path']!r}: {actual!r} != "
+                f"{op.get('value')!r}")
+        return doc
+    if kind in ("move", "copy"):
+        src = [_unescape(t) for t in op["from"].split("/")[1:]]
+        value = copy.deepcopy(_resolve(doc, src))
+        if kind == "move":
+            doc = _apply_one(doc, {"op": "remove", "path": op["from"]}, src)
+        return _apply_one(doc, {"op": "add", "path": op["path"],
+                                "value": value}, tokens)
+    if not tokens:  # whole-document op
+        if kind in ("add", "replace"):
+            return copy.deepcopy(op["value"])
+        raise ValueError(f"cannot {kind} whole document")
+    parent = _resolve(doc, tokens[:-1])
+    last = tokens[-1]
     if isinstance(parent, list):
         if kind == "add":
             idx = len(parent) if last == "-" else int(last)
@@ -97,4 +124,4 @@ def _apply_one(doc: Any, op: dict, tokens: list[str]) -> Any:
     return doc
 
 
-__all__ = ["diff", "apply_patch"]
+__all__ = ["diff", "apply_patch", "PatchTestFailed"]

@@ -394,6 +394,40 @@ class ApiServer:
         assert last is not None
         raise last
 
+    def json_patch(
+        self, kind: str, namespace: str, name: str, ops: list,
+        view_out=None, view_in=None,
+    ) -> KubeObject:
+        """RFC 6902 JSON Patch (application/json-patch+json) with the same
+        server-side conflict retry and cross-version view hooks as
+        merge_patch.  A failed `test` op raises InvalidError (the apiserver
+        answers 422), and is NOT retried — the test expresses the caller's
+        precondition, so retrying against fresh state would defeat it."""
+        from .jsonpatch import PatchTestFailed, apply_patch
+
+        last: Exception | None = None
+        for _ in range(16):
+            current = self.get(kind, namespace, name)
+            base = current.to_dict()
+            if view_out is not None:
+                base = view_out(base)
+            try:
+                patched_dict = apply_patch(base, ops)
+            except PatchTestFailed as err:
+                raise InvalidError(str(err)) from None
+            except (KeyError, IndexError, TypeError, ValueError) as err:
+                raise InvalidError(f"json patch failed: {err}") from None
+            patched = KubeObject.from_dict(patched_dict)
+            if view_in is not None:
+                patched = view_in(patched)
+            patched.metadata.resource_version = current.metadata.resource_version
+            try:
+                return self.update(patched)
+            except ConflictError as err:
+                last = err
+        assert last is not None
+        raise last
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             obj = self._objects.get(kind, {}).get((namespace, name))

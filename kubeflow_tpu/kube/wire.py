@@ -13,6 +13,7 @@ and the controllers above it run over real sockets end to end.
 
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import queue
@@ -22,7 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from .errors import ApiError, GoneError, ServerError
+from .errors import ApiError, GoneError, InvalidError, ServerError
 from .meta import KubeObject
 from .resources import DEFAULT_SCHEME, ResourceInfo, Scheme
 from .store import ApiServer, WatchEvent, match_labels
@@ -241,10 +242,28 @@ class _WireHandler(BaseHTTPRequestHandler):
                 selector = parse_label_selector(q.get("labelSelector", ""))
                 items, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
                                                   selector or None)
+                meta: dict = {"resourceVersion": str(rv)}
+                limit = int(q["limit"]) if q.get("limit") else 0
+                if q.get("continue"):
+                    try:
+                        token = json.loads(
+                            base64.b64decode(q["continue"]).decode())
+                        start = tuple(token["start"])
+                    except Exception:
+                        raise ApiError("malformed continue token") from None
+                    items = [o for o in items
+                             if (o.namespace, o.name) > start]
+                if limit and len(items) > limit:
+                    items, rest = items[:limit], items[limit:]
+                    last = items[-1]
+                    meta["continue"] = base64.b64encode(json.dumps(
+                        {"start": [last.namespace, last.name],
+                         "rv": rv}).encode()).decode()
+                    meta["remainingItemCount"] = len(rest)
                 self._send_json(200, {
                     "kind": f"{rt.info.kind}List",
                     "apiVersion": rt.info.api_version,
-                    "metadata": {"resourceVersion": str(rv)},
+                    "metadata": meta,
                     "items": self._convert_out_many(
                         [o.to_dict() for o in items], rt),
                 })
@@ -301,27 +320,30 @@ class _WireHandler(BaseHTTPRequestHandler):
         if rt is None or rt.name is None:
             return
         ctype = self.headers.get("Content-Type", "")
-        if "json-patch" in ctype and "merge" not in ctype:
-            self._send_json(415, status_body(
-                415, "BadRequest", "only merge-patch supported"))
-            return
         try:
             patch = self._read_body()
-            # strategic-merge from kubectl degrades to merge semantics here;
-            # the controllers only send RFC 7386 merge patches
+            # cross-version patches apply to the REQUEST-version view and
+            # convert back to storage — a verbatim merge would smuggle the
+            # request apiVersion (and any version-specific fields) into the
+            # stored object
             storage = self.scheme.by_kind(rt.info.kind).api_version
-            if self.converter is not None and rt.info.api_version != storage:
-                # cross-version patch: the patch applies to the REQUEST-
-                # version view, and the result converts back to storage — a
-                # verbatim merge would smuggle the request apiVersion (and
-                # any version-specific fields) into the stored object
-                updated = self.api.merge_patch(
-                    rt.info.kind, rt.namespace or "", rt.name, patch,
-                    view_out=lambda d: self._convert_out(d, rt),
-                    view_in=lambda o: self._convert_in(o, rt))
+            cross = self.converter is not None and \
+                rt.info.api_version != storage
+            hooks = dict(
+                view_out=lambda d: self._convert_out(d, rt),
+                view_in=lambda o: self._convert_in(o, rt),
+            ) if cross else {}
+            if "json-patch" in ctype and "merge" not in ctype:
+                # RFC 6902; a failed `test` op answers 422 Invalid
+                if not isinstance(patch, list):
+                    raise InvalidError("json patch body must be an op list")
+                updated = self.api.json_patch(
+                    rt.info.kind, rt.namespace or "", rt.name, patch, **hooks)
             else:
+                # merge-patch; strategic-merge from kubectl degrades to RFC
+                # 7386 merge semantics here (no patchMergeKey metadata)
                 updated = self.api.merge_patch(
-                    rt.info.kind, rt.namespace or "", rt.name, patch)
+                    rt.info.kind, rt.namespace or "", rt.name, patch, **hooks)
             self._send_json(200, self._convert_out(updated.to_dict(), rt))
         except ApiError as err:
             self._send_error_status(err)

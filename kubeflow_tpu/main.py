@@ -205,6 +205,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                         help="dir with tls.crt/tls.key for the webhook server")
     parser.add_argument("--enable-leader-election", action="store_true",
                         help="gate reconciling on a coordination.k8s.io Lease")
+    parser.add_argument("--watch-namespace", default="",
+                        help="scope informers to one namespace instead of "
+                             "cluster-wide list/watch")
     parser.add_argument("--leader-election-namespace", default="",
                         help="namespace for the election Lease")
     parser.add_argument("--demo", action="store_true",
@@ -260,7 +263,8 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     def start_reconciling():
         if real:
-            api.start_informers(mgr.watched_kinds())
+            api.start_informers(mgr.watched_kinds(),
+                                namespace=args.watch_namespace or None)
         mgr.start()
         logging.info("manager started; metrics on :%d", args.metrics_addr)
 

@@ -184,6 +184,47 @@ class TestWireProtocol:
         got = client.get("ClusterRoleBinding", "", "crb-1")
         assert got.name == "crb-1" and got.namespace == ""
 
+    def test_paginated_list(self, wire):
+        """limit/continue chunking (apiserver pagination): pages partition
+        the set, each carries the snapshot RV, and the informer relist walks
+        every page."""
+        api, srv, client = wire
+        for i in range(7):
+            client.create(make_notebook(f"pg{i}"))
+        seen: list[str] = []
+        path = "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+        params = "limit=3"
+        pages = 0
+        while True:
+            with urllib.request.urlopen(f"{srv.url}{path}?{params}",
+                                        timeout=5) as resp:
+                body = json.loads(resp.read())
+            pages += 1
+            seen.extend(i["metadata"]["name"] for i in body["items"])
+            cont = body["metadata"].get("continue")
+            if not cont:
+                assert "remainingItemCount" not in body["metadata"]
+                break
+            assert len(body["items"]) == 3
+            params = f"limit=3&continue={urllib.parse.quote(cont)}"
+        assert pages == 3 and seen == [f"pg{i}" for i in range(7)]
+
+    def test_namespace_scoped_informer(self, wire):
+        """start_informers(namespace=...) must only see that namespace."""
+        api, _, client = wire
+        api.create(make_notebook("in-scope", namespace="team-a"))
+        api.create(make_notebook("out-of-scope", namespace="team-b"))
+        seen = []
+        client.watch(lambda ev: seen.append(ev.obj.name))
+        client.start_informers(["Notebook"], namespace="team-a")
+        wait_for(lambda: "in-scope" in seen, msg="scoped informer sync")
+        time.sleep(0.3)  # give an unscoped leak a chance to surface
+        assert "out-of-scope" not in seen
+        api.create(make_notebook("late", namespace="team-b"))
+        api.create(make_notebook("late-a", namespace="team-a"))
+        wait_for(lambda: "late-a" in seen, msg="scoped live event")
+        assert "late" not in seen
+
     def test_generate_name(self, wire):
         _, _, client = wire
         obj = KubeObject("v1", "ConfigMap",
@@ -592,6 +633,40 @@ class TestJsonPatch:
         old = {"x": {"y": 1}}
         new = {"x": [1, 2]}
         assert apply_patch(old, diff(old, new)) == new
+
+    def test_test_move_copy_ops(self):
+        doc = {"a": {"b": 1}, "c": [1, 2]}
+        out = apply_patch(doc, [
+            {"op": "test", "path": "/a/b", "value": 1},
+            {"op": "copy", "from": "/a/b", "path": "/d"},
+            {"op": "move", "from": "/c/0", "path": "/c/-"},
+        ])
+        assert out == {"a": {"b": 1}, "c": [2, 1], "d": 1}
+        from kubeflow_tpu.kube.jsonpatch import PatchTestFailed
+
+        with pytest.raises(PatchTestFailed):
+            apply_patch(doc, [{"op": "test", "path": "/a/b", "value": 99}])
+
+    def test_json_patch_over_wire(self, wire):
+        """client-go's types.JSONPatchType path: RFC 6902 list body with
+        application/json-patch+json (previously 415)."""
+        _, _, client = wire
+        client.create(make_notebook("jp"))
+        patched = client.json_patch("Notebook", "default", "jp", [
+            {"op": "add", "path": "/metadata/labels/patched", "value": "yes"},
+        ])
+        assert patched.metadata.labels["patched"] == "yes"
+        # a failed `test` precondition is 422 Invalid, not retried
+        from kubeflow_tpu.kube import InvalidError
+
+        with pytest.raises(InvalidError, match="test failed"):
+            client.json_patch("Notebook", "default", "jp", [
+                {"op": "test", "path": "/metadata/labels/patched",
+                 "value": "no"},
+                {"op": "remove", "path": "/metadata/labels/patched"},
+            ])
+        assert client.get("Notebook", "default", "jp") \
+            .metadata.labels["patched"] == "yes"
 
 
 # -- rate limiter -------------------------------------------------------------
