@@ -121,6 +121,14 @@ class _WireHandler(BaseHTTPRequestHandler):
     # real apiserver calls the CRD's conversion webhook here; wiring a
     # RemoteConverter (odh/webhook_server.py) reproduces that callout.
     converter = None  # Optional[Callable[[dict, str], dict]]
+    # paginated-list snapshots: token id -> (rv, [KubeObject]) — every page
+    # of one list is served from the SAME snapshot (etcd serves continue
+    # requests at the original revision); bounded, eviction -> 410 Expired
+    # and the client relists, exactly client-go's pager fallback
+    _list_snapshots: "dict[int, tuple[int, list]]" = {}
+    _snapshot_lock = threading.Lock()
+    _snapshot_seq = [0]
+    _MAX_SNAPSHOTS = 32
 
     # -- plumbing -------------------------------------------------------------
     def log_message(self, *args):  # route through logging, not stderr
@@ -239,36 +247,71 @@ class _WireHandler(BaseHTTPRequestHandler):
             elif q.get("watch") in ("true", "1"):
                 self._serve_watch(rt, q)
             else:
-                selector = parse_label_selector(q.get("labelSelector", ""))
-                items, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
-                                                  selector or None)
-                meta: dict = {"resourceVersion": str(rv)}
-                limit = int(q["limit"]) if q.get("limit") else 0
-                if q.get("continue"):
-                    try:
-                        token = json.loads(
-                            base64.b64decode(q["continue"]).decode())
-                        start = tuple(token["start"])
-                    except Exception:
-                        raise ApiError("malformed continue token") from None
-                    items = [o for o in items
-                             if (o.namespace, o.name) > start]
-                if limit and len(items) > limit:
-                    items, rest = items[:limit], items[limit:]
-                    last = items[-1]
-                    meta["continue"] = base64.b64encode(json.dumps(
-                        {"start": [last.namespace, last.name],
-                         "rv": rv}).encode()).decode()
-                    meta["remainingItemCount"] = len(rest)
-                self._send_json(200, {
-                    "kind": f"{rt.info.kind}List",
-                    "apiVersion": rt.info.api_version,
-                    "metadata": meta,
-                    "items": self._convert_out_many(
-                        [o.to_dict() for o in items], rt),
-                })
+                self._serve_list(rt, q)
         except ApiError as err:
             self._send_error_status(err)
+
+    def _serve_list(self, rt: "_Route", q: dict[str, str]) -> None:
+        """LIST with limit/continue pagination.  Every page of one list is
+        served from the same snapshot at the same resourceVersion, so a
+        list-then-watch client resuming from the returned rv replays
+        exactly the events that landed after the snapshot — including any
+        that landed between pages."""
+        try:
+            limit = int(q.get("limit") or 0)
+        except ValueError:
+            self._send_json(400, status_body(
+                400, "BadRequest", f"invalid limit {q.get('limit')!r}"))
+            return
+        limit = max(0, limit)
+        cls = type(self)
+        if q.get("continue"):
+            try:
+                token = json.loads(base64.b64decode(q["continue"]).decode())
+                snap_id, cursor = int(token["snap"]), int(token["cursor"])
+            except Exception:
+                self._send_json(400, status_body(
+                    400, "BadRequest", "malformed continue token"))
+                return
+            with cls._snapshot_lock:
+                snap = cls._list_snapshots.get(snap_id)
+            if snap is None:
+                self._send_json(410, status_body(
+                    410, "Expired",
+                    "continue token expired; restart the list"))
+                return
+            rv, all_items = snap
+            items = all_items[cursor:]
+        else:
+            selector = parse_label_selector(q.get("labelSelector", ""))
+            items, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
+                                              selector or None)
+            cursor = 0
+            all_items = items
+        meta: dict = {"resourceVersion": str(rv)}
+        if limit and len(items) > limit:
+            shown, rest = items[:limit], items[limit:]
+            if cursor == 0:
+                # first page of a truncated list: snapshot it for the
+                # continuation requests
+                with cls._snapshot_lock:
+                    cls._snapshot_seq[0] += 1
+                    snap_id = cls._snapshot_seq[0]
+                    cls._list_snapshots[snap_id] = (rv, all_items)
+                    while len(cls._list_snapshots) > cls._MAX_SNAPSHOTS:
+                        cls._list_snapshots.pop(
+                            next(iter(cls._list_snapshots)))
+            meta["continue"] = base64.b64encode(json.dumps(
+                {"snap": snap_id, "cursor": cursor + limit}).encode()).decode()
+            meta["remainingItemCount"] = len(rest)
+            items = shown
+        self._send_json(200, {
+            "kind": f"{rt.info.kind}List",
+            "apiVersion": rt.info.api_version,
+            "metadata": meta,
+            "items": self._convert_out_many(
+                [o.to_dict() for o in items], rt),
+        })
 
     def do_POST(self):  # noqa: N802
         if not self._guard():
@@ -423,6 +466,11 @@ class KubeApiWireServer:
         handler = type("Handler", (_WireHandler,), {
             "api": api, "scheme": scheme or DEFAULT_SCHEME, "token": token,
             "converter": staticmethod(converter) if converter else None,
+            # per-server pagination snapshots (a class attr on the subclass,
+            # NOT the shared base — two servers must not see each other's
+            # continue tokens)
+            "_list_snapshots": {}, "_snapshot_lock": threading.Lock(),
+            "_snapshot_seq": [0],
         })
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True

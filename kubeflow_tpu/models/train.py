@@ -114,16 +114,73 @@ def chunked_cross_entropy(
     return total / tokens
 
 
-def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES):
+def make_pipeline_forward(model: nn.Module, mesh: Mesh,
+                          microbatches: int):
+    """Forward pass with the layer stack run as a GPipe pipeline
+    (parallel.pipeline): embed and head use the model's methods, the stack
+    applies one DecoderLayer per local layer under the pipeline schedule.
+    Parameters are the SAME tree as the single-program path — "layers" is
+    simply sharded stage-wise by rules_for_mesh."""
+    from ..parallel.pipeline import gpipe
+    from .transformer import DecoderLayer
+
+    cfg = model.cfg
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True "
+                         "(the stacked layers axis is what gets staged)")
+    template = DecoderLayer(cfg, model.mesh)
+
+    def forward(params, tokens, return_hidden: bool):
+        x = model.apply({"params": params}, tokens, method="embed_tokens")
+
+        def apply_one(layer_params, x_mb):
+            positions = jnp.broadcast_to(
+                jnp.arange(x_mb.shape[1]), x_mb.shape[:2])
+            # logical rules OFF inside the stage body: the engine owns
+            # pipeline placement, and flax's logical constraints (written
+            # for the global view) misvalidate under the partially-manual
+            # mesh; dp/fsdp/tp sharding still flows from input shardings
+            # through the auto axes
+            with nn.logical_axis_rules(()):
+                return template.apply({"params": layer_params}, x_mb,
+                                      positions)
+
+        from .transformer import _REMAT_POLICIES
+
+        # unbox: the sliced per-layer params must not carry the stacked
+        # tree's ("layers", ...) partition metadata — the engine owns the
+        # stage placement, and a stale box would re-constrain rank-reduced
+        # slices with the stacked spec
+        x = gpipe(apply_one, nn.unbox(params["layers"]), x, mesh,
+                  microbatches, remat_layer=cfg.remat,
+                  remat_policy=_REMAT_POLICIES[cfg.remat_policy]())
+        return model.apply({"params": params}, x, return_hidden,
+                           method="head")
+
+    return forward
+
+
+def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES,
+                    mesh: Optional[Mesh] = None,
+                    pipeline_microbatches: int = 0):
     cfg = getattr(model, "cfg", None)
     loss_chunks = getattr(cfg, "loss_chunks", 0) or 0
+    stages = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
+    if stages > 1:
+        microbatches = pipeline_microbatches or 2 * stages
+        pipeline_forward = make_pipeline_forward(model, mesh, microbatches)
+
+        def forward(params, tokens, return_hidden=False):
+            return pipeline_forward(params, tokens, return_hidden)
+    else:
+        def forward(params, tokens, return_hidden=False):
+            return model.apply({"params": params}, tokens,
+                               return_hidden=return_hidden)
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         def loss_fn(params):
             if loss_chunks > 0:
-                hidden = model.apply(
-                    {"params": params}, batch["inputs"], return_hidden=True
-                )
+                hidden = forward(params, batch["inputs"], return_hidden=True)
                 if cfg.tie_embeddings:
                     kernel = nn.unbox(params["embed"]["embedding"]).T
                 else:
@@ -135,7 +192,7 @@ def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES):
                     loss_chunks,
                     cfg.logits_softcap,
                 )
-            logits = model.apply({"params": params}, batch["inputs"])
+            logits = forward(params, batch["inputs"])
             return cross_entropy_loss(logits, batch["targets"])
 
         with nn.logical_axis_rules(list(rules)):
@@ -156,13 +213,20 @@ def setup_training(
     mesh: Mesh,
     rng: Optional[jax.Array] = None,
     optimizer: Optional[optax.GradientTransformation] = None,
-    rules=DEFAULT_RULES,
+    rules=None,
     batch_shape: Optional[tuple[int, int]] = None,
+    pipeline_microbatches: int = 0,
 ) -> TrainSetup:
     """Initialize a sharded TrainState on `mesh` and return a jitted train
     step with explicit in/out shardings (single compiled SPMD program; XLA
-    inserts the psums/all-gathers the rules imply)."""
+    inserts the psums/all-gathers the rules imply).  A populated "pipeline"
+    mesh axis switches the layer stack to the GPipe schedule
+    (parallel.pipeline) with `pipeline_microbatches` microbatches
+    (default 2x stages)."""
+    from ..parallel.sharding import rules_for_mesh
+
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rules = rules if rules is not None else rules_for_mesh(mesh)
     model = Transformer(config, mesh)
     batch_shape = batch_shape or (max(len(mesh.devices.flat), 1), 256)
     sample = jnp.zeros(batch_shape, jnp.int32)
@@ -185,7 +249,8 @@ def setup_training(
 
         batch_sharding = logical_sharding(mesh, ("batch", None), rules)
         step = jax.jit(
-            make_train_step(model, optimizer, rules),
+            make_train_step(model, optimizer, rules, mesh=mesh,
+                            pipeline_microbatches=pipeline_microbatches),
             in_shardings=(state_shardings, {"inputs": batch_sharding,
                                             "targets": batch_sharding}),
             out_shardings=(state_shardings, None),

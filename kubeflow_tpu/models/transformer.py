@@ -178,18 +178,22 @@ class DecoderLayer(nn.Module):
 
 
 class Transformer(nn.Module):
-    """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, V]."""
+    """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, V].
+
+    setup-style with separately callable phases (embed_tokens / run_stack /
+    head) so the pipeline-parallel path (parallel.pipeline.gpipe, driven
+    from models.train) can run the layer stack itself while reusing the
+    exact same parameters; __call__ composes the three and is the
+    single-program path.  The parameter tree is identical either way
+    ("embed", "layers"/"layer_i", "final_norm", "lm_head")."""
 
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
 
-    @nn.compact
-    def __call__(self, tokens, return_hidden: bool = False):
+    def setup(self):
         cfg = self.cfg
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
-        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
-
-        embed = nn.Embed(
+        self.embed = nn.Embed(
             cfg.vocab_size,
             cfg.embed_dim,
             dtype=dtype,
@@ -199,9 +203,6 @@ class Transformer(nn.Module):
             ),
             name="embed",
         )
-        x = embed(tokens)
-        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
-
         layer_cls = DecoderLayer
         if cfg.remat:
             layer_cls = nn.remat(
@@ -211,32 +212,59 @@ class Transformer(nn.Module):
                 policy=_REMAT_POLICIES[cfg.remat_policy](),
             )
         if cfg.scan_layers:
+            self.layers = layer_cls(cfg, self.mesh, name="layers")
+        else:
+            self.layer_list = [
+                layer_cls(cfg, self.mesh, name=f"layer_{i}")
+                for i in range(cfg.num_layers)
+            ]
+        self.final_norm = RMSNorm(cfg.norm_eps, dtype, name="final_norm")
+        if not cfg.tie_embeddings:
+            self.lm_head = _dense(
+                cfg.vocab_size, ("embed", "vocab"), "lm_head", dtype, pdtype
+            )
+
+    def embed_tokens(self, tokens):
+        x = self.embed(tokens)
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+    def run_stack(self, x, positions):
+        cfg = self.cfg
+        if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, positions), None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(layer_cls(cfg, self.mesh, name="layers"), x, None)
+            )(self.layers, x, None)
         else:
-            for i in range(cfg.num_layers):
-                x = layer_cls(cfg, self.mesh, name=f"layer_{i}")(x, positions)
+            for layer in self.layer_list:
+                x = layer(x, positions)
+        return x
 
-        x = RMSNorm(cfg.norm_eps, dtype, name="final_norm")(x)
+    def head(self, x, return_hidden: bool = False):
+        cfg = self.cfg
+        pdtype = _dtype(cfg.param_dtype)
+        x = self.final_norm(x)
         if return_hidden:
             # chunked-loss path: the caller applies the LM head per chunk
             # (train.chunked_cross_entropy) so [tokens, vocab] fp32 logits
             # are never resident all at once
             return x
         if cfg.tie_embeddings:
-            logits = embed.attend(x.astype(pdtype))
+            logits = self.embed.attend(x.astype(pdtype))
         else:
-            logits = _dense(
-                cfg.vocab_size, ("embed", "vocab"), "lm_head", dtype, pdtype
-            )(x)
+            logits = self.lm_head(x)
         if cfg.logits_softcap > 0.0:
             cap = cfg.logits_softcap
             logits = jnp.tanh(logits.astype(jnp.float32) / cap) * cap
         return nn.with_logical_constraint(
             logits.astype(jnp.float32), ("batch", "seq", "vocab")
         )
+
+    def __call__(self, tokens, return_hidden: bool = False):
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        x = self.embed_tokens(tokens)
+        x = self.run_stack(x, positions)
+        return self.head(x, return_hidden)

@@ -105,11 +105,15 @@ def ring_attention(
     sharded over `axis_name`; composes with batch sharding over
     `batch_axes` and head (tensor) sharding over `head_axis`."""
     spec = P(batch_axes, axis_name, head_axis, None)
+    # when already inside a (partially-)manual shard_map — the pipeline
+    # engine's stage body — the nested shard_map must be built against the
+    # CONTEXT mesh (same axes, some already manual), not the concrete one
+    context = jax.sharding.get_abstract_mesh()
     local = jax.shard_map(
         lambda q_, k_, v_: _ring_attention_local(
             q_, k_, v_, axis_name, causal, softmax_scale
         ),
-        mesh=mesh,
+        mesh=mesh if context.empty else context,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,

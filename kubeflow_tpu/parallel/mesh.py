@@ -11,6 +11,8 @@ Axis convention (MaxText-style, outermost first):
   fsdp     — parameter/optimizer sharding (ZeRO-3 style)
   sequence — sequence/context parallelism (ring attention)
   tensor   — tensor (Megatron) parallelism for MLP/attention heads
+  pipeline — GPipe pipeline stages (parallel.pipeline; layer stack sharded
+             stage-wise, activations ppermute stage->stage)
 """
 
 from __future__ import annotations
@@ -24,7 +26,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "sequence", "tensor")
+MESH_AXES = ("data", "fsdp", "sequence", "tensor", "pipeline")
 
 
 @dataclass(frozen=True)
@@ -36,27 +38,30 @@ class MeshConfig:
     sequence: int = 1
     tensor: int = 1
     num_slices: int = 1  # >1 => hybrid mesh, data axis spans DCN
+    pipeline: int = 1    # GPipe stages (innermost: stage neighbors on ICI)
 
     def resolved(self, num_devices: int) -> "MeshConfig":
-        fixed = self.fsdp * self.sequence * self.tensor
+        fixed = self.fsdp * self.sequence * self.tensor * self.pipeline
         data = self.data
         if data == -1:
             if num_devices % fixed != 0:
                 raise ValueError(
                     f"{num_devices} devices not divisible by "
-                    f"fsdp*sequence*tensor={fixed}"
+                    f"fsdp*sequence*tensor*pipeline={fixed}"
                 )
             data = num_devices // fixed
         if data * fixed != num_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.sequence}x{self.tensor} != "
-                f"{num_devices} devices"
+                f"mesh {data}x{self.fsdp}x{self.sequence}x{self.tensor}"
+                f"x{self.pipeline} != {num_devices} devices"
             )
-        return MeshConfig(data, self.fsdp, self.sequence, self.tensor, self.num_slices)
+        return MeshConfig(data, self.fsdp, self.sequence, self.tensor,
+                          self.num_slices, self.pipeline)
 
     @property
-    def shape(self) -> tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.sequence, self.tensor)
+    def shape(self) -> tuple[int, int, int, int, int]:
+        return (self.data, self.fsdp, self.sequence, self.tensor,
+                self.pipeline)
 
 
 def make_mesh(
@@ -83,6 +88,7 @@ def make_mesh(
             config.fsdp,
             config.sequence,
             config.tensor,
+            config.pipeline,
         )
         if devices and devices[0].platform == "cpu":
             # virtual CPU devices carry no slice_index attribute; emulate the
@@ -94,7 +100,7 @@ def make_mesh(
         else:
             device_array = mesh_utils.create_hybrid_device_mesh(
                 per_slice,
-                dcn_mesh_shape=(config.num_slices, 1, 1, 1),
+                dcn_mesh_shape=(config.num_slices, 1, 1, 1, 1),
                 devices=devices,
             )
     else:

@@ -1,0 +1,126 @@
+"""Pipeline parallelism: GPipe over a "pipeline" mesh axis.
+
+TPU-first design: the decoder's stacked layer parameters (leading "layers"
+axis from `nn.scan`) are sharded across pipeline stages — rule
+("layers", "pipeline"), see parallel.sharding.rules_for_mesh — and the
+schedule runs under a PARTIALLY-manual `jax.shard_map`: only the pipeline
+axis is manual (explicit `lax.ppermute` moves activations stage->stage over
+ICI neighbors), while data/fsdp/sequence/tensor stay automatic so the
+layers' internal logical sharding constraints keep composing.  pp therefore
+stacks with dp/fsdp/sp/tp in one jitted step.
+
+Schedule: classic GPipe.  The global batch splits into M microbatches; for
+T = M + S - 1 ticks every stage applies its L/S layers to the activation it
+holds and rotates the result to the next stage.  Stage s computes microbatch
+m at tick t = s + m; ticks outside that window are bubbles (computed but
+masked — uniform control flow keeps the collective schedule identical on
+every shard, as ring attention does).  The backward schedule is whatever AD
+produces for the scan (activations for all T ticks are live unless
+`remat_layer` wraps the layer), so this is throughput-optimal in FLOPs but
+not 1F1B-optimal in memory — the standard GPipe trade.
+
+The reference has no analog (single-pod notebooks, SURVEY.md §2.5); this is
+part of the in-notebook compute plane the TPU build adds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+PIPELINE_AXIS = "pipeline"
+
+
+def num_stages(mesh: Mesh, axis_name: str = PIPELINE_AXIS) -> int:
+    return int(mesh.shape.get(axis_name, 1))
+
+
+def gpipe(
+    apply_layer: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = PIPELINE_AXIS,
+    remat_layer: bool = False,
+    remat_policy=None,
+) -> jax.Array:
+    """Run a layer stack as a GPipe pipeline.
+
+    apply_layer(layer_params, x) applies ONE layer (params without the
+    leading stack axis) to activations x of shape [mb, ...]; the engine
+    scans it over each stage's local layers.  stacked_params is the full
+    pytree with leading axis L (L % stages == 0), sharded over `axis_name`.
+    x: [B, ...] with B % num_microbatches == 0.  Returns [B, ...] outputs,
+    replicated over the pipeline axis.
+    """
+    stages = num_stages(mesh, axis_name)
+    if stages <= 1:
+        def body(carry, layer_params):
+            return apply_layer(layer_params, carry), None
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if layers % stages != 0:
+        raise ValueError(f"{layers} layers not divisible by {stages} stages")
+    batch = x.shape[0]
+    if batch % num_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by {num_microbatches} microbatches")
+
+    one_layer = apply_layer
+    if remat_layer:
+        one_layer = jax.checkpoint(apply_layer, policy=remat_policy)
+
+    m_shape = (num_microbatches, batch // num_microbatches) + x.shape[1:]
+
+    def body(stage_params, x_all):
+        # stage_params: this stage's [L/stages, ...] slice; x_all: [M, mb, ...]
+        s = jax.lax.axis_index(axis_name)
+        microbatches = x_all.shape[0]
+        ticks = microbatches + stages - 1
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+
+        def apply_stage(x_in):
+            def scan_body(carry, layer_params):
+                return one_layer(layer_params, carry), None
+            out, _ = jax.lax.scan(scan_body, x_in, stage_params)
+            return out
+
+        buf = jnp.zeros_like(x_all[0])
+        out = jnp.zeros_like(x_all)
+
+        def tick(carry, t):
+            buf, out = carry
+            inject = x_all[jnp.clip(t, 0, microbatches - 1)]
+            x_in = jnp.where(s == 0, inject, buf)
+            y = apply_stage(x_in)
+            m = t - (stages - 1)
+            write = out.at[jnp.clip(m, 0, microbatches - 1)].set(y)
+            out = jnp.where((s == stages - 1) & (m >= 0), write, out)
+            buf = jax.lax.ppermute(y, axis_name, perm)
+            return (buf, out), None
+
+        (buf, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(ticks))
+        # results live on the last stage; zero-elsewhere + psum replicates
+        # them across the pipeline (the head/loss runs on every stage)
+        out = jnp.where(s == stages - 1, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis_name)
+
+    run = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    out = run(stacked_params, x.reshape(m_shape))
+    return out.reshape(x.shape)
+
+
+__all__ = ["gpipe", "num_stages", "PIPELINE_AXIS"]

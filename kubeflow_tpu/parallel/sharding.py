@@ -29,6 +29,26 @@ DEFAULT_RULES: tuple[tuple[str, object], ...] = (
 )
 
 
+def rules_for_mesh(mesh: Mesh) -> tuple[tuple[str, object], ...]:
+    """DEFAULT_RULES specialized to the mesh's populated axes:
+
+    - a populated "pipeline" axis shards the stacked "layers" param axis
+      stage-wise (parallel.pipeline's GPipe engine consumes exactly that
+      layout);
+    - the "expert" logical axis (MoE expert stack, ops.moe) shards over
+      "tensor" — experts are the MLP's parallelism dimension, so expert
+      parallelism reuses the Megatron axis;
+    - everything else is DEFAULT_RULES.
+    """
+    rules = [(name, ax) for name, ax in DEFAULT_RULES if name != "layers"]
+    if mesh.shape.get("pipeline", 1) > 1:
+        rules.insert(0, ("layers", "pipeline"))
+    else:
+        rules.insert(0, ("layers", None))
+    rules.append(("expert", "tensor"))
+    return tuple(rules)
+
+
 def rules_dict(
     rules: Optional[Sequence[tuple[str, object]]] = None,
 ) -> dict[str, object]:

@@ -209,6 +209,58 @@ class TestWireProtocol:
             params = f"limit=3&continue={urllib.parse.quote(cont)}"
         assert pages == 3 and seen == [f"pg{i}" for i in range(7)]
 
+    def test_paginated_list_is_snapshot_consistent(self, wire):
+        """All pages of one list serve the SAME snapshot at the same rv
+        (etcd serves continues at the original revision) — writes landing
+        between pages must not leak in or punch holes."""
+        api, srv, client = wire
+        for i in range(6):
+            client.create(make_notebook(f"sn{i}"))
+        path = "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+        with urllib.request.urlopen(f"{srv.url}{path}?limit=3",
+                                    timeout=5) as resp:
+            page1 = json.loads(resp.read())
+        # mutate between pages: delete a page-2 item, add a before-cursor item
+        client.delete("Notebook", "default", "sn4")
+        client.create(make_notebook("sn0a"))
+        cont = urllib.parse.quote(page1["metadata"]["continue"])
+        with urllib.request.urlopen(f"{srv.url}{path}?limit=3&continue={cont}",
+                                    timeout=5) as resp:
+            page2 = json.loads(resp.read())
+        names = [i["metadata"]["name"] for i in page1["items"] + page2["items"]]
+        assert names == [f"sn{i}" for i in range(6)], names  # the snapshot
+        assert page2["metadata"]["resourceVersion"] == \
+            page1["metadata"]["resourceVersion"]
+        # a FRESH list sees the new state
+        with urllib.request.urlopen(f"{srv.url}{path}", timeout=5) as resp:
+            fresh = [i["metadata"]["name"]
+                     for i in json.loads(resp.read())["items"]]
+        assert "sn4" not in fresh and "sn0a" in fresh
+
+    def test_pagination_error_codes(self, wire):
+        _, srv, client = wire
+        client.create(make_notebook("pe"))
+        path = "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+        for query, code in [("limit=abc", 400), ("limit=2&continue=!!!", 400)]:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{srv.url}{path}?{query}", timeout=5)
+            assert exc.value.code == code, query
+        # an evicted snapshot answers 410 Expired -> client relists
+        for i in range(40):
+            client.create(make_notebook(f"evict{i:02d}"))
+        with urllib.request.urlopen(f"{srv.url}{path}?limit=2",
+                                    timeout=5) as resp:
+            token = json.loads(resp.read())["metadata"]["continue"]
+        for _ in range(33):  # churn past _MAX_SNAPSHOTS
+            with urllib.request.urlopen(f"{srv.url}{path}?limit=2",
+                                        timeout=5) as resp:
+                json.loads(resp.read())
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"{srv.url}{path}?limit=2&continue={urllib.parse.quote(token)}",
+                timeout=5)
+        assert exc.value.code == 410
+
     def test_namespace_scoped_informer(self, wire):
         """start_informers(namespace=...) must only see that namespace."""
         api, _, client = wire
