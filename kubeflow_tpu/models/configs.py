@@ -37,6 +37,11 @@ class TransformerConfig:
                                      # models.transformer._REMAT_POLICIES)
     flash_block_q: int = 0           # Pallas flash tile sizes; 0 = kernel
     flash_block_k: int = 0           # defaults (tuned per-chip in bench)
+    moe_experts: int = 0             # >0: MLPs become MoE (models.moe)
+    moe_top_k: int = 2               # experts per token
+    moe_capacity_factor: float = 1.25
+    moe_mlp_dim: int = 0             # per-expert hidden; 0 = mlp_dim
+    moe_aux_weight: float = 0.01     # load-balance loss weight
 
     def with_(self, **kw) -> "TransformerConfig":
         return replace(self, **kw)
@@ -44,11 +49,16 @@ class TransformerConfig:
     @property
     def num_params(self) -> int:
         """Parameter count (embed + per-layer attn/mlp/norms + final norm
-        [+ untied output head])."""
+        [+ untied output head]); MoE multiplies the MLP by the expert count
+        and adds the router."""
         d, l = self.embed_dim, self.num_layers
         attn = d * self.num_heads * self.head_dim * 2  # q + out
         attn += d * self.num_kv_heads * self.head_dim * 2  # k + v
-        mlp = 3 * d * self.mlp_dim  # gate, up, down
+        if self.moe_experts > 0:
+            expert_mlp = 3 * d * (self.moe_mlp_dim or self.mlp_dim)
+            mlp = self.moe_experts * expert_mlp + d * self.moe_experts
+        else:
+            mlp = 3 * d * self.mlp_dim  # gate, up, down
         norms = 2 * d
         per_layer = attn + mlp + norms
         embed = self.vocab_size * d
@@ -56,9 +66,11 @@ class TransformerConfig:
         return embed + l * per_layer + d + head
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Training (fwd+bwd) matmul FLOPs per token: 6x matmul params plus
-        the causal attention term 12*L*S*(H*Dh)/2 (QK^T and AV, halved for
-        causality) — the standard MFU accounting (PaLM appendix B).
+        """Training (fwd+bwd) matmul FLOPs per token: 6x ACTIVATED matmul
+        params plus the causal attention term 12*L*S*(H*Dh)/2 (QK^T and AV,
+        halved for causality) — the standard MFU accounting (PaLM appendix
+        B).  For MoE only the top-k activated experts count (the honest
+        sparse-FLOPs convention).
 
         The embedding table is a lookup (no matmul) when untied, so it is
         excluded; when tied it doubles as the logits matmul weight and
@@ -66,6 +78,10 @@ class TransformerConfig:
         matmul_params = self.num_params - (
             0 if self.tie_embeddings else self.vocab_size * self.embed_dim
         )
+        if self.moe_experts > 0:
+            expert_mlp = 3 * self.embed_dim * (self.moe_mlp_dim or self.mlp_dim)
+            inactive = self.moe_experts - min(self.moe_top_k, self.moe_experts)
+            matmul_params -= self.num_layers * inactive * expert_mlp
         attn = 12 * self.num_layers * seq_len * self.num_heads * self.head_dim / 2
         return 6.0 * matmul_params + attn
 

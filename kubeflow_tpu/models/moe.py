@@ -1,0 +1,146 @@
+"""Mixture-of-Experts MLP with expert parallelism, TPU-first.
+
+GShard/Switch-style dense dispatch: the router picks top-k experts per
+token; tokens are packed into fixed-capacity per-expert buffers with
+one-hot dispatch/combine einsums — static shapes, no gather/scatter, so
+XLA tiles everything onto the MXU and inserts the dispatch/combine
+all-to-alls implied by the shardings (the original GShard recipe).  The
+expert-stacked parameters and the [experts, ...] token buffers carry the
+logical "expert" axis, mapped to the mesh's "expert" axis by
+parallel.sharding.rules_for_mesh — expert parallelism composes with
+dp/fsdp/sp/tp/pp in the same jitted step.
+
+Capacity overflow drops tokens (their combine weight is zero and the
+residual stream passes them through unchanged), exactly Switch's behavior;
+the load-balance auxiliary loss (Switch eq. 4: E * sum_e f_e * P_e) keeps
+routing uniform so drops stay rare.
+
+The reference has no compute plane (SURVEY.md §2.5); this extends the
+in-notebook model zoo the TPU build adds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from .configs import TransformerConfig
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+def load_balance_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
+    """Switch Transformers eq. 4: num_experts * sum_e(f_e * P_e), where
+    f_e is the fraction of tokens whose TOP-1 choice is expert e and P_e
+    the mean router probability for e.  Equals 1.0 under perfectly uniform
+    routing; rises as routing collapses."""
+    num_experts = probs.shape[-1]
+    # fraction of tokens dispatched to each expert (top-1 one-hot)
+    f = jnp.mean(expert_mask.astype(jnp.float32), axis=tuple(range(expert_mask.ndim - 1)))
+    p = jnp.mean(probs.astype(jnp.float32), axis=tuple(range(probs.ndim - 1)))
+    return num_experts * jnp.sum(f * p)
+
+
+class _ExpertFFN(nn.Module):
+    """One expert's gated MLP; vmapped over the expert axis by MoEMLP."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):  # [tokens..., D]
+        cfg = self.cfg
+        dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        mlp_dim = cfg.moe_mlp_dim or cfg.mlp_dim
+
+        def dense(features, axes, name):
+            return nn.DenseGeneral(
+                features, use_bias=False, dtype=dtype, param_dtype=pdtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), axes),
+                name=name)
+
+        gate = dense(mlp_dim, ("embed", "mlp"), "gate")(x)
+        up = dense(mlp_dim, ("embed", "mlp"), "up")(x)
+        return dense(cfg.embed_dim, ("mlp", "embed"), "down")(
+            nn.silu(gate) * up)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: [B, S, D] -> ([B, S, D], aux_loss)."""
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x) -> tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        num_experts, top_k = cfg.moe_experts, cfg.moe_top_k
+        batch, seq, dim = x.shape
+
+        # router in fp32 (routing decisions are precision-sensitive)
+        router = nn.DenseGeneral(
+            num_experts, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", None)),
+            name="router")
+        probs = jax.nn.softmax(router(x.astype(jnp.float32)), axis=-1)
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [B, S, k]
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+        # fixed per-expert capacity over each row's tokens
+        capacity = max(1, int(cfg.moe_capacity_factor * seq * top_k
+                              / num_experts))
+        # [B, S, k, E] one-hot choice
+        choice = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.float32)
+        # position of each (token, choice) in its expert's buffer: running
+        # count over the flattened (S, k) dispatch order, per row
+        flat = choice.reshape(batch, seq * top_k, num_experts)
+        position = jnp.cumsum(flat, axis=1) - flat  # count before me
+        within = (position < capacity).astype(jnp.float32) * flat
+        position = position.reshape(batch, seq, top_k, num_experts)
+        within = within.reshape(batch, seq, top_k, num_experts)
+
+        # combine[B,S,k,E,C]: gate weight at the assigned buffer slot
+        slot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)
+        combine = (gate_vals[..., None, None] * within[..., None] * slot)
+        combine = jnp.sum(combine, axis=2)          # [B, S, E, C]
+        dispatch = (combine > 0.0).astype(x.dtype)  # [B, S, E, C]
+
+        # dispatch: pack tokens into per-expert buffers; the "expert"-
+        # sharded output is where XLA inserts the all-to-all
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", "batch", None, "embed"))
+
+        expert_out = nn.vmap(
+            _ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(cfg, name="experts")(expert_in)          # [E, B, C, D]
+        expert_out = nn.with_logical_constraint(
+            expert_out, ("expert", "batch", None, "embed"))
+
+        out = jnp.einsum("bsec,ebcd->bsd",
+                         combine.astype(expert_out.dtype), expert_out)
+        out = nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+        top1 = jax.nn.one_hot(gate_idx[..., 0], num_experts,
+                              dtype=jnp.float32)
+        aux = load_balance_loss(probs.reshape(-1, num_experts),
+                                top1.reshape(-1, num_experts))
+        return out, aux
+
+
+__all__ = ["MoEMLP", "load_balance_loss"]

@@ -128,9 +128,15 @@ def make_pipeline_forward(model: nn.Module, mesh: Mesh,
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(the stacked layers axis is what gets staged)")
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "MoE under pipeline parallelism is not supported yet: the "
+            "GPipe engine carries a single activation array and would "
+            "drop the per-layer load-balance aux loss")
     template = DecoderLayer(cfg, model.mesh)
 
-    def forward(params, tokens, return_hidden: bool):
+    def forward(params, tokens, return_hidden: bool = False):
+        """Returns (out, aux) matching the return_aux=True model path."""
         x = model.apply({"params": params}, tokens, method="embed_tokens")
 
         def apply_one(layer_params, x_mb):
@@ -154,8 +160,9 @@ def make_pipeline_forward(model: nn.Module, mesh: Mesh,
         x = gpipe(apply_one, nn.unbox(params["layers"]), x, mesh,
                   microbatches, remat_layer=cfg.remat,
                   remat_policy=_REMAT_POLICIES[cfg.remat_policy]())
-        return model.apply({"params": params}, x, return_hidden,
-                           method="head")
+        out = model.apply({"params": params}, x, return_hidden,
+                          method="head")
+        return out, jnp.float32(0.0)
 
     return forward
 
@@ -165,44 +172,52 @@ def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES,
                     pipeline_microbatches: int = 0):
     cfg = getattr(model, "cfg", None)
     loss_chunks = getattr(cfg, "loss_chunks", 0) or 0
+    moe = getattr(cfg, "moe_experts", 0) > 0
     stages = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
     if stages > 1:
         microbatches = pipeline_microbatches or 2 * stages
-        pipeline_forward = make_pipeline_forward(model, mesh, microbatches)
-
-        def forward(params, tokens, return_hidden=False):
-            return pipeline_forward(params, tokens, return_hidden)
+        forward = make_pipeline_forward(model, mesh, microbatches)
     else:
         def forward(params, tokens, return_hidden=False):
-            return model.apply({"params": params}, tokens,
-                               return_hidden=return_hidden)
+            out, aux = model.apply({"params": params}, tokens,
+                                   return_hidden=return_hidden,
+                                   return_aux=True)
+            return out, aux
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         def loss_fn(params):
             if loss_chunks > 0:
-                hidden = forward(params, batch["inputs"], return_hidden=True)
+                hidden, aux = forward(params, batch["inputs"],
+                                      return_hidden=True)
                 if cfg.tie_embeddings:
                     kernel = nn.unbox(params["embed"]["embedding"]).T
                 else:
                     kernel = nn.unbox(params["lm_head"]["kernel"])
-                return chunked_cross_entropy(
+                ce = chunked_cross_entropy(
                     hidden,
                     batch["targets"],
                     kernel,
                     loss_chunks,
                     cfg.logits_softcap,
                 )
-            logits = forward(params, batch["inputs"])
-            return cross_entropy_loss(logits, batch["targets"])
+            else:
+                logits, aux = forward(params, batch["inputs"])
+                ce = cross_entropy_loss(logits, batch["targets"])
+            total = ce + cfg.moe_aux_weight * aux if moe else ce
+            return total, (ce, aux)
 
         with nn.logical_axis_rules(list(rules)):
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            (loss, (ce, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads=grads)
         metrics = {
             "loss": loss,
             "grad_norm": optax.global_norm(grads),
             "step": state.step,
         }
+        if moe:
+            metrics["ce_loss"] = ce
+            metrics["moe_aux_loss"] = aux
         return new_state, metrics
 
     return step

@@ -162,6 +162,10 @@ class MLP(nn.Module):
 
 
 class DecoderLayer(nn.Module):
+    """One decoder block.  Dense configs return the residual stream; MoE
+    configs (cfg.moe_experts > 0) return (stream, aux_loss) — run_stack
+    accumulates the aux term across layers."""
+
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
 
@@ -173,6 +177,15 @@ class DecoderLayer(nn.Module):
         h = RMSNorm(cfg.norm_eps, dtype, name="attn_norm")(x)
         x = x + Attention(cfg, self.mesh, name="attn")(h, positions)
         h = RMSNorm(cfg.norm_eps, dtype, name="mlp_norm")(x)
+        if cfg.moe_experts > 0:
+            from .moe import MoEMLP
+
+            mlp_out, aux = MoEMLP(cfg, self.mesh, name="moe")(h)
+            x = x + mlp_out
+            return (
+                nn.with_logical_constraint(x, ("batch", "seq", "embed")),
+                aux,
+            )
         x = x + MLP(cfg, name="mlp")(h)
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
@@ -229,19 +242,36 @@ class Transformer(nn.Module):
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
     def run_stack(self, x, positions):
+        """Apply the layer stack; returns (x, aux) where aux is the summed
+        MoE load-balance loss (0.0 for dense configs)."""
         cfg = self.cfg
+        moe = cfg.moe_experts > 0
         if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, positions), None),
+            def body(mdl, carry, _):
+                x, aux = carry
+                out = mdl(x, positions)
+                if moe:
+                    x, layer_aux = out
+                    return (x, aux + layer_aux), None
+                return (out, aux), None
+
+            (x, aux), _ = nn.scan(
+                body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(self.layers, x, None)
+            )(self.layers, (x, jnp.float32(0.0)), None)
         else:
+            aux = jnp.float32(0.0)
             for layer in self.layer_list:
-                x = layer(x, positions)
-        return x
+                out = layer(x, positions)
+                if moe:
+                    x, layer_aux = out
+                    aux = aux + layer_aux
+                else:
+                    x = out
+        return x, aux
 
     def head(self, x, return_hidden: bool = False):
         cfg = self.cfg
@@ -263,8 +293,10 @@ class Transformer(nn.Module):
             logits.astype(jnp.float32), ("batch", "seq", "vocab")
         )
 
-    def __call__(self, tokens, return_hidden: bool = False):
+    def __call__(self, tokens, return_hidden: bool = False,
+                 return_aux: bool = False):
         positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
         x = self.embed_tokens(tokens)
-        x = self.run_stack(x, positions)
-        return self.head(x, return_hidden)
+        x, aux = self.run_stack(x, positions)
+        out = self.head(x, return_hidden)
+        return (out, aux) if return_aux else out

@@ -13,6 +13,8 @@ Axis convention (MaxText-style, outermost first):
   tensor   — tensor (Megatron) parallelism for MLP/attention heads
   pipeline — GPipe pipeline stages (parallel.pipeline; layer stack sharded
              stage-wise, activations ppermute stage->stage)
+  expert   — MoE expert parallelism (models.moe; XLA inserts the
+             dispatch/combine all-to-alls the einsum shardings imply)
 """
 
 from __future__ import annotations
@@ -26,7 +28,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "sequence", "tensor", "pipeline")
+MESH_AXES = ("data", "fsdp", "sequence", "tensor", "pipeline", "expert")
 
 
 @dataclass(frozen=True)
@@ -39,29 +41,32 @@ class MeshConfig:
     tensor: int = 1
     num_slices: int = 1  # >1 => hybrid mesh, data axis spans DCN
     pipeline: int = 1    # GPipe stages (innermost: stage neighbors on ICI)
+    expert: int = 1      # MoE expert parallelism (models.moe; all-to-all
+                         # dispatch/combine rides ICI)
 
     def resolved(self, num_devices: int) -> "MeshConfig":
-        fixed = self.fsdp * self.sequence * self.tensor * self.pipeline
+        fixed = (self.fsdp * self.sequence * self.tensor
+                 * self.pipeline * self.expert)
         data = self.data
         if data == -1:
             if num_devices % fixed != 0:
                 raise ValueError(
                     f"{num_devices} devices not divisible by "
-                    f"fsdp*sequence*tensor*pipeline={fixed}"
+                    f"fsdp*sequence*tensor*pipeline*expert={fixed}"
                 )
             data = num_devices // fixed
         if data * fixed != num_devices:
             raise ValueError(
                 f"mesh {data}x{self.fsdp}x{self.sequence}x{self.tensor}"
-                f"x{self.pipeline} != {num_devices} devices"
+                f"x{self.pipeline}x{self.expert} != {num_devices} devices"
             )
         return MeshConfig(data, self.fsdp, self.sequence, self.tensor,
-                          self.num_slices, self.pipeline)
+                          self.num_slices, self.pipeline, self.expert)
 
     @property
-    def shape(self) -> tuple[int, int, int, int, int]:
+    def shape(self) -> tuple[int, int, int, int, int, int]:
         return (self.data, self.fsdp, self.sequence, self.tensor,
-                self.pipeline)
+                self.pipeline, self.expert)
 
 
 def make_mesh(
@@ -89,6 +94,7 @@ def make_mesh(
             config.sequence,
             config.tensor,
             config.pipeline,
+            config.expert,
         )
         if devices and devices[0].platform == "cpu":
             # virtual CPU devices carry no slice_index attribute; emulate the
@@ -100,7 +106,7 @@ def make_mesh(
         else:
             device_array = mesh_utils.create_hybrid_device_mesh(
                 per_slice,
-                dcn_mesh_shape=(config.num_slices, 1, 1, 1, 1),
+                dcn_mesh_shape=(config.num_slices, 1, 1, 1, 1, 1),
                 devices=devices,
             )
     else:

@@ -35,9 +35,10 @@ def rules_for_mesh(mesh: Mesh) -> tuple[tuple[str, object], ...]:
     - a populated "pipeline" axis shards the stacked "layers" param axis
       stage-wise (parallel.pipeline's GPipe engine consumes exactly that
       layout);
-    - the "expert" logical axis (MoE expert stack, ops.moe) shards over
-      "tensor" — experts are the MLP's parallelism dimension, so expert
-      parallelism reuses the Megatron axis;
+    - the "expert" logical axis (MoE expert stack + dispatched token
+      buffers, models.moe) shards over the mesh's "expert" axis; XLA
+      inserts the dispatch/combine all-to-alls the einsum shardings imply
+      (the GShard recipe);
     - everything else is DEFAULT_RULES.
     """
     rules = [(name, ax) for name, ax in DEFAULT_RULES if name != "layers"]
@@ -45,7 +46,8 @@ def rules_for_mesh(mesh: Mesh) -> tuple[tuple[str, object], ...]:
         rules.insert(0, ("layers", "pipeline"))
     else:
         rules.insert(0, ("layers", None))
-    rules.append(("expert", "tensor"))
+    rules.append(("expert", "expert" if mesh.shape.get("expert", 1) > 1
+                  else None))
     return tuple(rules)
 
 

@@ -22,7 +22,7 @@ class TestMesh:
     def test_resolves_data_axis(self):
         mesh = make_mesh(MeshConfig(data=-1, fsdp=2, sequence=1, tensor=2))
         assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "sequence": 1,
-                                    "tensor": 2, "pipeline": 1}
+                                    "tensor": 2, "pipeline": 1, "expert": 1}
 
     def test_rejects_bad_factorization(self):
         with pytest.raises(ValueError):
