@@ -1,0 +1,117 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+TPU-first decode: the cache is a fixed [B, max_seq_len] ring per layer
+(flax "cache" collection, stacked over the scanned layer axis), written
+with `dynamic_update_slice` — no growing shapes, so the whole decode loop
+is ONE compiled `lax.scan` program.  Prefill runs the prompt through the
+same decode path in a single call (filling the cache), then the loop feeds
+one token per step with its global position; rope is applied with global
+positions before caching, so cached keys never need re-rotation.
+
+Sampling: greedy (temperature=0) or temperature + top-k.  The reference
+ships no inference path (it is a notebook controller); this is part of the
+in-notebook compute plane the TPU build adds, and what a workbench uses to
+serve/inspect a model it just trained.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .configs import TransformerConfig
+from .transformer import Transformer
+
+
+def decode_config(cfg: TransformerConfig) -> TransformerConfig:
+    """Training config -> decode config: remat off (nothing to rematerialize
+    and the cache mutation must not be replayed), XLA attention (single-token
+    queries never fit the flash kernel's tiling)."""
+    return cfg.with_(remat=False, attention_impl="xla")
+
+
+def sample_token(
+    logits: jax.Array,
+    rng: Optional[jax.Array],
+    temperature: float,
+    top_k: int = 0,
+) -> jax.Array:
+    """[B, V] logits -> [B] token ids."""
+    if temperature <= 0.0 or rng is None:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(
+    cfg: TransformerConfig,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: Optional[jax.Array] = None,
+    mesh=None,
+) -> jax.Array:
+    """prompt [B, P] int32 -> [B, P + max_new_tokens] completions.
+
+    Prompts are assumed unpadded and equal-length (the notebook batch
+    case); P + max_new_tokens must fit cfg.max_seq_len.
+    """
+    cfg = decode_config(cfg)
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt({prompt_len}) + new({max_new_tokens}) exceeds "
+            f"max_seq_len {cfg.max_seq_len}")
+    model = Transformer(cfg, mesh)
+    if rng is None and temperature > 0.0:
+        rng = jax.random.PRNGKey(0)
+
+    # prefill: one full-prompt pass fills the cache and yields the first
+    # sampled token from the last prompt position
+    (logits, _aux), cache_vars = model.apply(
+        {"params": params}, prompt, return_aux=True, decode=True,
+        mutable=["cache"])
+    step_rng = rng
+    if step_rng is not None:
+        step_rng, sub = jax.random.split(step_rng)
+    else:
+        sub = None
+    next_tok = sample_token(logits[:, -1, :], sub, temperature, top_k)
+
+    # thread the cache through the scan carry; every step is the same
+    # static-shape program
+    def scan_step(carry, _):
+        cache, tok, pos, rng_ = carry
+        positions = jnp.broadcast_to(pos, (batch, 1))
+        (logits, _), new_cache = model.apply(
+            {"params": params, **cache}, tok[:, None], return_aux=True,
+            decode=True, positions=positions, mutable=["cache"])
+        if rng_ is not None:
+            rng_, sub = jax.random.split(rng_)
+        else:
+            sub = None
+        nxt = sample_token(logits[:, -1, :], sub, temperature, top_k)
+        return (new_cache, nxt, pos + 1, rng_), tok
+
+    if max_new_tokens == 1:
+        return jnp.concatenate([prompt, next_tok[:, None]], axis=1)
+
+    carry = (cache_vars, next_tok, jnp.int32(prompt_len), step_rng)
+    (_, last_tok, _, _), toks = jax.lax.scan(
+        scan_step, carry, None, length=max_new_tokens - 1)
+    # toks[i] is the token fed at step i (= sampled at step i-1); append the
+    # final sample to complete the sequence
+    generated = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last_tok[:, None]], axis=1)
+    return jnp.concatenate([prompt, generated], axis=1)
+
+
+__all__ = ["generate", "decode_config", "sample_token"]

@@ -101,7 +101,7 @@ class Attention(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
         q = _dense(
@@ -121,6 +121,40 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+
+        if decode:
+            # KV cache (flax "cache" collection): static [B, max_seq] ring
+            # written with dynamic_update_slice — XLA-friendly in-place
+            # updates, no growing shapes.  rope was applied with GLOBAL
+            # positions above, so cached keys need no re-rotation.
+            batch = x.shape[0]
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim),
+                k.dtype)
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim),
+                v.dtype)
+            index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32))
+            cur = index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k, (0, cur, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v, (0, cur, 0, 0))
+            index.value = cur + x.shape[1]
+            # causal mask with q at global offset `cur` covers both the
+            # unwritten tail (kv_pos > q_pos) and ordinary causality
+            out = attention(q, cached_k.value, cached_v.value, causal=True,
+                            impl="xla", q_offset=cur)
+            out = nn.with_logical_constraint(
+                out, ("batch", "seq", "heads", "kv"))
+            return _dense(
+                cfg.embed_dim, ("heads", "kv", "embed"), "out",
+                dtype, _dtype(cfg.param_dtype), contract_axes=(-2, -1),
+            )(out)
 
         use_ring = (
             cfg.attention_impl == "ring"
@@ -170,12 +204,12 @@ class DecoderLayer(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         h = RMSNorm(cfg.norm_eps, dtype, name="attn_norm")(x)
-        x = x + Attention(cfg, self.mesh, name="attn")(h, positions)
+        x = x + Attention(cfg, self.mesh, name="attn")(h, positions, decode)
         h = RMSNorm(cfg.norm_eps, dtype, name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from .moe import MoEMLP
@@ -241,15 +275,21 @@ class Transformer(nn.Module):
         x = self.embed(tokens)
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
-    def run_stack(self, x, positions):
+    def run_stack(self, x, positions, decode: bool = False):
         """Apply the layer stack; returns (x, aux) where aux is the summed
-        MoE load-balance loss (0.0 for dense configs)."""
+        MoE load-balance loss (0.0 for dense configs).  decode=True runs
+        the KV-cache path (the "cache" collection gains a stacked layer
+        axis under scan)."""
         cfg = self.cfg
         moe = cfg.moe_experts > 0
         if cfg.scan_layers:
             def body(mdl, carry, _):
                 x, aux = carry
-                out = mdl(x, positions)
+                # pass `decode` only when set: the remat wrapper treats
+                # call args as dynamic, and a traced boolean would break
+                # the layer's Python-level branch (decode configs run with
+                # remat=False; models.generate enforces that)
+                out = mdl(x, positions, True) if decode else mdl(x, positions)
                 if moe:
                     x, layer_aux = out
                     return (x, aux + layer_aux), None
@@ -257,7 +297,7 @@ class Transformer(nn.Module):
 
             (x, aux), _ = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
@@ -265,7 +305,8 @@ class Transformer(nn.Module):
         else:
             aux = jnp.float32(0.0)
             for layer in self.layer_list:
-                out = layer(x, positions)
+                out = layer(x, positions, True) if decode \
+                    else layer(x, positions)
                 if moe:
                     x, layer_aux = out
                     aux = aux + layer_aux
@@ -294,9 +335,12 @@ class Transformer(nn.Module):
         )
 
     def __call__(self, tokens, return_hidden: bool = False,
-                 return_aux: bool = False):
-        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+                 return_aux: bool = False, decode: bool = False,
+                 positions=None):
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]),
+                                         tokens.shape)
         x = self.embed_tokens(tokens)
-        x, aux = self.run_stack(x, positions)
+        x, aux = self.run_stack(x, positions, decode)
         out = self.head(x, return_hidden)
         return (out, aux) if return_aux else out

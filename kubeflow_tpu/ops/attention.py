@@ -123,18 +123,27 @@ def attention(
     softmax_scale: Optional[float] = None,
     block_q: int = 0,
     block_k: int = 0,
+    q_offset=0,
 ) -> jax.Array:
     """Dispatch: flash on TPU when the shape fits the kernel's tiling
     (seq multiple of the 128-lane block, head_dim >= 128-friendly), else XLA.
+    q_offset (global position of the first query; may be traced) forces the
+    XLA path — the decode KV-cache reads use it.
     """
+    offset = q_offset is not None and (
+        not isinstance(q_offset, int) or q_offset != 0)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         seq_ok = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
-        impl = "flash" if (on_tpu and seq_ok) else "xla"
+        impl = "flash" if (on_tpu and seq_ok and not offset) else "xla"
     if impl == "flash":
+        if offset:
+            raise ValueError("flash attention path has no q_offset support")
         return flash_attention(q, k, v, causal=causal,
                                softmax_scale=softmax_scale,
                                block_q=block_q, block_k=block_k)
     if impl == "xla":
-        return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        return xla_attention(q, k, v, causal=causal,
+                             softmax_scale=softmax_scale,
+                             q_offset=q_offset)
     raise ValueError(f"unknown attention impl {impl!r}")
