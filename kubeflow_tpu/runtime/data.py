@@ -1,0 +1,185 @@
+"""Input pipeline: host-sharded loading + device prefetch.
+
+The data-plane side of the distributed runtime (SURVEY.md §2.5 — the
+reference has no data path at all; its workload is a Jupyter server).  TPU
+training is HBM- and host-bound long before it is loader-bound IF the
+loader (a) only materializes each host's shard and (b) overlaps the
+host->HBM transfer with the running step:
+
+- `TokenBatches` yields deterministic host-local LM batches from a token
+  array: seeded per-epoch shuffling, each process slicing its own rows of
+  the global batch (`jax.process_index()` over the batch-sharded mesh
+  axes), targets = inputs shifted.
+- `ShardedBatcher` turns host-local numpy batches into GLOBAL jax Arrays
+  via `jax.make_array_from_process_local_data` — the multi-host assembly
+  that lets a pjit step consume per-host shards without any host ever
+  holding the global batch.
+- `DevicePrefetcher` stages N batches ahead onto device from a background
+  thread (device_put is async; the queue depth hides transfer latency
+  behind compute — the `prefetch_to_device` pattern generalized to
+  NamedSharding).
+
+Composed by `input_pipeline(...)`, the one-liner a notebook uses.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..parallel.sharding import logical_sharding
+
+
+class TokenBatches:
+    """Deterministic host-sharded LM batches from a flat token array.
+
+    Each epoch draws `global_batch` non-overlapping sequence windows in a
+    seeded shuffle; this process materializes ONLY rows
+    [process_index * per_host, (process_index + 1) * per_host)."""
+
+    def __init__(self, tokens: np.ndarray, global_batch: int, seq_len: int,
+                 seed: int = 0, num_epochs: Optional[int] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None) -> None:
+        self.tokens = np.asarray(tokens)
+        self.global_batch = global_batch
+        self.seq_len = seq_len
+        self.seed = seed
+        self.num_epochs = num_epochs
+        self.process_index = (process_index if process_index is not None
+                              else jax.process_index())
+        self.process_count = (process_count if process_count is not None
+                              else jax.process_count())
+        if global_batch % self.process_count != 0:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{self.process_count} processes")
+        self.windows = (len(self.tokens) - 1) // seq_len
+        if self.windows < global_batch:
+            raise ValueError(
+                f"dataset has {self.windows} windows of {seq_len}; "
+                f"need >= {global_batch}")
+
+    def __iter__(self) -> Iterator[dict]:
+        per_host = self.global_batch // self.process_count
+        lo = self.process_index * per_host
+        epoch = 0
+        while self.num_epochs is None or epoch < self.num_epochs:
+            order = np.random.default_rng(
+                (self.seed, epoch)).permutation(self.windows)
+            for start in range(0, self.windows - self.global_batch + 1,
+                               self.global_batch):
+                mine = order[start + lo:start + lo + per_host]
+                rows = np.stack([
+                    self.tokens[w * self.seq_len:
+                                w * self.seq_len + self.seq_len + 1]
+                    for w in mine
+                ])
+                yield {"inputs": rows[:, :-1].astype(np.int32),
+                       "targets": rows[:, 1:].astype(np.int32)}
+            epoch += 1
+
+
+class ShardedBatcher:
+    """Host-local numpy batches -> global jax Arrays on the mesh."""
+
+    def __init__(self, source, mesh: Mesh, rules=None,
+                 logical_axes=("batch", None)) -> None:
+        self.source = source
+        self.mesh = mesh
+        self.sharding: NamedSharding = logical_sharding(
+            mesh, logical_axes, rules)
+
+    def __iter__(self) -> Iterator[dict]:
+        for batch in self.source:
+            yield {
+                k: jax.make_array_from_process_local_data(
+                    self.sharding, np.asarray(v))
+                for k, v in batch.items()
+            }
+
+
+class DevicePrefetcher:
+    """Stage up to `depth` batches ahead from a background thread.
+
+    device_put dispatches asynchronously; keeping a short queue of
+    already-transferred batches means the step never waits on PCIe/DCN.
+    Iteration ends when the source ends; `close()` tears the thread down
+    early (e.g. on notebook interrupt)."""
+
+    _DONE = object()
+
+    def __init__(self, source, depth: int = 2,
+                 transfer: Optional[Callable] = None) -> None:
+        self.source = source
+        self.transfer = transfer
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="data-prefetch")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        try:
+            for batch in self.source:
+                if self.transfer is not None:
+                    batch = self.transfer(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except Exception as err:  # surface loader errors to the consumer
+            self._q.put(err)
+            return
+        self._q.put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration from None
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a blocked producer can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
+def input_pipeline(tokens: np.ndarray, global_batch: int, seq_len: int,
+                   mesh: Mesh, seed: int = 0,
+                   num_epochs: Optional[int] = None, prefetch: int = 2,
+                   rules=None) -> DevicePrefetcher:
+    """tokens -> prefetched, mesh-sharded {"inputs", "targets"} batches."""
+    host = TokenBatches(tokens, global_batch, seq_len, seed=seed,
+                        num_epochs=num_epochs)
+    global_batches = ShardedBatcher(host, mesh, rules=rules)
+    return DevicePrefetcher(global_batches, depth=prefetch)
+
+
+__all__ = ["TokenBatches", "ShardedBatcher", "DevicePrefetcher",
+           "input_pipeline"]
