@@ -21,7 +21,11 @@ from kubeflow_tpu.odh.controller import setup_odh_controllers
 from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
 
 CENTRAL_NS = "opendatahub"
-POLL_TIMEOUT_S = 15.0
+# generous, like the reference's 3-minute e2e resource timeout
+# (notebook_controller_setup_test.go:94): a full-suite run shares the host
+# with compile-heavy compute tests, and a starved reconcile thread must
+# show up as slow, not as a phase flake
+POLL_TIMEOUT_S = 60.0
 POLL_INTERVAL_S = 0.02
 
 
