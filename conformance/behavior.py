@@ -173,15 +173,47 @@ def check_tpu_topology(c: Client) -> None:
     print("PASS tpu topology + slice-atomic semantics")
 
 
+def check_served_versions(c: Client) -> None:
+    """The CRD serves v1alpha1/v1beta1/v1 with webhook conversion: a
+    non-storage-version client must round-trip (each side sees its own
+    apiVersion; metadata/uid shared)."""
+    name = "conf-conv"
+    beta = f"/apis/kubeflow.org/v1beta1/namespaces/{c.ns}/notebooks"
+    status, created = c.req("POST", beta, {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": name},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "workbench:latest"}]}}},
+    })
+    assert status == 201, f"v1beta1 create returned {status}: {created}"
+    assert created["apiVersion"] == "kubeflow.org/v1beta1", created["apiVersion"]
+    status, v1 = c.req("GET", c.nb_path(name))
+    assert status == 200 and v1["apiVersion"] == "kubeflow.org/v1", \
+        (status, v1.get("apiVersion"))
+    assert v1["metadata"]["uid"] == created["metadata"]["uid"]
+    status, lst = c.req("GET", beta)
+    assert status == 200
+    mine = [i for i in lst["items"] if i["metadata"]["name"] == name]
+    assert mine and mine[0]["apiVersion"] == "kubeflow.org/v1beta1", lst
+    c.req("DELETE", c.nb_path(name))
+    wait(lambda: c.req("GET", c.nb_path(name))[0] == 404,
+         what="converted notebook cleanup")
+    print("PASS served-versions conversion round-trip")
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--server", required=True)
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--skip-tpu", action="store_true",
                         help="cluster has no TPU nodes")
+    parser.add_argument("--skip-conversion", action="store_true",
+                        help="CRD deployed without the conversion webhook")
     args = parser.parse_args()
     c = Client(args.server, args.namespace)
     check_cpu_lifecycle(c)
+    if not args.skip_conversion:
+        check_served_versions(c)
     if not args.skip_tpu:
         check_tpu_topology(c)
     print("behavioral conformance: PASS")

@@ -1,11 +1,14 @@
-"""`python -m kubeflow_tpu.deploy [profile]` -> multi-doc YAML on stdout
-(the `kustomize build config/overlays/{profile}` analog)."""
+"""`python -m kubeflow_tpu.deploy [profile] [--image IMG]` -> multi-doc
+YAML on stdout (the `kustomize build config/overlays/{profile}` analog)."""
 
-import sys
+import argparse
 
 from .manifests import PROFILES, render_yaml
 
-profile = sys.argv[1] if len(sys.argv) > 1 else "standalone"
-if profile not in PROFILES:
-    sys.exit(f"unknown profile {profile!r}; choose from {PROFILES}")
-sys.stdout.write(render_yaml(profile))
+parser = argparse.ArgumentParser(prog="kubeflow_tpu.deploy")
+parser.add_argument("profile", nargs="?", default="standalone",
+                    choices=sorted(PROFILES))
+parser.add_argument("--image", default="kubeflow-tpu-controller:latest",
+                    help="manager container image")
+args = parser.parse_args()
+print(render_yaml(args.profile, image=args.image), end="")

@@ -350,7 +350,8 @@ def webhook_manifests() -> list[dict]:
     ]
 
 
-def render_profile(profile: str = "standalone") -> list[dict]:
+def render_profile(profile: str = "standalone",
+                   image: str = "kubeflow-tpu-controller:latest") -> list[dict]:
     if profile not in PROFILES:
         raise ValueError(f"unknown profile {profile!r}; choose from {PROFILES}")
     docs: list[dict] = [
@@ -379,15 +380,17 @@ def render_profile(profile: str = "standalone") -> list[dict]:
             ],
         },
         params_configmap(profile),
-        manager_deployment(profile),
+        manager_deployment(profile, image=image),
     ]
     if profile != "standalone":
         docs.extend(webhook_manifests())
     return docs
 
 
-def render_yaml(profile: str = "standalone") -> str:
-    return yaml.safe_dump_all(render_profile(profile), sort_keys=False)
+def render_yaml(profile: str = "standalone",
+                image: str = "kubeflow-tpu-controller:latest") -> str:
+    return yaml.safe_dump_all(render_profile(profile, image=image),
+                              sort_keys=False)
 
 
 def validate_docs(docs: Iterable[dict]) -> None:

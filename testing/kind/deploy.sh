@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Build the controller image, load it into a kind cluster, deploy the
+# standalone profile, and wait for the manager (reference analog: the
+# integration workflow's podman build -> kind load -> make deploy,
+# odh_notebook_controller_integration_test.yaml:62-90).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+CLUSTER="${CLUSTER:-kubeflow-tpu}"
+IMAGE="${IMAGE:-kubeflow-tpu-controller:kind}"
+NAMESPACE="${NAMESPACE:-kubeflow-tpu-system}"
+
+docker build -t "$IMAGE" .
+kind load docker-image "$IMAGE" --name "$CLUSTER"
+
+kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
+# standalone profile: CRD without the conversion-webhook clause (no
+# cert-manager in the minimal cluster), RBAC, manager Deployment
+python -m kubeflow_tpu.deploy standalone --image "$IMAGE" \
+  | sed "s/\$(NAMESPACE)/${NAMESPACE}/g" \
+  | kubectl apply -n "$NAMESPACE" -f -
+
+kubectl -n "$NAMESPACE" rollout status deployment/notebook-controller-deployment \
+  --timeout=180s
+echo "deploy: OK"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Install kind + kubectl for the integration workflow (reference analog:
+# components/testing/gh-actions/install_kind.sh).
+set -euo pipefail
+KIND_VERSION="${KIND_VERSION:-v0.23.0}"
+KUBECTL_VERSION="${KUBECTL_VERSION:-v1.30.0}"
+BIN="${BIN:-/usr/local/bin}"
+
+curl -fsSLo "${BIN}/kind" \
+  "https://kind.sigs.k8s.io/dl/${KIND_VERSION}/kind-linux-amd64"
+chmod +x "${BIN}/kind"
+curl -fsSLo "${BIN}/kubectl" \
+  "https://dl.k8s.io/release/${KUBECTL_VERSION}/bin/linux/amd64/kubectl"
+chmod +x "${BIN}/kubectl"
+kind version
+kubectl version --client
