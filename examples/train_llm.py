@@ -1,0 +1,90 @@
+"""End-to-end in-notebook LLM workflow: data -> sharded training -> decode.
+
+What a workbench user runs inside a TPU notebook this framework
+provisioned — the whole compute-plane surface in one script:
+
+  1. `tpu_init()` would consume the controller's env injection on a real
+     slice (here: the local devices);
+  2. `input_pipeline` streams host-sharded, device-prefetched LM batches;
+  3. `setup_training` jits one SPMD step over a mesh using every populated
+     parallelism axis;
+  4. `generate` decodes from the trained weights with the KV cache.
+
+Runs anywhere: on the 8-device virtual CPU mesh
+(`JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8`)
+or a real slice.  Prints RESULT: OK when every stage behaves.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.models.configs import TINY  # noqa: E402
+from kubeflow_tpu.models.generate import generate  # noqa: E402
+from kubeflow_tpu.models.train import setup_training  # noqa: E402
+from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: E402
+from kubeflow_tpu.runtime.data import input_pipeline  # noqa: E402
+
+
+def main() -> None:
+    devices = jax.devices()
+    print(f"devices: {len(devices)} x {devices[0].device_kind}")
+
+    # a toy corpus with learnable structure: ascending token runs
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, TINY.vocab_size - 64, size=4000)
+    tokens = np.concatenate([np.arange(s, s + 16) % TINY.vocab_size
+                             for s in starts])
+
+    n = len(devices)
+    mesh = make_mesh(
+        MeshConfig(data=-1,
+                   fsdp=2 if n % 4 == 0 else 1,
+                   tensor=2 if n % 2 == 0 else 1),
+        devices=devices,
+    )
+    print(f"mesh: {dict(mesh.shape)}")
+    setup = setup_training(TINY, mesh, batch_shape=(16, 64))
+
+    pipe = input_pipeline(tokens, global_batch=16, seq_len=64, mesh=mesh,
+                          num_epochs=None, prefetch=2)
+    state, first_loss, last_loss = setup.state, None, None
+    for step, batch in enumerate(pipe):
+        state, metrics = setup.train_step(state, batch)
+        loss = float(metrics["loss"])
+        first_loss = first_loss if first_loss is not None else loss
+        last_loss = loss
+        if step % 10 == 0:
+            print(f"step {step:3d}  loss {loss:.4f}")
+        if step >= 40:
+            pipe.close()
+            break
+    assert last_loss < first_loss, (first_loss, last_loss)
+    print(f"trained: loss {first_loss:.4f} -> {last_loss:.4f}")
+
+    params = jax.device_get(state.params)
+    prompt = np.stack([np.arange(10, 15), np.arange(100, 105)]).astype(np.int32)
+    out = generate(TINY, params, jax.numpy.asarray(prompt), max_new_tokens=8)
+    print("decoded:", np.asarray(out).tolist())
+    assert out.shape == (2, 13)
+    print("RESULT: OK")
+
+
+if __name__ == "__main__":
+    main()
