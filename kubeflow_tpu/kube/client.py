@@ -422,7 +422,8 @@ class KubeClient:
     def _watch_stream(self, info, rv: int, inf: _Informer) -> int:
         """Stream watch events from `rv`; returns the newest resourceVersion
         seen so the caller can resume without a relist."""
-        qs = urlencode({"watch": "true", "resourceVersion": str(rv)})
+        qs = urlencode({"watch": "true", "resourceVersion": str(rv),
+                        "allowWatchBookmarks": "true"})
         path = f"{info.collection_path(inf.namespace)}?{qs}"
         self.limiter.acquire()
         conn = self._connect(timeout=self.watch_timeout_s)
@@ -443,6 +444,14 @@ class KubeClient:
                 if not line:
                     continue
                 ev = json.loads(line)
+                if ev["type"] == "BOOKMARK":
+                    # progress notify: advance the resume RV, dispatch nothing
+                    try:
+                        rv = max(rv, int(ev["object"].get("metadata", {})
+                                         .get("resourceVersion", 0)))
+                    except (TypeError, ValueError):
+                        pass
+                    continue
                 etype = EventType(ev["type"])
                 obj = KubeObject.from_dict(ev["object"])
                 try:

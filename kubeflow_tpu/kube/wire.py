@@ -425,6 +425,8 @@ class _WireHandler(BaseHTTPRequestHandler):
         except GoneError as err:
             self._send_error_status(err)
             return
+        bookmarks = q.get("allowWatchBookmarks") in ("true", "1")
+        idle_ticks = 0
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -434,7 +436,26 @@ class _WireHandler(BaseHTTPRequestHandler):
                 try:
                     ev = events.get(timeout=0.25)
                 except queue.Empty:
+                    idle_ticks += 1
+                    if bookmarks and idle_ticks >= 4:
+                        # ~1s idle: progress-notify BOOKMARK so clients can
+                        # advance their resume RV without real events (the
+                        # apiserver's WatchBookmarks feature)
+                        idle_ticks = 0
+                        mark = json.dumps({
+                            "type": "BOOKMARK",
+                            "object": {
+                                "kind": rt.info.kind,
+                                "apiVersion": rt.info.api_version,
+                                "metadata": {"resourceVersion":
+                                             str(self.api.resource_version)},
+                            },
+                        }).encode() + b"\n"
+                        self.wfile.write(
+                            b"%x\r\n" % len(mark) + mark + b"\r\n")
+                        self.wfile.flush()
                     continue
+                idle_ticks = 0
                 if ev is None:
                     break
                 try:

@@ -261,6 +261,38 @@ class TestWireProtocol:
                 timeout=5)
         assert exc.value.code == 410
 
+    def test_watch_bookmarks(self, wire):
+        """allowWatchBookmarks: an idle stream emits BOOKMARK progress
+        events carrying the current resourceVersion; clients advance their
+        resume point without relisting (apiserver WatchBookmarks)."""
+        api, srv, _ = wire
+        api.create(make_notebook("bm"))
+        rv = api.resource_version
+        url = (f"{srv.url}/apis/kubeflow.org/v1/namespaces/default/notebooks"
+               f"?watch=true&resourceVersion={rv}&allowWatchBookmarks=true")
+        req = urllib.request.Request(url)
+        resp = urllib.request.urlopen(req, timeout=10)
+        try:
+            line = resp.readline()  # idle stream -> first line is a bookmark
+            ev = json.loads(line)
+            assert ev["type"] == "BOOKMARK"
+            assert int(ev["object"]["metadata"]["resourceVersion"]) >= rv
+            assert ev["object"]["kind"] == "Notebook"
+        finally:
+            resp.close()
+        # without the flag, an idle stream stays silent
+        url_plain = url.replace("&allowWatchBookmarks=true", "")
+        resp = urllib.request.urlopen(urllib.request.Request(url_plain),
+                                      timeout=10)
+        try:
+            import socket as _socket
+
+            resp.fp.raw._sock.settimeout(1.8)
+            with pytest.raises((TimeoutError, _socket.timeout)):
+                resp.readline()
+        finally:
+            resp.close()
+
     def test_namespace_scoped_informer(self, wire):
         """start_informers(namespace=...) must only see that namespace."""
         api, _, client = wire
