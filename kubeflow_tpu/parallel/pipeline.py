@@ -56,6 +56,12 @@ def gpipe(
     pytree with leading axis L (L % stages == 0), sharded over `axis_name`.
     x: [B, ...] with B % num_microbatches == 0.  Returns [B, ...] outputs,
     replicated over the pipeline axis.
+
+    Composition constraint: if the stage body itself shards the batch
+    dimension (ring attention's shard_map over data/fsdp does), the
+    per-microbatch batch B/num_microbatches must remain divisible by that
+    sharding group — pick num_microbatches accordingly (e.g.
+    B // (data*fsdp)).
     """
     stages = num_stages(mesh, axis_name)
     if stages <= 1:
