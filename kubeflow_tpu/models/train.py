@@ -128,11 +128,7 @@ def make_pipeline_forward(model: nn.Module, mesh: Mesh,
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(the stacked layers axis is what gets staged)")
-    if cfg.moe_experts > 0:
-        raise NotImplementedError(
-            "MoE under pipeline parallelism is not supported yet: the "
-            "GPipe engine carries a single activation array and would "
-            "drop the per-layer load-balance aux loss")
+    moe = cfg.moe_experts > 0
     template = DecoderLayer(cfg, model.mesh)
 
     def forward(params, tokens, return_hidden: bool = False):
@@ -157,12 +153,14 @@ def make_pipeline_forward(model: nn.Module, mesh: Mesh,
         # tree's ("layers", ...) partition metadata — the engine owns the
         # stage placement, and a stale box would re-constrain rank-reduced
         # slices with the stacked spec
-        x = gpipe(apply_one, nn.unbox(params["layers"]), x, mesh,
-                  microbatches, remat_layer=cfg.remat,
-                  remat_policy=_REMAT_POLICIES[cfg.remat_policy]())
+        result = gpipe(apply_one, nn.unbox(params["layers"]), x, mesh,
+                       microbatches, remat_layer=cfg.remat,
+                       remat_policy=_REMAT_POLICIES[cfg.remat_policy](),
+                       layer_has_aux=moe)
+        x, aux = result if moe else (result, jnp.float32(0.0))
         out = model.apply({"params": params}, x, return_hidden,
                           method="head")
-        return out, jnp.float32(0.0)
+        return out, aux
 
     return forward
 

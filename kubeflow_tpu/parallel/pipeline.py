@@ -47,6 +47,7 @@ def gpipe(
     axis_name: str = PIPELINE_AXIS,
     remat_layer: bool = False,
     remat_policy=None,
+    layer_has_aux: bool = False,
 ) -> jax.Array:
     """Run a layer stack as a GPipe pipeline.
 
@@ -55,7 +56,16 @@ def gpipe(
     scans it over each stage's local layers.  stacked_params is the full
     pytree with leading axis L (L % stages == 0), sharded over `axis_name`.
     x: [B, ...] with B % num_microbatches == 0.  Returns [B, ...] outputs,
-    replicated over the pipeline axis.
+    replicated over the pipeline axis; with layer_has_aux=True,
+    apply_layer returns (x, aux_scalar) per layer (MoE load-balance loss)
+    and gpipe returns (out, aux) where aux is the microbatch-mean total —
+    per-stage aux is accumulated only over VALID ticks (bubbles compute
+    masked garbage) and averaged over microbatches.  Note the estimator
+    choice: the load-balance statistic is computed PER MICROBATCH and
+    averaged (mean of per-group f·P products), not over the global batch
+    (product of global means) — the same per-group convention
+    GShard/Mesh-TF use for per-shard batches; both estimators share the
+    uniform-routing minimizer.
 
     Composition constraint: if the stage body itself shards the batch
     dimension (ring attention's shard_map over data/fsdp does), the
@@ -66,9 +76,14 @@ def gpipe(
     stages = num_stages(mesh, axis_name)
     if stages <= 1:
         def body(carry, layer_params):
-            return apply_layer(layer_params, carry), None
-        out, _ = jax.lax.scan(body, x, stacked_params)
-        return out
+            x, aux = carry
+            if layer_has_aux:
+                x, layer_aux = apply_layer(layer_params, x)
+                return (x, aux + layer_aux), None
+            return (apply_layer(layer_params, x), aux), None
+        (out, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
+                                     stacked_params)
+        return (out, aux) if layer_has_aux else out
 
     layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if layers % stages != 0:
@@ -93,40 +108,56 @@ def gpipe(
 
         def apply_stage(x_in):
             def scan_body(carry, layer_params):
-                return one_layer(layer_params, carry), None
-            out, _ = jax.lax.scan(scan_body, x_in, stage_params)
-            return out
+                x, aux = carry
+                if layer_has_aux:
+                    x, layer_aux = one_layer(layer_params, x)
+                    return (x, aux + layer_aux), None
+                return (one_layer(layer_params, x), aux), None
+            (out, aux), _ = jax.lax.scan(scan_body, (x_in, jnp.float32(0.0)),
+                                         stage_params)
+            return out, aux
 
         buf = jnp.zeros_like(x_all[0])
         out = jnp.zeros_like(x_all)
+        aux_acc = jnp.float32(0.0)
 
         def tick(carry, t):
-            buf, out = carry
+            buf, out, aux_acc = carry
             inject = x_all[jnp.clip(t, 0, microbatches - 1)]
             x_in = jnp.where(s == 0, inject, buf)
-            y = apply_stage(x_in)
+            y, aux_t = apply_stage(x_in)
+            # this stage works on microbatch m = t - s; bubbles (invalid m)
+            # compute masked garbage whose aux must not accumulate
+            valid = (t >= s) & (t < s + microbatches)
+            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
             m = t - (stages - 1)
             write = out.at[jnp.clip(m, 0, microbatches - 1)].set(y)
             out = jnp.where((s == stages - 1) & (m >= 0), write, out)
             buf = jax.lax.ppermute(y, axis_name, perm)
-            return (buf, out), None
+            return (buf, out, aux_acc), None
 
-        (buf, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(ticks))
+        (buf, out, aux_acc), _ = jax.lax.scan(
+            tick, (buf, out, aux_acc), jnp.arange(ticks))
         # results live on the last stage; zero-elsewhere + psum replicates
         # them across the pipeline (the head/loss runs on every stage)
         out = jnp.where(s == stages - 1, out, jnp.zeros_like(out))
-        return jax.lax.psum(out, axis_name)
+        out = jax.lax.psum(out, axis_name)
+        # total aux: every stage contributed its layers' aux for every
+        # microbatch exactly once; batch-mean = sum / microbatches
+        aux = jax.lax.psum(aux_acc, axis_name) / microbatches
+        return out, aux
 
     run = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={axis_name},
         check_vma=False,
     )
-    out = run(stacked_params, x.reshape(m_shape))
-    return out.reshape(x.shape)
+    out, aux = run(stacked_params, x.reshape(m_shape))
+    out = out.reshape(x.shape)
+    return (out, aux) if layer_has_aux else out
 
 
 __all__ = ["gpipe", "num_stages", "PIPELINE_AXIS"]
