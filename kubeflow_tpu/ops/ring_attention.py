@@ -42,9 +42,17 @@ def _ring_attention_local(
     k = _repeat_kv(k, num_heads)
     v = _repeat_kv(v, num_heads)
 
-    out = jnp.zeros((batch, num_heads, q_len, head_dim), jnp.float32)
-    row_max = jnp.full((batch, num_heads, q_len), -jnp.inf, jnp.float32)
-    row_sum = jnp.zeros((batch, num_heads, q_len), jnp.float32)
+    # the accumulators join a carry with device-varying k/v blocks; pvary
+    # marks the zero inits as varying over the same manual axes as q so the
+    # loop carry is VMA-consistent (check_vma=True catches the unreduced-
+    # cotangent bugs that silently broke nesting under the pipeline axis)
+    vma = tuple(jax.typeof(q).vma)
+    out = jax.lax.pvary(
+        jnp.zeros((batch, num_heads, q_len, head_dim), jnp.float32), vma)
+    row_max = jax.lax.pvary(
+        jnp.full((batch, num_heads, q_len), -jnp.inf, jnp.float32), vma)
+    row_sum = jax.lax.pvary(
+        jnp.zeros((batch, num_heads, q_len), jnp.float32), vma)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(i, carry):
@@ -116,6 +124,6 @@ def ring_attention(
         mesh=mesh if context.empty else context,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        check_vma=True,
     )
     return local(q, k, v)

@@ -73,16 +73,22 @@ def gpipe(
     sharding group — pick num_microbatches accordingly (e.g.
     B // (data*fsdp)).
     """
-    stages = num_stages(mesh, axis_name)
-    if stages <= 1:
+    def scan_layers(layer_fn, params, x_in):
+        """Scan `layer_fn` over stacked layer params, accumulating the
+        per-layer aux into the carry (shared by the single-stage fallback
+        and each pipeline stage)."""
         def body(carry, layer_params):
             x, aux = carry
             if layer_has_aux:
-                x, layer_aux = apply_layer(layer_params, x)
+                x, layer_aux = layer_fn(layer_params, x)
                 return (x, aux + layer_aux), None
-            return (apply_layer(layer_params, x), aux), None
-        (out, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
-                                     stacked_params)
+            return (layer_fn(layer_params, x), aux), None
+        (out, aux), _ = jax.lax.scan(body, (x_in, jnp.float32(0.0)), params)
+        return out, aux
+
+    stages = num_stages(mesh, axis_name)
+    if stages <= 1:
+        out, aux = scan_layers(apply_layer, stacked_params, x)
         return (out, aux) if layer_has_aux else out
 
     layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -107,15 +113,7 @@ def gpipe(
         perm = [(i, (i + 1) % stages) for i in range(stages)]
 
         def apply_stage(x_in):
-            def scan_body(carry, layer_params):
-                x, aux = carry
-                if layer_has_aux:
-                    x, layer_aux = one_layer(layer_params, x)
-                    return (x, aux + layer_aux), None
-                return (one_layer(layer_params, x), aux), None
-            (out, aux), _ = jax.lax.scan(scan_body, (x_in, jnp.float32(0.0)),
-                                         stage_params)
-            return out, aux
+            return scan_layers(one_layer, stage_params, x_in)
 
         buf = jnp.zeros_like(x_all[0])
         out = jnp.zeros_like(x_all)

@@ -13,13 +13,25 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 from kubeflow_tpu.models.configs import TINY
 from kubeflow_tpu.models.moe import MoEMLP, load_balance_loss
 from kubeflow_tpu.models.train import setup_training
+
+
 from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
 from kubeflow_tpu.parallel.sharding import rules_for_mesh
+
+
+def const_opt():
+    """Plain constant-lr SGD for update-equivalence checks: the training
+    default's warmup starts at lr=0 (zero first update — vacuous
+    comparison), and one-step Adam is ~lr*sign(grad), so fp32 noise on
+    near-zero gradients flips signs into 2*lr param diffs; under SGD the
+    parameter delta is proportional to the gradient."""
+    return optax.sgd(0.05)
 
 MOE_TINY = TINY.with_(moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0)
 
@@ -97,11 +109,20 @@ class TestMoETraining:
         data["targets"] = jnp.roll(data["inputs"], -1, axis=1)
 
         ref_mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
-        ref = setup_training(MOE_TINY, ref_mesh, batch_shape=batch_shape)
+        ref = setup_training(MOE_TINY, ref_mesh, batch_shape=batch_shape,
+                             optimizer=const_opt())
+        # host copy BEFORE the step: train_step donates the input state
+        init_leaf = np.asarray(
+            jax.device_get(jax.tree_util.tree_leaves(ref.state.params)[0]))
         ref_state, ref_metrics = ref.train_step(ref.state, data)
+        # the comparison must not be vacuous: the step moved the weights
+        new_leaf = np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(ref_state.params)[0]))
+        assert float(np.max(np.abs(new_leaf - init_leaf))) > 0.0
 
         ep_mesh = make_mesh(MeshConfig(data=-1, expert=4))
-        ep = setup_training(MOE_TINY, ep_mesh, batch_shape=batch_shape)
+        ep = setup_training(MOE_TINY, ep_mesh, batch_shape=batch_shape,
+                            optimizer=const_opt())
         ep_state, ep_metrics = ep.train_step(ep.state, data)
 
         assert abs(float(ep_metrics["loss"]) -
@@ -143,16 +164,23 @@ class TestMoETraining:
                                              batch_shape, 0, TINY.vocab_size)}
         data["targets"] = jnp.roll(data["inputs"], -1, axis=1)
 
+        # parameter comparison runs with the aux WEIGHT off: the pipelined
+        # aux is a per-microbatch estimator (documented in gpipe), so its
+        # gradient differs legitimately; the CE gradient path through the
+        # pipelined MoE layers must be exact
+        cfg = MOE_TINY.with_(moe_aux_weight=0.0)
         plain_mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
-        plain = setup_training(MOE_TINY, plain_mesh, batch_shape=batch_shape)
+        plain = setup_training(cfg, plain_mesh, batch_shape=batch_shape,
+                               optimizer=const_opt())
         plain_state, pm = plain.train_step(plain.state, data)
 
         pp_mesh = make_mesh(MeshConfig(data=-1, pipeline=2))
-        pp = setup_training(MOE_TINY, pp_mesh, batch_shape=batch_shape,
-                            pipeline_microbatches=4)
+        pp = setup_training(cfg, pp_mesh, batch_shape=batch_shape,
+                            pipeline_microbatches=4, optimizer=const_opt())
         pp_state, m = pp.train_step(pp.state, data)
 
         assert abs(float(m["ce_loss"]) - float(pm["ce_loss"])) < 1e-4
+        # the aux STATISTIC still agrees within the estimator bound
         assert abs(float(m["moe_aux_loss"]) - float(pm["moe_aux_loss"])) \
             < 0.05 * float(pm["moe_aux_loss"])
         mismatch = []

@@ -11,13 +11,25 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 from kubeflow_tpu.models.configs import TINY
 from kubeflow_tpu.models.train import setup_training
+
+
 from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
 from kubeflow_tpu.parallel.pipeline import gpipe
 from kubeflow_tpu.parallel.sharding import rules_for_mesh
+
+
+def const_opt():
+    """Plain constant-lr SGD for update-equivalence checks: the training
+    default's warmup starts at lr=0 (zero first update — vacuous
+    comparison), and one-step Adam is ~lr*sign(grad), so fp32 noise on
+    near-zero gradients flips signs into 2*lr param diffs; under SGD the
+    parameter delta is proportional to the gradient."""
+    return optax.sgd(0.05)
 
 
 class TestGpipeEngine:
@@ -86,13 +98,22 @@ class TestPipelinedTraining:
 
         plain_mesh = make_mesh(MeshConfig(data=1),
                                devices=jax.devices()[:1])
-        plain = setup_training(cfg, plain_mesh, batch_shape=batch_shape)
+        plain = setup_training(cfg, plain_mesh, batch_shape=batch_shape,
+                               optimizer=const_opt())
+        # host copy BEFORE the step: train_step donates the input state
+        init_leaf = np.asarray(
+            jax.device_get(jax.tree_util.tree_leaves(plain.state.params)[0]))
         plain_state, plain_metrics = plain.train_step(plain.state, data)
 
         pp_mesh = make_mesh(MeshConfig(data=-1, pipeline=2))
         pp = setup_training(cfg, pp_mesh, batch_shape=batch_shape,
-                            pipeline_microbatches=4)
+                            pipeline_microbatches=4, optimizer=const_opt())
         pp_state, pp_metrics = pp.train_step(pp.state, data)
+
+        # the comparison must not be vacuous: the step moved the weights
+        new_leaf = np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(plain_state.params)[0]))
+        assert float(np.max(np.abs(new_leaf - init_leaf))) > 0.0
 
         assert abs(float(pp_metrics["loss"]) -
                    float(plain_metrics["loss"])) < 1e-4
