@@ -83,7 +83,13 @@ def gpipe(
                 x, layer_aux = layer_fn(layer_params, x)
                 return (x, aux + layer_aux), None
             return (layer_fn(layer_params, x), aux), None
-        (out, aux), _ = jax.lax.scan(body, (x_in, jnp.float32(0.0)), params)
+        aux0 = jnp.float32(0.0)
+        # inside a pipeline stage the aux joins a carry varying over the
+        # manual axis; match VMA types (see the pvary note below)
+        vma = tuple(getattr(jax.typeof(x_in), "vma", ()))
+        if vma:
+            aux0 = jax.lax.pvary(aux0, vma)
+        (out, aux), _ = jax.lax.scan(body, (x_in, aux0), params)
         return out, aux
 
     stages = num_stages(mesh, axis_name)
@@ -115,9 +121,13 @@ def gpipe(
         def apply_stage(x_in):
             return scan_layers(one_layer, stage_params, x_in)
 
-        buf = jnp.zeros_like(x_all[0])
-        out = jnp.zeros_like(x_all)
-        aux_acc = jnp.float32(0.0)
+        # pvary: the zero inits join a carry whose other leg (y, rotated
+        # activations) varies over the pipeline axis — consistent VMA types
+        # let check_vma=True verify the collective placement statically
+        # (the safeguard that caught the ring-under-pipeline gradient bug)
+        buf = jax.lax.pvary(jnp.zeros_like(x_all[0]), (axis_name,))
+        out = jax.lax.pvary(jnp.zeros_like(x_all), (axis_name,))
+        aux_acc = jax.lax.pvary(jnp.float32(0.0), (axis_name,))
 
         def tick(carry, t):
             buf, out, aux_acc = carry
@@ -151,6 +161,12 @@ def gpipe(
         in_specs=(P(axis_name), P()),
         out_specs=(P(), P()),
         axis_names={axis_name},
+        # check_vma=True on THIS outer shard_map trips an sdy
+        # manual_computation lowering error when ring attention's (vma-
+        # checked) shard_map nests inside; the engine's collective
+        # placement is instead pinned dynamically by the SGD parameter-
+        # update allclose gates (tests/test_pipeline.py, dryrun_multichip),
+        # which hold to ~1e-7 across device counts
         check_vma=False,
     )
     out, aux = run(stacked_params, x.reshape(m_shape))
