@@ -297,6 +297,16 @@ class KubeClient:
                           content_type="application/merge-patch+json")
         return KubeObject.from_dict(d)
 
+    def strategic_merge_patch(self, kind: str, namespace: str, name: str,
+                              patch: dict) -> KubeObject:
+        """client-go types.StrategicMergePatchType: keyed-list merge on the
+        server (containers by name, volumeMounts by mountPath, ...)."""
+        info = self.scheme_registry.by_kind(kind)
+        d = self._request("PATCH", info.object_path(namespace or None, name),
+                          body=patch,
+                          content_type="application/strategic-merge-patch+json")
+        return KubeObject.from_dict(d)
+
     def json_patch(self, kind: str, namespace: str, name: str,
                    ops: list) -> KubeObject:
         """RFC 6902 patch (client-go types.JSONPatchType); `test` ops carry

@@ -376,23 +376,23 @@ class ApiServer:
         the apiserver's cross-version patch flow — without duplicating this
         retry loop: view_out(dict)->dict runs before the merge, view_in
         (KubeObject)->KubeObject after."""
-        last: Exception | None = None
-        for _ in range(16):
-            current = self.get(kind, namespace, name)
-            base = current.to_dict()
-            if view_out is not None:
-                base = view_out(base)
-            merged_dict = _json_merge(base, patch)
-            merged = KubeObject.from_dict(merged_dict)
-            if view_in is not None:
-                merged = view_in(merged)
-            merged.metadata.resource_version = current.metadata.resource_version
-            try:
-                return self.update(merged)
-            except ConflictError as err:
-                last = err
-        assert last is not None
-        raise last
+        return self._patch_with_retry(
+            kind, namespace, name, lambda base: _json_merge(base, patch),
+            view_out, view_in)
+
+    def strategic_merge_patch(
+        self, kind: str, namespace: str, name: str, patch: dict,
+        view_out=None, view_in=None,
+    ) -> KubeObject:
+        """Strategic merge patch: RFC 7386 shape plus patchMergeKey-keyed
+        list merge and $patch/$deleteFromPrimitiveList directives
+        (kube.strategicmerge).  Same server-side conflict retry and
+        cross-version view hooks as merge_patch."""
+        from .strategicmerge import strategic_merge
+
+        return self._patch_with_retry(
+            kind, namespace, name, lambda base: strategic_merge(base, patch),
+            view_out, view_in)
 
     def json_patch(
         self, kind: str, namespace: str, name: str, ops: list,
@@ -405,19 +405,33 @@ class ApiServer:
         precondition, so retrying against fresh state would defeat it."""
         from .jsonpatch import PatchTestFailed, apply_patch
 
+        def apply_ops(base: dict) -> dict:
+            try:
+                return apply_patch(base, ops)
+            except PatchTestFailed as err:
+                raise InvalidError(str(err)) from None
+            except (KeyError, IndexError, TypeError, ValueError) as err:
+                raise InvalidError(f"json patch failed: {err}") from None
+
+        return self._patch_with_retry(
+            kind, namespace, name, apply_ops, view_out, view_in)
+
+    def _patch_with_retry(
+        self, kind: str, namespace: str, name: str, apply_fn,
+        view_out=None, view_in=None,
+    ) -> KubeObject:
+        """Shared patch protocol: read, apply `apply_fn` to the (possibly
+        version-converted) dict view, write back pinned to the read RV, and
+        retry the whole read-apply-write on conflict — the apiserver
+        re-applies patches server-side the same way, so patch callers never
+        see a ConflictError of their own making."""
         last: Exception | None = None
         for _ in range(16):
             current = self.get(kind, namespace, name)
             base = current.to_dict()
             if view_out is not None:
                 base = view_out(base)
-            try:
-                patched_dict = apply_patch(base, ops)
-            except PatchTestFailed as err:
-                raise InvalidError(str(err)) from None
-            except (KeyError, IndexError, TypeError, ValueError) as err:
-                raise InvalidError(f"json patch failed: {err}") from None
-            patched = KubeObject.from_dict(patched_dict)
+            patched = KubeObject.from_dict(apply_fn(base))
             if view_in is not None:
                 patched = view_in(patched)
             patched.metadata.resource_version = current.metadata.resource_version

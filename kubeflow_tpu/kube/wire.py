@@ -382,9 +382,18 @@ class _WireHandler(BaseHTTPRequestHandler):
                     raise InvalidError("json patch body must be an op list")
                 updated = self.api.json_patch(
                     rt.info.kind, rt.namespace or "", rt.name, patch, **hooks)
+            elif "strategic-merge" in ctype:
+                # patchMergeKey-keyed list merge + $patch directives
+                # (kube.strategicmerge) — what kubectl sends for core types
+                if not isinstance(patch, dict):
+                    raise InvalidError("strategic merge patch body must be "
+                                       "a JSON object")
+                updated = self.api.strategic_merge_patch(
+                    rt.info.kind, rt.namespace or "", rt.name, patch, **hooks)
             else:
-                # merge-patch; strategic-merge from kubectl degrades to RFC
-                # 7386 merge semantics here (no patchMergeKey metadata)
+                if not isinstance(patch, dict):
+                    raise InvalidError("merge patch body must be a JSON "
+                                       "object")
                 updated = self.api.merge_patch(
                     rt.info.kind, rt.namespace or "", rt.name, patch, **hooks)
             self._send_json(200, self._convert_out(updated.to_dict(), rt))

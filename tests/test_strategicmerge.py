@@ -1,0 +1,233 @@
+"""Strategic merge patch semantics (kube/strategicmerge.py).
+
+The reference's stack gets these semantics from the real apiserver
+(kubectl sends application/strategic-merge-patch+json for core types);
+here they are pinned directly: patchMergeKey-keyed list merge, $patch
+directives, $deleteFromPrimitiveList, and the wire-server route.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook
+from kubeflow_tpu.kube import ApiServer, KubeObject, ObjectMeta
+from kubeflow_tpu.kube.client import KubeClient, RestConfig
+from kubeflow_tpu.kube.strategicmerge import strategic_merge
+from kubeflow_tpu.kube.wire import KubeApiWireServer
+
+
+class TestKeyedListMerge:
+    def test_containers_merge_by_name(self):
+        base = {"containers": [
+            {"name": "nb", "image": "a:1", "workingDir": "/home/jovyan"},
+            {"name": "proxy", "image": "p:1"},
+        ]}
+        patch = {"containers": [{"name": "nb", "image": "a:2"}]}
+        out = strategic_merge(base, patch)
+        assert out["containers"] == [
+            {"name": "nb", "image": "a:2", "workingDir": "/home/jovyan"},
+            {"name": "proxy", "image": "p:1"},
+        ], "keyed merge updates in place, keeps unmentioned siblings"
+
+    def test_new_item_appended(self):
+        base = {"containers": [{"name": "nb", "image": "a:1"}]}
+        out = strategic_merge(
+            base, {"containers": [{"name": "sidecar", "image": "s:1"}]})
+        assert [c["name"] for c in out["containers"]] == ["nb", "sidecar"]
+
+    def test_nested_env_merge(self):
+        base = {"containers": [{"name": "nb", "env": [
+            {"name": "A", "value": "1"}, {"name": "B", "value": "2"}]}]}
+        patch = {"containers": [{"name": "nb", "env": [
+            {"name": "B", "value": "20"}, {"name": "C", "value": "3"}]}]}
+        out = strategic_merge(base, patch)
+        assert out["containers"][0]["env"] == [
+            {"name": "A", "value": "1"},
+            {"name": "B", "value": "20"},
+            {"name": "C", "value": "3"},
+        ]
+
+    def test_volume_mounts_key_on_mount_path(self):
+        base = {"volumeMounts": [{"mountPath": "/data", "name": "v1"}]}
+        patch = {"volumeMounts": [{"mountPath": "/data", "readOnly": True}]}
+        out = strategic_merge(base, patch)
+        assert out["volumeMounts"] == [
+            {"mountPath": "/data", "name": "v1", "readOnly": True}]
+
+    def test_ports_candidate_keys(self):
+        # Container.ports keys on containerPort...
+        base = {"ports": [{"containerPort": 8888}]}
+        out = strategic_merge(
+            base, {"ports": [{"containerPort": 8888, "name": "http"}]})
+        assert out["ports"] == [{"containerPort": 8888, "name": "http"}]
+        # ...ServiceSpec.ports on port
+        base = {"ports": [{"port": 80, "targetPort": 8888}]}
+        out = strategic_merge(
+            base, {"ports": [{"port": 80, "name": "http-notebook"}]})
+        assert out["ports"] == [
+            {"port": 80, "targetPort": 8888, "name": "http-notebook"}]
+
+    def test_unkeyed_list_replaced_atomically(self):
+        base = {"args": ["--a"], "containers": [{"image": "no-name"}]}
+        patch = {"args": ["--b"], "containers": [{"image": "x"}]}
+        out = strategic_merge(base, patch)
+        assert out["args"] == ["--b"]
+        # items missing the merge key degrade to atomic replace, not a crash
+        assert out["containers"] == [{"image": "x"}]
+
+
+class TestDirectives:
+    def test_patch_delete_list_item(self):
+        base = {"containers": [{"name": "nb"}, {"name": "proxy"}]}
+        patch = {"containers": [{"name": "proxy", "$patch": "delete"}]}
+        assert strategic_merge(base, patch)["containers"] == [{"name": "nb"}]
+
+    def test_patch_replace_list(self):
+        base = {"containers": [{"name": "a"}, {"name": "b"}]}
+        patch = {"containers": [{"$patch": "replace"}, {"name": "c"}]}
+        assert strategic_merge(base, patch)["containers"] == [{"name": "c"}]
+
+    def test_patch_replace_map(self):
+        base = {"resources": {"limits": {"cpu": "1"}, "requests": {"cpu": "1"}}}
+        patch = {"resources": {"$patch": "replace", "limits": {"cpu": "2"}}}
+        assert strategic_merge(base, patch)["resources"] == {
+            "limits": {"cpu": "2"}}
+
+    def test_delete_from_primitive_list(self):
+        base = {"finalizers": ["a", "b", "c"]}
+        patch = {"$deleteFromPrimitiveList/finalizers": ["b"]}
+        assert strategic_merge(base, patch)["finalizers"] == ["a", "c"]
+
+    def test_primitive_merge_union_with_deletions(self):
+        # finalizers has patchStrategy=merge: additions union, deletions
+        # apply last regardless of JSON key order (kubectl emits both in
+        # one patch)
+        base = {"finalizers": ["a", "b", "c"]}
+        patch = {"finalizers": ["d"],
+                 "$deleteFromPrimitiveList/finalizers": ["b"]}
+        assert strategic_merge(base, patch)["finalizers"] == ["a", "c", "d"]
+        reordered = {"$deleteFromPrimitiveList/finalizers": ["b"],
+                     "finalizers": ["d"]}
+        assert strategic_merge(base, reordered)["finalizers"] == [
+            "a", "c", "d"], "deletion order-independent"
+
+    def test_owner_references_merge_by_uid(self):
+        base = {"metadata": {"ownerReferences": [
+            {"uid": "A", "kind": "Notebook", "name": "wb"}]}}
+        patch = {"metadata": {"ownerReferences": [
+            {"uid": "B", "kind": "DSPA", "name": "dspa"}]}}
+        out = strategic_merge(base, patch)
+        assert [r["uid"] for r in out["metadata"]["ownerReferences"]] == [
+            "A", "B"], "adding an owner must not sever existing owner links"
+
+    def test_set_element_order_ignored(self):
+        base = {"containers": [{"name": "a", "image": "i"}]}
+        patch = {"$setElementOrder/containers": [{"name": "a"}],
+                 "containers": [{"name": "a", "image": "j"}]}
+        assert strategic_merge(base, patch)["containers"] == [
+            {"name": "a", "image": "j"}]
+
+    def test_null_deletes_key(self):
+        out = strategic_merge({"a": 1, "b": 2}, {"a": None})
+        assert out == {"b": 2}
+
+    def test_directives_never_persist(self):
+        # directives drive the merge but must not be stored (the apiserver
+        # strips them): copy-fallback paths strip $patch keys and
+        # pure-directive list items
+        out = strategic_merge(
+            {}, {"resources": {"$patch": "replace", "limits": {"cpu": "2"}}})
+        assert out == {"resources": {"limits": {"cpu": "2"}}}
+        out = strategic_merge(
+            {"spec": {"containers": [{"name": "a"}]}},
+            {"spec": {"containers": [{"name": "a", "image": "x"},
+                                     {"$patch": "delete"}]}})
+        assert out == {"spec": {"containers": []}}, \
+            "key-less $patch: delete clears the keyed list"
+        out = strategic_merge({}, {"x": {"$patch": "delete"}})
+        assert out == {}, "map $patch: delete removes the key, not -> {}"
+        out = strategic_merge(
+            {"containers": [{"name": "a", "image": "i"}]},
+            {"containers": [{"name": "a", "image": "j"},
+                            {"$patch": "merge"}]})
+        assert out["containers"] == [{"name": "a", "image": "j"}], \
+            "unknown pure-directive items never become (empty) list items"
+
+    def test_retain_keys(self):
+        # kubectl emits $retainKeys for patchStrategy=retainKeys one-of
+        # fields (e.g. Deployment .spec.strategy): after the merge only the
+        # listed keys survive, and the directive itself is never stored
+        base = {"strategy": {"type": "Recreate"}}
+        patch = {"strategy": {
+            "$retainKeys": ["type", "rollingUpdate"],
+            "type": "RollingUpdate",
+            "rollingUpdate": {"maxSurge": 1}}}
+        assert strategic_merge(base, patch)["strategy"] == {
+            "type": "RollingUpdate", "rollingUpdate": {"maxSurge": 1}}
+
+    def test_inputs_not_mutated(self):
+        base = {"containers": [{"name": "nb", "env": [{"name": "A"}]}]}
+        patch = {"containers": [{"name": "nb",
+                                 "env": [{"name": "B", "value": "2"}]}]}
+        strategic_merge(base, patch)
+        assert base == {"containers": [{"name": "nb", "env": [{"name": "A"}]}]}
+        assert patch == {"containers": [{"name": "nb",
+                                         "env": [{"name": "B", "value": "2"}]}]}
+
+
+class TestOverTheWire:
+    @pytest.fixture()
+    def wire(self):
+        api = ApiServer()
+        srv = KubeApiWireServer(api).start()
+        client = KubeClient(RestConfig(server=srv.url))
+        yield api, client
+        client.stop_informers()
+        srv.stop()
+
+    def test_strategic_patch_merges_containers(self, wire):
+        _, client = wire
+        nb = Notebook.new("wb", "default").obj
+        nb.body["spec"]["template"]["spec"]["containers"] = [
+            {"name": "wb", "image": "jupyter:1",
+             "env": [{"name": "NB_PREFIX", "value": "/notebook/default/wb"}]},
+        ]
+        client.create(nb)
+        client.strategic_merge_patch("Notebook", "default", "wb", {
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "wb", "image": "jupyter:2"},
+            ]}}}})
+        got = client.get("Notebook", "default", "wb")
+        (container,) = got.body["spec"]["template"]["spec"]["containers"]
+        assert container["image"] == "jupyter:2"
+        assert container["env"] == [
+            {"name": "NB_PREFIX", "value": "/notebook/default/wb"}
+        ], "keyed merge must not drop sibling fields (7386 would)"
+
+    def test_strategic_patch_deletes_sidecar(self, wire):
+        _, client = wire
+        nb = Notebook.new("wb", "default").obj
+        nb.body["spec"]["template"]["spec"]["containers"] = [
+            {"name": "wb", "image": "jupyter:1"},
+            {"name": "rbac-proxy", "image": "proxy:1"},
+        ]
+        client.create(nb)
+        client.strategic_merge_patch("Notebook", "default", "wb", {
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "rbac-proxy", "$patch": "delete"},
+            ]}}}})
+        got = client.get("Notebook", "default", "wb")
+        names = [c["name"]
+                 for c in got.body["spec"]["template"]["spec"]["containers"]]
+        assert names == ["wb"]
+
+    def test_store_direct_api(self):
+        api = ApiServer()
+        api.create(KubeObject(
+            "v1", "ConfigMap", ObjectMeta(name="cm", namespace="ns"),
+            body={"data": {"a": "1"}}))
+        api.strategic_merge_patch("ConfigMap", "ns", "cm",
+                                  {"data": {"b": "2"}})
+        assert api.get("ConfigMap", "ns", "cm").body["data"] == {
+            "a": "1", "b": "2"}
