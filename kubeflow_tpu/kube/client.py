@@ -260,12 +260,21 @@ class KubeClient:
             return None
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[dict[str, str]] = None) -> list[KubeObject]:
+             label_selector: Optional[dict[str, str]] = None,
+             field_selector: Optional[str] = None) -> list[KubeObject]:
+        """`field_selector` is the raw fieldSelector string
+        ("metadata.name=wb,involvedObject.kind=Notebook") — server-side
+        filtering on dotted field paths."""
         info = self.scheme_registry.by_kind(kind)
         path = info.collection_path(namespace)
+        q: dict[str, str] = {}
         if label_selector:
-            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
-            path += "?" + urlencode({"labelSelector": sel})
+            q["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(label_selector.items()))
+        if field_selector:
+            q["fieldSelector"] = field_selector
+        if q:
+            path += "?" + urlencode(q)
         d = self._request("GET", path)
         return sorted(
             (KubeObject.from_dict(i) for i in d.get("items", [])),

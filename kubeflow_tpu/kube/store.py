@@ -40,6 +40,11 @@ class EventType(Enum):
 class WatchEvent:
     type: EventType
     obj: KubeObject
+    # pre-update state on MODIFIED events (None on ADDED/DELETED) — the
+    # watch cache keeps it so selector-filtered watches can detect an
+    # object editing into/out of the selected set (the apiserver's cacher
+    # does the same to synthesize ADDED/DELETED transitions)
+    prev: Optional[KubeObject] = None
 
 
 class AdmissionDenied(ForbiddenError):
@@ -132,10 +137,11 @@ class ApiServer:
         # replay-then-register is atomic with live delivery; callbacks must
         # only enqueue or re-enter this ApiServer (same thread, RLock-safe)
         with self._lock:
-            self._history.append(WatchEvent(ev.type, ev.obj.deepcopy()))
+            self._history.append(
+                WatchEvent(ev.type, ev.obj.deepcopy(), prev=ev.prev))
             watchers = list(self._watchers)
         for fn in watchers:
-            fn(WatchEvent(ev.type, ev.obj.deepcopy()))
+            fn(WatchEvent(ev.type, ev.obj.deepcopy(), prev=ev.prev))
 
     def _next_rv(self) -> int:
         self._rv_counter += 1
@@ -352,7 +358,7 @@ class ApiServer:
             merged.metadata.resource_version = self._next_rv()
             kind_store[key] = merged
             stored = merged.deepcopy()
-        self._notify(WatchEvent(EventType.MODIFIED, stored))
+        self._notify(WatchEvent(EventType.MODIFIED, stored, prev=old))
         # finalizer removal on a deleting object may complete the delete
         if stored.metadata.deletion_timestamp and not stored.metadata.finalizers:
             self._finalize_delete(stored.kind, stored.namespace, stored.name)
@@ -387,12 +393,19 @@ class ApiServer:
         """Strategic merge patch: RFC 7386 shape plus patchMergeKey-keyed
         list merge and $patch/$deleteFromPrimitiveList directives
         (kube.strategicmerge).  Same server-side conflict retry and
-        cross-version view hooks as merge_patch."""
+        cross-version view hooks as merge_patch.  A malformed patch (list
+        item missing its declared merge key) raises InvalidError — 422 on
+        the wire, the apiserver's 'does not contain declared merge key'."""
         from .strategicmerge import strategic_merge
 
+        def apply_smp(base: dict) -> dict:
+            try:
+                return strategic_merge(base, patch)
+            except ValueError as err:
+                raise InvalidError(str(err)) from None
+
         return self._patch_with_retry(
-            kind, namespace, name, lambda base: strategic_merge(base, patch),
-            view_out, view_in)
+            kind, namespace, name, apply_smp, view_out, view_in)
 
     def json_patch(
         self, kind: str, namespace: str, name: str, ops: list,
@@ -449,6 +462,7 @@ class ApiServer:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             if obj.metadata.finalizers:
                 if obj.metadata.deletion_timestamp is None:
+                    prev = obj.deepcopy()
                     obj.metadata.deletion_timestamp = now_iso()
                     obj.metadata.resource_version = self._next_rv()
                     stored = obj.deepcopy()
@@ -457,7 +471,7 @@ class ApiServer:
             else:
                 stored = None
         if stored is not None:
-            self._notify(WatchEvent(EventType.MODIFIED, stored))
+            self._notify(WatchEvent(EventType.MODIFIED, stored, prev=prev))
             return
         self._finalize_delete(kind, namespace, name)
 

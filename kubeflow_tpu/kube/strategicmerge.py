@@ -30,7 +30,6 @@ MERGE_KEYS: dict[str, tuple[str, ...]] = {
     "initContainers": ("name",),
     "ephemeralContainers": ("name",),
     "env": ("name",),
-    "envFrom": ("name",),          # no tag upstream; name-keyed in practice
     "ports": ("containerPort", "port"),
     "volumeMounts": ("mountPath",),
     "volumeDevices": ("devicePath",),
@@ -125,7 +124,10 @@ def _merge_map(out: dict, patch: dict) -> dict:
 def _pick_key(base: list, patch: list, candidates: tuple[str, ...]):
     """First candidate key present on every dict item (pure-directive items
     like {"$patch": "replace"} don't vote); None -> the list is treated
-    atomically."""
+    atomically.  If the BASE items agree on a merge key but a patch item
+    omits it, the patch is malformed — raise rather than silently degrade
+    to whole-list replace (the apiserver answers 'does not contain declared
+    merge key')."""
     if any(not isinstance(x, dict) for x in list(base) + list(patch)):
         return None
     voting = [x for x in list(base) + list(patch) if not _is_pure_directive(x)]
@@ -134,6 +136,12 @@ def _pick_key(base: list, patch: list, candidates: tuple[str, ...]):
     for cand in candidates:
         if all(cand in x for x in voting):
             return cand
+    base_voting = [x for x in base if not _is_pure_directive(x)]
+    for cand in candidates:
+        if base_voting and all(cand in x for x in base_voting):
+            raise ValueError(
+                f"strategic merge patch list item does not contain the "
+                f"declared merge key {cand!r}")
     return None
 
 

@@ -70,6 +70,61 @@ def parse_label_selector(raw: str) -> dict[str, str]:
     return out
 
 
+def parse_field_selector(raw: str) -> list[tuple[str, bool, str]]:
+    """fieldSelector grammar: comma-joined `path=value` / `path==value` /
+    `path!=value` terms over dotted field paths (metadata.name,
+    involvedObject.kind, spec.nodeName, ...).  Returns (path, equals,
+    value) triples."""
+    out: list[tuple[str, bool, str]] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, v = part.split("!=", 1)
+            out.append((k.strip(), False, v.strip()))
+        elif "==" in part:
+            k, v = part.split("==", 1)
+            out.append((k.strip(), True, v.strip()))
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            out.append((k.strip(), True, v.strip()))
+        # a bare term with no operator is invalid; the real apiserver
+        # answers 400 — callers validate via the ValueError below
+        else:
+            raise ValueError(f"invalid field selector segment {part!r}")
+    return out
+
+
+def match_fields(obj_dict: dict,
+                 selectors: list[tuple[str, bool, str]]) -> bool:
+    """Evaluate parsed fieldSelector terms against the object's dict form.
+    Unset paths compare as the empty string (apiserver convention: a
+    selector on an unset field matches ""); non-scalar values never match.
+    The real apiserver restricts selectable fields per resource; a dynamic
+    server accepts any dotted path — a documented superset
+    (docs/wire_compat.md)."""
+    for path, equals, want in selectors:
+        cur: object = obj_dict
+        for seg in path.split("."):
+            if isinstance(cur, dict):
+                cur = cur.get(seg)
+            else:
+                cur = None
+                break
+        if cur is None:
+            have = ""
+        elif isinstance(cur, bool):
+            have = "true" if cur else "false"
+        elif isinstance(cur, (str, int, float)):
+            have = str(cur)
+        else:
+            return False  # list/map-valued path: nothing to compare
+        if (have == want) != equals:
+            return False
+    return True
+
+
 class _Route:
     """Decoded request path: which resource, namespace, name, subresource."""
 
@@ -121,10 +176,11 @@ class _WireHandler(BaseHTTPRequestHandler):
     # real apiserver calls the CRD's conversion webhook here; wiring a
     # RemoteConverter (odh/webhook_server.py) reproduces that callout.
     converter = None  # Optional[Callable[[dict, str], dict]]
-    # paginated-list snapshots: token id -> (rv, [KubeObject]) — every page
-    # of one list is served from the SAME snapshot (etcd serves continue
-    # requests at the original revision); bounded, eviction -> 410 Expired
-    # and the client relists, exactly client-go's pager fallback
+    # paginated-list snapshots: token id -> (rv, [request-version dicts,
+    # already converted + field-filtered]) — every page of one list is
+    # served from the SAME snapshot (etcd serves continue requests at the
+    # original revision); bounded, eviction -> 410 Expired and the client
+    # relists, exactly client-go's pager fallback
     _list_snapshots: "dict[int, tuple[int, list]]" = {}
     _snapshot_lock = threading.Lock()
     _snapshot_seq = [0]
@@ -284,16 +340,27 @@ class _WireHandler(BaseHTTPRequestHandler):
             items = all_items[cursor:]
         else:
             selector = parse_label_selector(q.get("labelSelector", ""))
-            items, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
-                                              selector or None)
+            try:
+                fields = parse_field_selector(q.get("fieldSelector", ""))
+            except ValueError as err:
+                self._send_json(400, status_body(400, "BadRequest", str(err)))
+                return
+            objs, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
+                                             selector or None)
+            # convert to the REQUEST-version view before field matching:
+            # selectors are written in request-version field names, and the
+            # same dicts serve as the response items (one serialization)
+            items = self._convert_out_many([o.to_dict() for o in objs], rt)
+            if fields:
+                items = [d for d in items if match_fields(d, fields)]
             cursor = 0
             all_items = items
         meta: dict = {"resourceVersion": str(rv)}
         if limit and len(items) > limit:
             shown, rest = items[:limit], items[limit:]
             if cursor == 0:
-                # first page of a truncated list: snapshot it for the
-                # continuation requests
+                # first page of a truncated list: snapshot it (already in
+                # request-version dict form) for the continuation requests
                 with cls._snapshot_lock:
                     cls._snapshot_seq[0] += 1
                     snap_id = cls._snapshot_seq[0]
@@ -309,8 +376,7 @@ class _WireHandler(BaseHTTPRequestHandler):
             "kind": f"{rt.info.kind}List",
             "apiVersion": rt.info.api_version,
             "metadata": meta,
-            "items": self._convert_out_many(
-                [o.to_dict() for o in items], rt),
+            "items": items,
         })
 
     def do_POST(self):  # noqa: N802
@@ -416,6 +482,11 @@ class _WireHandler(BaseHTTPRequestHandler):
     # -- watch streaming ------------------------------------------------------
     def _serve_watch(self, rt: _Route, q: dict[str, str]) -> None:
         selector = parse_label_selector(q.get("labelSelector", ""))
+        try:
+            fields = parse_field_selector(q.get("fieldSelector", ""))
+        except ValueError as err:
+            self._send_json(400, status_body(400, "BadRequest", str(err)))
+            return
         since_rv = int(q["resourceVersion"]) if q.get("resourceVersion") else None
         events: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
 
@@ -427,6 +498,8 @@ class _WireHandler(BaseHTTPRequestHandler):
                 return
             if selector and not match_labels(obj.metadata.labels, selector):
                 return
+            # field selectors are evaluated AFTER version conversion in the
+            # stream loop — terms are written in request-version field names
             events.put(ev)
 
         try:
@@ -471,8 +544,31 @@ class _WireHandler(BaseHTTPRequestHandler):
                     out_obj = self._convert_out(ev.obj.to_dict(), rt)
                 except ApiError:
                     continue  # conversion failure drops the event, not the stream
+                ev_type = ev.type.value
+                if fields:
+                    # apiserver selected-set semantics (the cacher keeps the
+                    # previous state per event for exactly this): an object
+                    # editing OUT of the selector emits a synthetic DELETED
+                    # — plain skipping would strand stale objects in
+                    # informer caches forever; editing IN emits ADDED
+                    matches = match_fields(out_obj, fields)
+                    if ev_type == "MODIFIED" and ev.prev is not None:
+                        try:
+                            prev_obj = self._convert_out(
+                                ev.prev.to_dict(), rt)
+                        except ApiError:
+                            continue
+                        prev_match = match_fields(prev_obj, fields)
+                        if matches and not prev_match:
+                            ev_type = "ADDED"
+                        elif prev_match and not matches:
+                            ev_type = "DELETED"
+                        elif not matches:
+                            continue
+                    elif not matches:
+                        continue  # ADDED/DELETED outside the selected set
                 line = json.dumps(
-                    {"type": ev.type.value, "object": out_obj}
+                    {"type": ev_type, "object": out_obj}
                 ).encode() + b"\n"
                 self.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
                 self.wfile.flush()
@@ -530,5 +626,6 @@ class KubeApiWireServer:
             self._thread.join(timeout=5)
 
 
-__all__ = ["KubeApiWireServer", "parse_label_selector", "route_path",
+__all__ = ["KubeApiWireServer", "parse_label_selector",
+           "parse_field_selector", "match_fields", "route_path",
            "status_body"]

@@ -1,0 +1,172 @@
+"""Field selectors on list/watch (kube/wire.py parse_field_selector).
+
+The reference's culler and event plumbing rely on the apiserver's field
+selectors (e.g. client-go listing Events by involvedObject).  The wire
+server evaluates dotted-path terms server-side; unset fields compare as
+"" per apiserver convention.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook
+from kubeflow_tpu.kube import ApiServer, KubeObject, ObjectMeta
+from kubeflow_tpu.kube.client import KubeClient, RestConfig
+from kubeflow_tpu.kube.wire import (
+    KubeApiWireServer,
+    match_fields,
+    parse_field_selector,
+)
+
+
+class TestParser:
+    def test_equality_and_inequality(self):
+        sel = parse_field_selector(
+            "metadata.name=wb,status.phase==Running,spec.nodeName!=n1")
+        assert sel == [("metadata.name", True, "wb"),
+                       ("status.phase", True, "Running"),
+                       ("spec.nodeName", False, "n1")]
+
+    def test_invalid_segment_raises(self):
+        with pytest.raises(ValueError):
+            parse_field_selector("metadata.name")
+
+    def test_empty_is_noop(self):
+        assert parse_field_selector("") == []
+
+
+class TestMatcher:
+    def test_dotted_path(self):
+        obj = {"metadata": {"name": "wb"},
+               "involvedObject": {"kind": "Notebook", "name": "wb"}}
+        assert match_fields(obj, parse_field_selector(
+            "involvedObject.kind=Notebook,involvedObject.name=wb"))
+        assert not match_fields(obj, parse_field_selector(
+            "involvedObject.kind=Pod"))
+
+    def test_unset_field_matches_empty(self):
+        assert match_fields({}, parse_field_selector("spec.nodeName="))
+        assert match_fields({}, parse_field_selector("spec.nodeName!=n1"))
+
+    def test_numbers_and_bools_stringify(self):
+        obj = {"status": {"readyReplicas": 3, "ready": True}}
+        assert match_fields(obj, parse_field_selector(
+            "status.readyReplicas=3,status.ready=true"))
+
+    def test_non_scalar_never_matches(self):
+        obj = {"spec": {"containers": [{"name": "a"}]}}
+        assert not match_fields(obj, parse_field_selector("spec.containers=x"))
+
+
+class TestOverTheWire:
+    @pytest.fixture()
+    def wire(self):
+        api = ApiServer()
+        srv = KubeApiWireServer(api).start()
+        client = KubeClient(RestConfig(server=srv.url))
+        yield api, client
+        client.stop_informers()
+        srv.stop()
+
+    def test_list_filters_by_name(self, wire):
+        _, client = wire
+        for name in ("a", "b", "c"):
+            client.create(Notebook.new(name, "default").obj)
+        got = client.list("Notebook", "default",
+                          field_selector="metadata.name=b")
+        assert [o.name for o in got] == ["b"]
+        got = client.list("Notebook", "default",
+                          field_selector="metadata.name!=b")
+        assert [o.name for o in got] == ["a", "c"]
+
+    def test_list_events_by_involved_object(self, wire):
+        _, client = wire
+        for nb, reason in [("wb1", "Created"), ("wb2", "Failed")]:
+            client.create(KubeObject(
+                "v1", "Event",
+                ObjectMeta(name=f"ev-{nb}", namespace="default"),
+                body={"involvedObject": {"kind": "Notebook", "name": nb},
+                      "reason": reason, "type": "Normal"}))
+        got = client.list(
+            "Event", "default",
+            field_selector="involvedObject.name=wb2,involvedObject.kind=Notebook")
+        assert [o.name for o in got] == ["ev-wb2"]
+
+    def test_invalid_selector_answers_400(self, wire):
+        import urllib.error
+        import urllib.request
+        api, client = wire
+        url = (client.config.server
+               + "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+               + "?fieldSelector=bogus")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 400
+
+    def test_watch_respects_field_selector(self, wire):
+        api, client = wire
+        import json
+        import urllib.request
+        url = (client.config.server
+               + "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+               + "?watch=true&fieldSelector=metadata.name%3Dwanted")
+        seen: list[str] = []
+        ready = threading.Event()
+
+        def consume():
+            req = urllib.request.urlopen(url, timeout=10)
+            ready.set()
+            for line in req:
+                seen.append(json.loads(line)["object"]["metadata"]["name"])
+                if seen:
+                    break
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        ready.wait(5)
+        api.create(Notebook.new("other", "default").obj)
+        api.create(Notebook.new("wanted", "default").obj)
+        t.join(timeout=10)
+        assert seen == ["wanted"], "filtered watch only streams matches"
+
+    def test_watch_synthesizes_transitions(self, wire):
+        """An object editing out of the selected set must stream a
+        synthetic DELETED (and editing in, an ADDED) — the apiserver's
+        cacher semantics; plain skipping strands informer caches."""
+        import json
+        import urllib.request
+        api, client = wire
+        url = (client.config.server
+               + "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+               + "?watch=true&fieldSelector="
+               + "metadata.annotations.tier%3Dgold")
+        seen: list[tuple[str, str]] = []
+        ready = threading.Event()
+
+        def consume():
+            req = urllib.request.urlopen(url, timeout=10)
+            ready.set()
+            for line in req:
+                ev = json.loads(line)
+                seen.append((ev["type"], ev["object"]["metadata"]["name"]))
+                if len(seen) >= 3:
+                    break
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        ready.wait(5)
+        nb = Notebook.new("wb", "default").obj
+        api.create(nb)                       # no annotation: outside the set
+        cur = api.get("Notebook", "default", "wb")
+        cur.metadata.annotations["tier"] = "gold"
+        cur = api.update(cur)                # edits IN  -> ADDED
+        cur.metadata.annotations["note"] = "x"
+        cur = api.update(cur)                # stays in  -> MODIFIED
+        cur.metadata.annotations["tier"] = "bronze"
+        api.update(cur)                      # edits OUT -> synthetic DELETED
+        t.join(timeout=10)
+        assert seen == [("ADDED", "wb"), ("MODIFIED", "wb"),
+                        ("DELETED", "wb")], seen

@@ -73,8 +73,16 @@ class TestKeyedListMerge:
         patch = {"args": ["--b"], "containers": [{"image": "x"}]}
         out = strategic_merge(base, patch)
         assert out["args"] == ["--b"]
-        # items missing the merge key degrade to atomic replace, not a crash
+        # when the BASE items lack the key too, atomic replace (no keyed
+        # state to protect)
         assert out["containers"] == [{"image": "x"}]
+
+    def test_missing_merge_key_rejected(self):
+        # base items are keyed; a patch item omitting the declared merge
+        # key must error like the apiserver, not silently replace the list
+        base = {"containers": [{"name": "wb"}, {"name": "rbac-proxy"}]}
+        with pytest.raises(ValueError, match="declared merge key"):
+            strategic_merge(base, {"containers": [{"image": "x"}]})
 
 
 class TestDirectives:
