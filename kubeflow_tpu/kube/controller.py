@@ -250,7 +250,16 @@ class Manager:
     def start(self, poll_interval_s: float = 0.05) -> None:
         def loop() -> None:
             while not self._stop.is_set():
-                if not self._process_one():
+                try:
+                    busy = self._process_one()
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    # anything escaping the per-reconcile handler (queue
+                    # bookkeeping, clock, mapping bugs): a silently-dead
+                    # manager thread turns into every controller stalling,
+                    # indistinguishable from a hung cluster
+                    logger.exception("manager loop error; continuing")
+                    busy = False
+                if not busy:
                     self._stop.wait(poll_interval_s)
 
         self._thread = threading.Thread(target=loop, daemon=True, name="kube-manager")

@@ -120,7 +120,11 @@ class ApiServer:
                     )
                 for ev in self._history:
                     if ev.obj.metadata.resource_version > since_rv:
-                        fn(WatchEvent(ev.type, ev.obj.deepcopy()))
+                        # prev rides along: resumed selector-filtered
+                        # watches need it to synthesize edit-in/edit-out
+                        # transitions that happened while they were away
+                        fn(WatchEvent(ev.type, ev.obj.deepcopy(),
+                                      prev=ev.prev))
             self._watchers.append(fn)
 
     @property

@@ -176,11 +176,13 @@ class _WireHandler(BaseHTTPRequestHandler):
     # real apiserver calls the CRD's conversion webhook here; wiring a
     # RemoteConverter (odh/webhook_server.py) reproduces that callout.
     converter = None  # Optional[Callable[[dict, str], dict]]
-    # paginated-list snapshots: token id -> (rv, [request-version dicts,
-    # already converted + field-filtered]) — every page of one list is
-    # served from the SAME snapshot (etcd serves continue requests at the
-    # original revision); bounded, eviction -> 410 Expired and the client
-    # relists, exactly client-go's pager fallback
+    # paginated-list snapshots: token id -> (rv, [dicts], converted) —
+    # `converted` says whether the dicts are already in request-version
+    # form (field-filtered lists convert up front; plain lists convert per
+    # page).  Every page of one list is served from the SAME snapshot (etcd
+    # serves continue requests at the original revision); bounded,
+    # eviction -> 410 Expired and the client relists, exactly client-go's
+    # pager fallback
     _list_snapshots: "dict[int, tuple[int, list]]" = {}
     _snapshot_lock = threading.Lock()
     _snapshot_seq = [0]
@@ -336,7 +338,7 @@ class _WireHandler(BaseHTTPRequestHandler):
                     410, "Expired",
                     "continue token expired; restart the list"))
                 return
-            rv, all_items = snap
+            rv, all_items, converted = snap
             items = all_items[cursor:]
         else:
             selector = parse_label_selector(q.get("labelSelector", ""))
@@ -347,24 +349,32 @@ class _WireHandler(BaseHTTPRequestHandler):
                 return
             objs, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
                                              selector or None)
-            # convert to the REQUEST-version view before field matching:
-            # selectors are written in request-version field names, and the
-            # same dicts serve as the response items (one serialization)
-            items = self._convert_out_many([o.to_dict() for o in objs], rt)
             if fields:
+                # field selectors are written in request-version field
+                # names: convert the whole collection up front, filter on
+                # the converted view, and serve those dicts directly
+                items = self._convert_out_many(
+                    [o.to_dict() for o in objs], rt)
                 items = [d for d in items if match_fields(d, fields)]
+            else:
+                # no field filtering: keep raw dicts and convert per page
+                # below — a limit=50 first page of a 5000-object alias-
+                # version collection must not pay a 5000-item conversion
+                items = [o.to_dict() for o in objs]
             cursor = 0
             all_items = items
+            converted = bool(fields)
         meta: dict = {"resourceVersion": str(rv)}
         if limit and len(items) > limit:
             shown, rest = items[:limit], items[limit:]
             if cursor == 0:
-                # first page of a truncated list: snapshot it (already in
-                # request-version dict form) for the continuation requests
+                # first page of a truncated list: snapshot it for the
+                # continuation requests (converted flag records whether the
+                # dicts are already in request-version form)
                 with cls._snapshot_lock:
                     cls._snapshot_seq[0] += 1
                     snap_id = cls._snapshot_seq[0]
-                    cls._list_snapshots[snap_id] = (rv, all_items)
+                    cls._list_snapshots[snap_id] = (rv, all_items, converted)
                     while len(cls._list_snapshots) > cls._MAX_SNAPSHOTS:
                         cls._list_snapshots.pop(
                             next(iter(cls._list_snapshots)))
@@ -376,7 +386,8 @@ class _WireHandler(BaseHTTPRequestHandler):
             "kind": f"{rt.info.kind}List",
             "apiVersion": rt.info.api_version,
             "metadata": meta,
-            "items": items,
+            # unconverted pages convert HERE — per page, not per collection
+            "items": items if converted else self._convert_out_many(items, rt),
         })
 
     def do_POST(self):  # noqa: N802
@@ -496,10 +507,10 @@ class _WireHandler(BaseHTTPRequestHandler):
                 return
             if rt.namespace and obj.namespace != rt.namespace:
                 return
-            if selector and not match_labels(obj.metadata.labels, selector):
-                return
-            # field selectors are evaluated AFTER version conversion in the
-            # stream loop — terms are written in request-version field names
+            # label AND field selectors are evaluated in the stream loop,
+            # post-conversion, with selected-set transition synthesis —
+            # filtering here would drop the edit-out events the synthesis
+            # needs to turn into DELETED
             events.put(ev)
 
         try:
@@ -545,24 +556,42 @@ class _WireHandler(BaseHTTPRequestHandler):
                 except ApiError:
                     continue  # conversion failure drops the event, not the stream
                 ev_type = ev.type.value
-                if fields:
+                if selector or fields:
                     # apiserver selected-set semantics (the cacher keeps the
                     # previous state per event for exactly this): an object
                     # editing OUT of the selector emits a synthetic DELETED
-                    # — plain skipping would strand stale objects in
-                    # informer caches forever; editing IN emits ADDED
-                    matches = match_fields(out_obj, fields)
+                    # carrying its LAST IN-SET state — plain skipping would
+                    # strand stale objects in informer caches forever;
+                    # editing IN emits ADDED.  Applies to label and field
+                    # selectors alike, evaluated on the request-version view.
+                    def _selected(d: dict) -> bool:
+                        labels = (d.get("metadata") or {}).get("labels") or {}
+                        if selector and not match_labels(labels, selector):
+                            return False
+                        return not fields or match_fields(d, fields)
+
+                    matches = _selected(out_obj)
                     if ev_type == "MODIFIED" and ev.prev is not None:
                         try:
                             prev_obj = self._convert_out(
                                 ev.prev.to_dict(), rt)
                         except ApiError:
                             continue
-                        prev_match = match_fields(prev_obj, fields)
+                        prev_match = _selected(prev_obj)
                         if matches and not prev_match:
                             ev_type = "ADDED"
                         elif prev_match and not matches:
+                            # the client must see the object as it last
+                            # matched (the new state is outside its view),
+                            # but stamped with the EVENT's resourceVersion
+                            # so watch resume stays monotonic — exactly the
+                            # cacher's synthetic-delete shape
                             ev_type = "DELETED"
+                            rv_now = (out_obj.get("metadata") or {}).get(
+                                "resourceVersion")
+                            out_obj = prev_obj
+                            out_obj.setdefault(
+                                "metadata", {})["resourceVersion"] = rv_now
                         elif not matches:
                             continue
                     elif not matches:

@@ -32,7 +32,7 @@ class TransformerConfig:
     logits_softcap: float = 0.0      # gemma-style tanh softcap; 0 = off
     loss_chunks: int = 0             # >0: chunked CE — never materializes
                                      # the full [tokens, vocab] fp32 logits
-    remat_policy: str = "nothing"    # nothing | dots | none — what the
+    remat_policy: str = "nothing"    # nothing|dots|attn|none — what the
                                      # per-layer checkpoint may keep (see
                                      # models.transformer._REMAT_POLICIES)
     flash_block_q: int = 0           # Pallas flash tile sizes; 0 = kernel

@@ -35,6 +35,11 @@ from .configs import TransformerConfig
 _REMAT_POLICIES = {
     "nothing": lambda: jax.checkpoint_policies.nothing_saveable,
     "dots": lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    # save ONLY the attention outputs (checkpoint_name in Attention): the
+    # per-layer backward recompute then skips re-running the flash kernel —
+    # the one fwd op whose wall share beats its HBM share ([B,S,H,D] bf16
+    # per layer) — while everything else still remats
+    "attn": lambda: jax.checkpoint_policies.save_only_these_names("attn_out"),
     "none": lambda: jax.checkpoint_policies.everything_saveable,
 }
 
@@ -174,6 +179,9 @@ class Attention(nn.Module):
             out = attention(q, k, v, causal=True, impl=impl,
                             block_q=cfg.flash_block_q,
                             block_k=cfg.flash_block_k)
+        from jax.ad_checkpoint import checkpoint_name
+
+        out = checkpoint_name(out, "attn_out")
         out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "kv"))
         return _dense(
             cfg.embed_dim, ("heads", "kv", "embed"), "out",

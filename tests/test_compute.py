@@ -169,6 +169,26 @@ class TestTraining:
         _, m2 = s2.train_step(s2.state, batch)
         assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-3
 
+    def test_remat_policies_identical_gradients(self):
+        """Remat policies trade memory for recompute — they must NEVER
+        change the math.  One step under each policy from identical init
+        must produce identical loss and gradients (fp32 model, so exact
+        comparison up to reduction noise)."""
+        mesh = make_mesh(MeshConfig(data=8))
+        key = jax.random.PRNGKey(3)
+        inputs = jax.random.randint(key, (8, 64), 0, TINY.vocab_size)
+        batch = {"inputs": inputs, "targets": jnp.roll(inputs, -1, axis=1)}
+        results = {}
+        for policy in ("nothing", "dots", "attn", "none"):
+            setup = setup_training(TINY.with_(remat_policy=policy), mesh,
+                                   batch_shape=(8, 64))
+            _, m = setup.train_step(setup.state, batch)
+            results[policy] = (float(m["loss"]), float(m["grad_norm"]))
+        base = results["nothing"]
+        for policy, (loss, gnorm) in results.items():
+            assert abs(loss - base[0]) < 1e-5, (policy, loss, base[0])
+            assert abs(gnorm - base[1]) < 1e-4, (policy, gnorm, base[1])
+
     def test_param_count_formula(self):
         mesh = make_mesh(MeshConfig(data=8))
         setup = setup_training(TINY, mesh, batch_shape=(2, 16))

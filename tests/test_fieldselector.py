@@ -151,7 +151,7 @@ class TestOverTheWire:
             ready.set()
             for line in req:
                 ev = json.loads(line)
-                seen.append((ev["type"], ev["object"]["metadata"]["name"]))
+                seen.append((ev["type"], ev["object"]))
                 if len(seen) >= 3:
                     break
 
@@ -166,7 +166,71 @@ class TestOverTheWire:
         cur.metadata.annotations["note"] = "x"
         cur = api.update(cur)                # stays in  -> MODIFIED
         cur.metadata.annotations["tier"] = "bronze"
-        api.update(cur)                      # edits OUT -> synthetic DELETED
+        final = api.update(cur)              # edits OUT -> synthetic DELETED
         t.join(timeout=10)
-        assert seen == [("ADDED", "wb"), ("MODIFIED", "wb"),
-                        ("DELETED", "wb")], seen
+        assert [(t_, o["metadata"]["name"]) for t_, o in seen] == [
+            ("ADDED", "wb"), ("MODIFIED", "wb"), ("DELETED", "wb")], seen
+        # the synthetic DELETED carries the LAST IN-SET state (the cacher's
+        # shape), stamped with the event's resourceVersion
+        deleted = seen[2][1]
+        assert deleted["metadata"]["annotations"]["tier"] == "gold"
+        assert deleted["metadata"]["resourceVersion"] == \
+            str(final.metadata.resource_version)
+
+    def test_resumed_watch_replays_transitions(self, wire):
+        """A watch resuming from an older resourceVersion must still see
+        the synthetic DELETED for an edit-out that happened while it was
+        away — history replay carries the pre-update state too."""
+        import json
+        import urllib.request
+        api, client = wire
+        nb = Notebook.new("wb", "default").obj
+        nb.metadata.labels["team"] = "ml"
+        created = api.create(nb)
+        rv = created.metadata.resource_version
+        # while "away": the label is removed (edit-out), then a decoy update
+        cur = api.get("Notebook", "default", "wb")
+        del cur.metadata.labels["team"]
+        api.update(cur)
+        url = (client.config.server
+               + "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+               + f"?watch=true&labelSelector=team%3Dml&resourceVersion={rv}")
+        req = urllib.request.urlopen(url, timeout=10)
+        line = next(iter(req))
+        ev = json.loads(line)
+        assert ev["type"] == "DELETED", ev
+        assert ev["object"]["metadata"]["labels"]["team"] == "ml", \
+            "replayed synthetic DELETED carries the last in-set state"
+
+    def test_label_selector_watch_synthesizes_transitions(self, wire):
+        """Label selectors get the same selected-set semantics as field
+        selectors — removing a watched label must stream a DELETED."""
+        import json
+        import urllib.request
+        api, client = wire
+        url = (client.config.server
+               + "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+               + "?watch=true&labelSelector=team%3Dml")
+        seen: list[tuple[str, str]] = []
+        ready = threading.Event()
+
+        def consume():
+            req = urllib.request.urlopen(url, timeout=10)
+            ready.set()
+            for line in req:
+                ev = json.loads(line)
+                seen.append((ev["type"], ev["object"]["metadata"]["name"]))
+                if len(seen) >= 2:
+                    break
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        ready.wait(5)
+        nb = Notebook.new("wb", "default").obj
+        nb.metadata.labels["team"] = "ml"
+        api.create(nb)                         # in set -> ADDED
+        cur = api.get("Notebook", "default", "wb")
+        del cur.metadata.labels["team"]
+        api.update(cur)                        # label removed -> DELETED
+        t.join(timeout=10)
+        assert seen == [("ADDED", "wb"), ("DELETED", "wb")], seen

@@ -453,7 +453,243 @@ class TestMLflow:
         api.update(nb)  # no raise
 
 
+class TestFirstDifference:
+    """FirstDifferenceReporter analog (notebook_mutating_webhook.go:601-646):
+    the update-pending annotation carries ONE human-readable difference."""
+
+    def test_nested_dict_path(self):
+        from kubeflow_tpu.odh.diff import first_difference
+
+        a = {"spec": {"containers": [{"name": "wb", "image": "jupyter:1"}]}}
+        b = {"spec": {"containers": [{"name": "wb", "image": "jupyter:2"}]}}
+        msg = first_difference(a, b)
+        assert msg == ".spec.containers[0].image: 'jupyter:1' != 'jupyter:2'"
+
+    def test_absent_key_and_list_growth(self):
+        from kubeflow_tpu.odh.diff import first_difference
+
+        assert "<absent>" in first_difference({"a": 1}, {})
+        msg = first_difference({"envs": [1]}, {"envs": [1, 2]})
+        assert msg == ".envs[1]: <absent> != 2"
+
+    def test_type_change_reported_not_crash(self):
+        from kubeflow_tpu.odh.diff import first_difference
+
+        msg = first_difference({"replicas": 1}, {"replicas": "1"})
+        assert ".replicas" in msg and "1" in msg
+
+    def test_equal_structures_empty(self):
+        from kubeflow_tpu.odh.diff import first_difference
+
+        assert first_difference({"x": [1, {"y": 2}]}, {"x": [1, {"y": 2}]}) == ""
+
+
+def make_dspa(ns="user1", **overrides):
+    """A structurally-valid DSPA CR (notebook_dspa_secret.go test fixtures)."""
+    spec = {
+        "objectStorage": {
+            "externalStorage": {
+                "host": "minio.svc",
+                "scheme": "http",
+                "bucket": "pipelines",
+                "s3CredentialSecret": {
+                    "secretName": "s3-creds",
+                    "accessKey": "AWS_ACCESS_KEY_ID",
+                    "secretKey": "AWS_SECRET_ACCESS_KEY",
+                },
+            }
+        }
+    }
+    spec.update(overrides)
+    return KubeObject(
+        api_version="datasciencepipelinesapplications.opendatahub.io/v1",
+        kind="DataSciencePipelinesApplication",
+        metadata=ObjectMeta(name="dspa", namespace=ns),
+        body={
+            "spec": spec,
+            "status": {"components": {"apiServer": {
+                "externalUrl": "https://dspa.apps/pipelines"}}},
+        },
+    )
+
+
+def make_s3_secret(ns="user1"):
+    return KubeObject(
+        api_version="v1", kind="Secret",
+        metadata=ObjectMeta(name="s3-creds", namespace=ns),
+        body={"data": {
+            "AWS_ACCESS_KEY_ID": base64.b64encode(b"minio-user").decode(),
+            "AWS_SECRET_ACCESS_KEY": base64.b64encode(b"minio-pass").decode(),
+        }},
+    )
+
+
+class TestElyraSecret:
+    """ds-pipeline-config Secret from the namespace DSPA CR
+    (notebook_dspa_secret.go:189-477)."""
+
+    @pytest.fixture()
+    def elyra_env(self):
+        return make_env(set_pipeline_secret=True)
+
+    def test_secret_built_from_dspa_and_mounted(self, elyra_env):
+        import json as _json
+
+        api, _, mgr, _ = elyra_env
+        api.create(make_dspa())
+        api.create(make_s3_secret())
+        live = create_nb(api, mgr)
+        secret = api.get("Secret", "user1", C.ELYRA_SECRET_NAME)
+        payload = _json.loads(base64.b64decode(
+            secret.body["data"][C.ELYRA_SECRET_KEY]))
+        md = payload["metadata"]
+        assert payload["schema_name"] == "kfp"
+        assert md["api_endpoint"] == "https://dspa.apps/pipelines"
+        assert md["cos_endpoint"] == "http://minio.svc"
+        assert md["cos_bucket"] == "pipelines"
+        assert md["cos_username"] == "minio-user", "creds decoded from Secret"
+        assert md["cos_password"] == "minio-pass"
+        # owned by the DSPA, not the notebook: dies with the DSPA
+        (ref,) = secret.metadata.owner_references
+        assert ref.kind == "DataSciencePipelinesApplication"
+        # webhook mounted it at the Elyra runtimes path
+        spec = Notebook(live).pod_spec
+        assert any(v["name"] == C.ELYRA_VOLUME_NAME
+                   for v in spec["volumes"])
+        mount = next(m for m in spec["containers"][0]["volumeMounts"]
+                     if m["name"] == C.ELYRA_VOLUME_NAME)
+        assert mount["mountPath"] == C.ELYRA_MOUNT_PATH
+
+    def test_no_dspa_is_quiet_noop(self, elyra_env):
+        api, _, mgr, _ = elyra_env
+        create_nb(api, mgr)
+        assert api.try_get("Secret", "user1", C.ELYRA_SECRET_NAME) is None
+
+    def test_broken_dspa_does_not_block_admission(self, elyra_env):
+        api, _, mgr, _ = elyra_env
+        api.create(make_dspa(objectStorage={}))  # unusable: no storage
+        live = create_nb(api, mgr)
+        assert live is not None, "admission must tolerate a broken DSPA"
+        assert api.try_get("Secret", "user1", C.ELYRA_SECRET_NAME) is None
+        # the volume still mounts (secret is optional:True), so Elyra
+        # starts working the moment the DSPA is fixed
+        spec = Notebook(live).pod_spec
+        assert any(v["name"] == C.ELYRA_VOLUME_NAME for v in spec["volumes"])
+
+    def test_public_endpoint_from_gateway_listener(self, elyra_env):
+        import json as _json
+
+        api, _, mgr, cfg = elyra_env
+        api.create(KubeObject(
+            api_version="gateway.networking.k8s.io/v1", kind="Gateway",
+            metadata=ObjectMeta(name=cfg.gateway_name,
+                                namespace=cfg.gateway_namespace),
+            body={"spec": {"listeners": [
+                {"name": "https", "hostname": "ds.apps.example.com"}]}},
+        ))
+        api.create(make_dspa())
+        api.create(make_s3_secret())
+        create_nb(api, mgr)
+        secret = api.get("Secret", "user1", C.ELYRA_SECRET_NAME)
+        payload = _json.loads(base64.b64decode(
+            secret.body["data"][C.ELYRA_SECRET_KEY]))
+        assert payload["metadata"]["public_api_endpoint"] == \
+            "https://ds.apps.example.com/external/elyra/user1"
+
+    def test_public_endpoint_route_fallback_requires_ownership(
+            self, elyra_env):
+        import json as _json
+
+        api, _, mgr, cfg = elyra_env
+        gw = api.create(KubeObject(
+            api_version="gateway.networking.k8s.io/v1", kind="Gateway",
+            metadata=ObjectMeta(name=cfg.gateway_name,
+                                namespace=cfg.gateway_namespace),
+            body={"spec": {"listeners": [{"name": "https"}]}},  # no hostname
+        ))
+        # an UNRELATED route must not leak into the endpoint
+        api.create(KubeObject(
+            api_version="route.openshift.io/v1", kind="Route",
+            metadata=ObjectMeta(name="stray", namespace=cfg.gateway_namespace),
+            body={"spec": {"host": "stray.apps"}}))
+        labeled = KubeObject(
+            api_version="route.openshift.io/v1", kind="Route",
+            metadata=ObjectMeta(
+                name="gw-route", namespace=cfg.gateway_namespace,
+                labels={"gateway.networking.k8s.io/gateway-name": gw.name}),
+            body={"spec": {"host": "gw.apps.example.com"}})
+        api.create(labeled)
+        api.create(make_dspa())
+        api.create(make_s3_secret())
+        create_nb(api, mgr)
+        secret = api.get("Secret", "user1", C.ELYRA_SECRET_NAME)
+        payload = _json.loads(base64.b64decode(
+            secret.body["data"][C.ELYRA_SECRET_KEY]))
+        assert payload["metadata"]["public_api_endpoint"] == \
+            "https://gw.apps.example.com/external/elyra/user1"
+
+    def test_secret_updates_when_dspa_changes(self, elyra_env):
+        import json as _json
+
+        api, _, mgr, _ = elyra_env
+        api.create(make_dspa())
+        api.create(make_s3_secret())
+        create_nb(api, mgr)
+        dspa = api.get("DataSciencePipelinesApplication", "user1", "dspa")
+        dspa.spec["objectStorage"]["externalStorage"]["bucket"] = "nextgen"
+        api.update(dspa)
+        mgr.run_until_idle()
+        # a later reconcile (any notebook event) refreshes the payload
+        nb = api.get("Notebook", "user1", "wb")
+        nb.metadata.labels["touch"] = "1"
+        api.update(nb)
+        mgr.run_until_idle()
+        secret = api.get("Secret", "user1", C.ELYRA_SECRET_NAME)
+        payload = _json.loads(base64.b64decode(
+            secret.body["data"][C.ELYRA_SECRET_KEY]))
+        assert payload["metadata"]["cos_bucket"] == "nextgen"
+
+
 class TestRuntimeImages:
+    def test_key_name_sanitization(self):
+        from kubeflow_tpu.odh.runtime_images import format_key_name
+
+        # formatKeyName (notebook_runtime.go:174-183): lowercase, invalid
+        # chars collapse to single dashes, edges trimmed
+        assert format_key_name("Data Science Runtime") == \
+            "data-science-runtime.json"
+        assert format_key_name("  PyTorch + CUDA (2024a)! ") == \
+            "pytorch-cuda-2024a.json"
+        assert format_key_name("___") == ""
+        assert format_key_name("") == ""
+
+    def test_metadata_parse_failures_yield_empty_object(self):
+        from kubeflow_tpu.odh.runtime_images import parse_runtime_image_metadata
+
+        assert parse_runtime_image_metadata("not json", "img") == "{}"
+        assert parse_runtime_image_metadata("{}", "img") == "{}"
+        assert parse_runtime_image_metadata("[]", "img") == "{}"
+        out = parse_runtime_image_metadata(
+            '[{"display_name": "R", "metadata": {}}]', "reg/r:1")
+        assert '"image_name": "reg/r:1"' in out
+
+    def test_unlabeled_imagestreams_ignored(self, env):
+        api, _, mgr, _ = env
+        api.create(KubeObject(
+            api_version="image.openshift.io/v1", kind="ImageStream",
+            metadata=ObjectMeta(name="plain-is", namespace=CENTRAL_NS),
+            body={"spec": {"tags": [{
+                "name": "1", "from": {"name": "reg/x:1"},
+                "annotations": {
+                    C.ANNOTATION_RUNTIME_IMAGE_METADATA:
+                        '[{"display_name": "X", "metadata": {}}]'},
+            }]}},
+        ))
+        create_nb(api, mgr)
+        # no labeled runtime images -> no ConfigMap is created at all
+        assert api.try_get(
+            "ConfigMap", "user1", C.RUNTIME_IMAGES_CONFIGMAP) is None
+
     def test_sync_and_mount(self, env):
         api, _, mgr, _ = env
         api.create(KubeObject(

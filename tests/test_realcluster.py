@@ -417,11 +417,17 @@ class TestControllersOverWire:
     def test_drift_recreated_over_wire(self, stack):
         _, _, client, _ = stack
         client.create(make_notebook("real-nb"))
-        wait_for(lambda: client.try_get("StatefulSet", "default", "real-nb"),
-                 msg="sts")
+        # the reconcile creates the STS first, Service after — poll for the
+        # Service itself before deleting it (deleting on the STS signal
+        # alone races the first reconcile)
+        first = wait_for(
+            lambda: client.try_get("Service", "default", "real-nb"),
+            msg="service created")
         client.delete("Service", "default", "real-nb")
-        wait_for(lambda: client.try_get("Service", "default", "real-nb"),
-                 msg="service recreated after delete (level-triggered)")
+        wait_for(
+            lambda: (svc := client.try_get("Service", "default", "real-nb"))
+            is not None and svc.metadata.uid != first.metadata.uid,
+            msg="service recreated after delete (level-triggered)")
 
 
 # -- HTTPS admission choreography ---------------------------------------------
@@ -558,6 +564,50 @@ class TestConversionWebhook:
         stored = api.get("Notebook", "default", "wbp")
         assert stored.api_version == "kubeflow.org/v1"
         assert stored.metadata.labels["patched"] == "yes"
+
+    def test_cross_version_strategic_patch(self, conversion_stack):
+        """Strategic merge on an alias-version path: keyed-list semantics
+        must apply to the REQUEST-version view (view_out/view_in hooks) and
+        convert back to storage without smuggling the alias version."""
+        api, srv = conversion_stack
+        nb = make_notebook("wbs")
+        nb.body["spec"]["template"]["spec"]["containers"] = [
+            {"name": "wbs", "image": "jupyter:1",
+             "env": [{"name": "NB_PREFIX", "value": "/nb"}]}]
+        api.create(nb)
+        req = urllib.request.Request(
+            srv.url + "/apis/kubeflow.org/v1beta1/namespaces/default/"
+            "notebooks/wbs",
+            data=json.dumps({"spec": {"template": {"spec": {"containers": [
+                {"name": "wbs", "image": "jupyter:2"}]}}}}).encode(),
+            headers={"Content-Type":
+                     "application/strategic-merge-patch+json"},
+            method="PATCH")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["apiVersion"] == "kubeflow.org/v1beta1"
+        stored = api.get("Notebook", "default", "wbs")
+        assert stored.api_version == "kubeflow.org/v1"
+        (c,) = stored.body["spec"]["template"]["spec"]["containers"]
+        assert c["image"] == "jupyter:2"
+        assert c["env"] == [{"name": "NB_PREFIX", "value": "/nb"}], \
+            "keyed merge through the conversion hooks keeps siblings"
+
+    def test_alias_version_field_selector_list(self, conversion_stack):
+        """fieldSelector on an alias-version list is evaluated on the
+        converted view — and the filtered items come back in the request
+        version."""
+        api, srv = conversion_stack
+        api.create(make_notebook("sel-a"))
+        api.create(make_notebook("sel-b"))
+        code, lst = self._request(
+            srv, "GET",
+            "/apis/kubeflow.org/v1beta1/namespaces/default/notebooks"
+            "?fieldSelector=metadata.name%3Dsel-b")
+        assert code == 200
+        assert [i["metadata"]["name"] for i in lst["items"]] == ["sel-b"]
+        assert lst["items"][0]["apiVersion"] == "kubeflow.org/v1beta1"
 
     def test_alias_version_404s_without_converter(self):
         """A wire server with no conversion webhook must NOT serve alias
