@@ -4,6 +4,12 @@ Prints ONE JSON line:
   {"metric": "train_mfu_v5e", "value": <mfu>, "unit": "fraction",
    "vs_baseline": <mfu / 0.35>}
 
+`python bench.py --decode [steps]` instead measures KV-cache decode
+throughput (models/generate.py): aggregate sampled tokens/s at batch 16,
+reported against the HBM roofline — each decode step must stream every
+bf16 weight once, so the step-rate ceiling is hbm_gbps / param_bytes and
+`vs_baseline` is the fraction of that roofline achieved.
+
 The reference publishes no perf numbers (BASELINE.md); the baseline is this
 framework's own headline target — >=35% MFU on the MaxText-style Llama
 workload (BASELINE.json), so vs_baseline = mfu / 0.35.  Single-chip proxy:
@@ -33,6 +39,71 @@ from kubeflow_tpu.models.train import (
 from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
 
 MFU_TARGET = 0.35  # BASELINE.md headline: MaxText Llama-2-7B on v5e-16
+
+
+def main_decode(num_steps: int) -> None:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.configs import BENCH_CHIP, TINY
+    from kubeflow_tpu.models.generate import decode_config, generate
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.tpu.topology import (
+        ACCELERATORS,
+        accelerator_from_device_kind,
+    )
+
+    backend = jax.default_backend()
+    devices = jax.devices()
+    accel = (accelerator_from_device_kind(devices[0].device_kind)
+             if backend == "tpu" else "v5e")
+    config, batch, prompt_len, new_tokens = BENCH_CHIP, 16, 128, 256
+    if backend == "cpu":  # CI smoke
+        config, batch, prompt_len, new_tokens = TINY, 2, 8, 16
+    config = decode_config(config).with_(max_seq_len=prompt_len + new_tokens)
+
+    model = Transformer(config)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (batch, prompt_len), 0,
+                                config.vocab_size)
+    params = jax.jit(model.init)(rng, prompt)["params"]
+    # decode is weight-bandwidth bound: stream bf16 weights, not fp32
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    import numpy as np
+
+    run = jax.jit(lambda p, t: generate(config, p, t, new_tokens))
+    np.asarray(run(params, prompt))  # compile + warmup; a VALUE transfer —
+    # block_until_ready alone does not block through the remote relay, and
+    # identical inputs can be served from its result cache, so each timed
+    # iteration also uses a fresh prompt
+    best = 0.0
+    for i in range(max(1, num_steps // 4) if backend != "cpu" else 1):
+        p = jax.random.randint(jax.random.PRNGKey(1000 + i),
+                               (batch, prompt_len), 0, config.vocab_size)
+        np.asarray(p)
+        t0 = time.perf_counter()
+        np.asarray(run(params, p))
+        dt = time.perf_counter() - t0
+        best = max(best, batch * new_tokens / dt)
+    param_bytes = config.num_params * 2  # bf16
+    roofline_steps = ACCELERATORS[accel].hbm_gbps * 1e9 / param_bytes
+    roofline_tok_s = roofline_steps * batch
+    print(json.dumps({
+        "metric": f"decode_tok_s_{accel}",
+        "value": round(best, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(best / roofline_tok_s, 4),
+        "detail": {
+            "model": "bench-chip-470m" if backend != "cpu" else "tiny-cpu",
+            "batch": batch, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "hbm_roofline_tok_s": round(roofline_tok_s, 1),
+            "backend": backend,
+        },
+    }))
 
 
 def main() -> None:
@@ -99,4 +170,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--decode" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--decode"]
+        main_decode(int(args[0]) if args else 12)
+    else:
+        main()

@@ -9,3 +9,5 @@ cd "$(dirname "$0")/.."
 python ci/flash_numerics.py
 out=$(python bench.py 2 2>/dev/null | grep '^{')
 echo "$out" | python -c 'import json,sys; d=json.load(sys.stdin); assert {"metric","value","unit","vs_baseline"} <= set(d), d; print("bench smoke ok:", d["metric"])'
+out=$(python bench.py --decode 2>/dev/null | grep '^{')
+echo "$out" | python -c 'import json,sys; d=json.load(sys.stdin); assert {"metric","value","unit","vs_baseline"} <= set(d), d; print("bench smoke ok:", d["metric"])'

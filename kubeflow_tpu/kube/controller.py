@@ -113,6 +113,21 @@ class Manager:
             )
         )
 
+    def unregister(self, name: str) -> None:
+        """Remove a controller and drop its queued/delayed work.  An
+        in-flight reconcile for it finishes first (the worker holds no
+        lock across reconciles, so the next _pop simply won't see it)."""
+        with self._lock:
+            self._registrations = [
+                r for r in self._registrations if r.name != name]
+            self._queue = [k for k in self._queue if k[0] != name]
+            self._queued = {k for k in self._queued if k[0] != name}
+            self._delayed = [d for d in self._delayed if d.reg_name != name]
+            # retry budgets die with the controller — a later registration
+            # under the same name starts fresh, not mid-backoff
+            self._retries = {k: v for k, v in self._retries.items()
+                             if k[0] != name}
+
     # -- event -> requests ----------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
         for reg in self._registrations:
@@ -190,7 +205,10 @@ class Manager:
         if item is None:
             return False
         reg_name, req = item
-        reg = next(r for r in self._registrations if r.name == reg_name)
+        reg = next((r for r in self._registrations if r.name == reg_name),
+                   None)
+        if reg is None:
+            return True  # unregistered while queued: drop the item
         try:
             result = reg.reconciler.reconcile(req) or Result()
             self._retries.pop(item, None)

@@ -183,7 +183,7 @@ class _WireHandler(BaseHTTPRequestHandler):
     # serves continue requests at the original revision); bounded,
     # eviction -> 410 Expired and the client relists, exactly client-go's
     # pager fallback
-    _list_snapshots: "dict[int, tuple[int, list]]" = {}
+    _list_snapshots: "dict[int, tuple[int, list, bool]]" = {}
     _snapshot_lock = threading.Lock()
     _snapshot_seq = [0]
     _MAX_SNAPSHOTS = 32

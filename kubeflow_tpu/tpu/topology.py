@@ -34,13 +34,15 @@ class Accelerator:
     max_single_host_chips: int
     hbm_gib_per_chip: int
     bf16_peak_tflops: float   # per-chip peak, for MFU math
+    hbm_gbps: float           # per-chip HBM bandwidth, for decode math
 
 
 ACCELERATORS: dict[str, Accelerator] = {
-    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 32, 275.0),
-    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 16, 197.0),
-    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 95, 459.0),
-    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 32, 918.0),
+    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 32, 275.0, 1228.0),
+    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 16, 197.0,
+                       819.0),
+    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 95, 459.0, 2765.0),
+    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 32, 918.0, 1640.0),
 }
 
 

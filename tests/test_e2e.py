@@ -14,6 +14,9 @@ import pytest
 
 from kubeflow_tpu.api.types import Notebook, TPUSpec
 from kubeflow_tpu.core import constants as CC
+from kubeflow_tpu.core.culling_controller import setup_culling
+from kubeflow_tpu.core.jupyter import FakeJupyterState
+from kubeflow_tpu.core.metrics import NotebookMetrics
 from kubeflow_tpu.core.notebook_controller import setup_core_controllers
 from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
 from kubeflow_tpu.odh import constants as OC
@@ -71,6 +74,21 @@ def wait_for(cond, what: str):
             return result
         time.sleep(POLL_INTERVAL_S)
     raise AssertionError(f"timed out waiting for {what}")
+
+
+def mutate_notebook(api, namespace, name, fn):
+    """Read-mutate-update under the production conflict-retry helper —
+    controllers write status and annotations concurrently with the test,
+    exactly why the reference's e2e wraps every write in RetryOnConflict."""
+    from kubeflow_tpu.kube import retry_on_conflict
+
+    def attempt():
+        nb = api.get("Notebook", namespace, name)
+        fn(nb)
+        return api.update(nb)
+
+    return retry_on_conflict(attempt, steps=20,
+                             initial_backoff_s=POLL_INTERVAL_S, factor=1.0)
 
 
 @pytest.fixture(scope="module")
@@ -165,9 +183,10 @@ class TestE2ENotebookLifecycle:
 
     def test_phase_update_stop_resume(self, stack, ctx):
         api, _, _ = stack
-        live = api.get("Notebook", ctx.namespace, ctx.name)
-        live.metadata.annotations[CC.STOP_ANNOTATION] = "2026-07-29T00:00:00Z"
-        api.update(live)
+        mutate_notebook(
+            api, ctx.namespace, ctx.name,
+            lambda nb: nb.metadata.annotations.__setitem__(
+                CC.STOP_ANNOTATION, "2026-07-29T00:00:00Z"))
         wait_for(
             lambda: all(
                 s.spec["replicas"] == 0
@@ -177,9 +196,9 @@ class TestE2ENotebookLifecycle:
             ),
             f"{ctx.name}: slice-atomic stop",
         )
-        live = api.get("Notebook", ctx.namespace, ctx.name)
-        del live.metadata.annotations[CC.STOP_ANNOTATION]
-        api.update(live)
+        mutate_notebook(
+            api, ctx.namespace, ctx.name,
+            lambda nb: nb.metadata.annotations.pop(CC.STOP_ANNOTATION, None))
         wait_for(
             lambda: api.get("Notebook", ctx.namespace, ctx.name)
             .body.get("status", {})
@@ -187,6 +206,72 @@ class TestE2ENotebookLifecycle:
             == ctx.expected_hosts,
             f"{ctx.name}: resume",
         )
+
+    def test_phase_cull_uncull(self, stack, ctx):
+        """Idle-culling against the LIVE threaded stack (the reference's
+        e2e culls a real notebook, notebook_creation_test.go:31-83): mark
+        the Jupyter server idle, watch the culler stop the workload
+        slice-atomically, then un-cull and watch it resume."""
+        api, _, mgr = stack
+        jupyter = FakeJupyterState()
+        # fast-cull config: a 3-second idle threshold (annotations
+        # initialize to NOW and never move backwards, so the threshold is
+        # real wall time); a busy kernel bumps last-activity every pass and
+        # stays under it for the resume window; check period 0 re-evaluates
+        # every reconcile
+        # (check period must be >0: requeue_after=0 means "don't requeue",
+        # so a 0 period would only ever re-check on watch events)
+        cull_cfg = CoreConfig(enable_culling=True, cull_idle_time_min=0.05,
+                              idleness_check_period_min=0.01)
+        rec = setup_culling(mgr, cull_cfg, jupyter, NotebookMetrics(api))
+        try:
+            # every OTHER live context reports a busy kernel so only THIS
+            # context's idle-detection is exercised — otherwise the first
+            # cull phase would cull the whole module's notebooks and later
+            # contexts would assert trivially against pre-culled state
+            for other in CONTEXTS:
+                if other.name != ctx.name:
+                    jupyter.set_kernels(other.namespace, other.name, [{
+                        "id": "k1", "name": "python3",
+                        "last_activity": "2020-01-01T00:00:00Z",
+                        "execution_state": "busy", "connections": 1}])
+            # this context must arrive UN-culled (a prior context's phase
+            # culling it would make the wait below assert stale state)
+            assert CC.STOP_ANNOTATION not in api.get(
+                "Notebook", ctx.namespace, ctx.name).metadata.annotations
+            jupyter.set_kernels(ctx.namespace, ctx.name, [{
+                "id": "k1", "name": "python3",
+                "last_activity": "2020-01-01T00:00:00Z",
+                "execution_state": "idle", "connections": 0}])
+            mgr.enqueue_all("culling")
+            wait_for(
+                lambda: all(
+                    s.spec["replicas"] == 0
+                    for s in api.list("StatefulSet", namespace=ctx.namespace)
+                    if s.name == ctx.name
+                    or s.name.startswith(f"{ctx.name}-slice-")),
+                f"{ctx.name}: culled slice-atomically")
+            live = api.get("Notebook", ctx.namespace, ctx.name)
+            assert CC.STOP_ANNOTATION in live.metadata.annotations
+            # the user comes back: kernel goes busy (at a 0-minute idle
+            # threshold anything else would be instantly re-culled)
+            jupyter.set_kernels(ctx.namespace, ctx.name, [{
+                "id": "k1", "name": "python3",
+                "last_activity": "2020-01-01T00:00:00Z",
+                "execution_state": "busy", "connections": 1}])
+            # un-cull: the dashboard removes the stop annotation
+            mutate_notebook(
+                api, ctx.namespace, ctx.name,
+                lambda nb: nb.metadata.annotations.pop(
+                    CC.STOP_ANNOTATION, None))
+            wait_for(
+                lambda: api.get("Notebook", ctx.namespace, ctx.name)
+                .body.get("status", {}).get("readyReplicas")
+                == ctx.expected_hosts,
+                f"{ctx.name}: resumed after un-cull")
+        finally:
+            # later phases (and other contexts) must not fight the culler
+            mgr.unregister("culling")
 
     def test_phase_delete(self, stack, ctx):
         api, _, _ = stack
