@@ -316,6 +316,18 @@ class KubeClient:
                           content_type="application/strategic-merge-patch+json")
         return KubeObject.from_dict(d)
 
+    def apply(self, obj: KubeObject, field_manager: str,
+              force: bool = False) -> KubeObject:
+        """Server-side apply (client-go types.ApplyPatchType): declarative
+        upsert with managedFields ownership arbitration on the server."""
+        info = self.scheme_registry.by_kind(obj.kind)
+        path = info.object_path(obj.namespace or None, obj.name)
+        path += "?" + urlencode({"fieldManager": field_manager,
+                                 "force": "true" if force else "false"})
+        d = self._request("PATCH", path, body=obj.to_dict(),
+                          content_type="application/apply-patch+yaml")
+        return KubeObject.from_dict(d)
+
     def json_patch(self, kind: str, namespace: str, name: str,
                    ops: list) -> KubeObject:
         """RFC 6902 patch (client-go types.JSONPatchType); `test` ops carry

@@ -209,9 +209,19 @@ class Manager:
                    None)
         if reg is None:
             return True  # unregistered while queued: drop the item
+
+        def alive() -> bool:
+            # unregister() may run DURING the reconcile; its queue/retry
+            # cleanup must not be undone by this reconcile's bookkeeping —
+            # identity check, so a same-name re-registration stays clean
+            with self._lock:
+                return any(r is reg for r in self._registrations)
+
         try:
             result = reg.reconciler.reconcile(req) or Result()
             self._retries.pop(item, None)
+            if not alive():
+                return True
             if result.requeue_after > 0:
                 with self._lock:
                     self._delayed.append(
@@ -220,6 +230,8 @@ class Manager:
             elif result.requeue:
                 self._enqueue(reg_name, req)
         except Exception as err:  # controller-runtime: requeue with backoff
+            if not alive():
+                return True
             count = self._retries.get(item, 0) + 1
             self._retries[item] = count
             if count <= reg.max_retries:

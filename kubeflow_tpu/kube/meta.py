@@ -68,6 +68,10 @@ class ObjectMeta:
     annotations: dict[str, str] = field(default_factory=dict)
     owner_references: list[OwnerReference] = field(default_factory=list)
     finalizers: list[str] = field(default_factory=list)
+    # server-side-apply bookkeeping: raw managedFields entries
+    # ({manager, operation, apiVersion, fieldsType, fieldsV1}) — kept
+    # unstructured like the body (kube/apply.py owns the semantics)
+    managed_fields: list[dict] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -88,6 +92,8 @@ class ObjectMeta:
             d["ownerReferences"] = [o.to_dict() for o in self.owner_references]
         if self.finalizers:
             d["finalizers"] = list(self.finalizers)
+        if self.managed_fields:
+            d["managedFields"] = copy.deepcopy(self.managed_fields)
         return d
 
     @classmethod
@@ -107,6 +113,7 @@ class ObjectMeta:
                 OwnerReference.from_dict(o) for o in d.get("ownerReferences") or []
             ],
             finalizers=list(d.get("finalizers") or []),
+            managed_fields=copy.deepcopy(d.get("managedFields") or []),
         )
 
     def controller_owner(self) -> Optional[OwnerReference]:

@@ -411,6 +411,77 @@ class ApiServer:
         return self._patch_with_retry(
             kind, namespace, name, apply_smp, view_out, view_in)
 
+    def apply(
+        self, kind: str, namespace: str, name: str, applied: dict,
+        field_manager: str, force: bool = False,
+        view_out=None, view_in=None,
+    ) -> KubeObject:
+        """Server-side apply (kube/apply.py): upsert with managedFields
+        ownership.  ApplyConflict surfaces as ConflictError (409 with the
+        owning managers in the message); same conflict retry and
+        cross-version view hooks as the other patch verbs."""
+        from .apply import (
+            ApplyConflict,
+            apply_update,
+            field_set,
+            sanitize_applied,
+        )
+
+        if not field_manager:
+            raise InvalidError("fieldManager is required for apply")
+        api_version = applied.get("apiVersion", "")
+        applied = sanitize_applied(applied)
+        last: Exception | None = None
+        for _ in range(16):
+            try:
+                current = self.get(kind, namespace, name)
+            except NotFoundError:
+                # create path: the applied config becomes the object, with
+                # this manager owning exactly what it applied
+                obj = KubeObject.from_dict(copy.deepcopy(applied))
+                obj.kind = kind
+                obj.metadata.namespace = namespace
+                obj.metadata.name = name
+                obj.metadata.managed_fields = [{
+                    "manager": field_manager,
+                    "operation": "Apply",
+                    "apiVersion": api_version or obj.api_version,
+                    "fieldsType": "FieldsV1",
+                    "fieldsV1": field_set(applied),
+                    "time": now_iso(),
+                }]
+                if view_in is not None:
+                    obj = view_in(obj)
+                try:
+                    return self.create(obj)
+                except AlreadyExistsError as err:
+                    last = err
+                    continue  # raced another creator: re-apply onto it
+            base = current.to_dict()
+            if view_out is not None:
+                base = view_out(base)
+            try:
+                merged_dict = apply_update(
+                    base, applied, field_manager,
+                    api_version or current.api_version,
+                    force=force, now=now_iso())
+            except ApplyConflict as err:
+                raise ConflictError(str(err)) from None
+            merged = KubeObject.from_dict(merged_dict)
+            if view_in is not None:
+                merged = view_in(merged)
+            merged.metadata.resource_version = current.metadata.resource_version
+            try:
+                return self.update(merged)
+            except ConflictError as err:
+                last = err
+            except NotFoundError as err:
+                # a delete raced the read-modify-write: apply is an upsert,
+                # so fall back to the create path on the next iteration
+                last = err
+        assert last is not None
+        raise last
+
     def json_patch(
         self, kind: str, namespace: str, name: str, ops: list,
         view_out=None, view_in=None,

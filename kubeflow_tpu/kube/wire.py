@@ -459,6 +459,19 @@ class _WireHandler(BaseHTTPRequestHandler):
                     raise InvalidError("json patch body must be an op list")
                 updated = self.api.json_patch(
                     rt.info.kind, rt.namespace or "", rt.name, patch, **hooks)
+            elif "apply-patch" in ctype:
+                # server-side apply: ?fieldManager=...&force=true|false
+                if not isinstance(patch, dict):
+                    raise InvalidError("apply body must be a JSON object")
+                q = self._query()
+                manager = q.get("fieldManager", "")
+                if not manager:
+                    raise InvalidError(
+                        "fieldManager query parameter is required for apply")
+                force = q.get("force", "false") in ("true", "1")
+                updated = self.api.apply(
+                    rt.info.kind, rt.namespace or "", rt.name, patch,
+                    field_manager=manager, force=force, **hooks)
             elif "strategic-merge" in ctype:
                 # patchMergeKey-keyed list merge + $patch directives
                 # (kube.strategicmerge) — what kubectl sends for core types
