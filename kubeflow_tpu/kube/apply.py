@@ -125,10 +125,14 @@ def _fs_value(val, field_name: Optional[str]) -> dict:
 
 def leaf_paths(fs: dict, prefix: tuple = ()) -> Iterator[tuple]:
     """Ownable leaves of a fieldsV1 tree.  A `k:` item's `.` marker is a
-    leaf (item membership); empty dicts are value leaves."""
+    leaf (item membership); empty dicts are value leaves.  Tolerates
+    malformed trees (clients can write arbitrary managedFields through
+    plain create/update): non-dict nodes are leaves."""
     for key, sub in fs.items():
+        if not isinstance(key, str):
+            continue
         path = prefix + (key,)
-        if not sub:
+        if not isinstance(sub, dict) or not sub:
             yield path
         else:
             yield from leaf_paths(sub, path)
@@ -186,6 +190,11 @@ def find_conflicts(
     with a DIFFERENT current value — equal values co-own, no conflict."""
     clashes: list[tuple[str, tuple]] = []
     for path in leaf_paths(applied_fs):
+        if path[-1] == ".":
+            # item MEMBERSHIP always co-owns: two managers applying
+            # disjoint field subsets of the same container must compose,
+            # not 409 (conflicts arise only on actual value leaves)
+            continue
         desired = _value_at(applied, path)
         have = _value_at(current, path)
         if desired is not _MISSING and have is not _MISSING \
@@ -325,16 +334,19 @@ def apply_update(
 ) -> dict:
     """One server-side apply step: conflict-check, prune, merge, and
     rewrite this manager's managedFields entry.  Returns the new object
-    dict; raises ApplyConflict."""
-    applied = sanitize_applied(applied)
+    dict; raises ApplyConflict.  `applied` must be pre-sanitized
+    (sanitize_applied) — ApiServer.apply does this once, outside its
+    retry loop."""
     applied_fs = field_set(applied)
     meta = current.get("metadata") or {}
     entries = [e for e in (meta.get("managedFields") or [])
-               if e.get("operation") == "Apply"]
+               if isinstance(e, dict) and e.get("operation") == "Apply"]
     mine_old: dict = {}
     others: list[tuple[str, dict]] = []
     for e in entries:
-        fs = e.get("fieldsV1") or {}
+        fs = e.get("fieldsV1")
+        if not isinstance(fs, dict):
+            fs = {}  # malformed tree written via plain update: ignore
         if e.get("manager") == manager:
             mine_old = fs
         else:

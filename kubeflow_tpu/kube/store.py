@@ -414,12 +414,14 @@ class ApiServer:
     def apply(
         self, kind: str, namespace: str, name: str, applied: dict,
         field_manager: str, force: bool = False,
-        view_out=None, view_in=None,
+        view_out=None, view_in=None, return_created: bool = False,
     ) -> KubeObject:
         """Server-side apply (kube/apply.py): upsert with managedFields
         ownership.  ApplyConflict surfaces as ConflictError (409 with the
         owning managers in the message); same conflict retry and
-        cross-version view hooks as the other patch verbs."""
+        cross-version view hooks as the other patch verbs.
+        `return_created=True` returns (obj, created) so the wire layer can
+        answer 201 for the create path without a racy pre-lookup."""
         from .apply import (
             ApplyConflict,
             apply_update,
@@ -453,7 +455,8 @@ class ApiServer:
                 if view_in is not None:
                     obj = view_in(obj)
                 try:
-                    return self.create(obj)
+                    created = self.create(obj)
+                    return (created, True) if return_created else created
                 except AlreadyExistsError as err:
                     last = err
                     continue  # raced another creator: re-apply onto it
@@ -472,7 +475,8 @@ class ApiServer:
                 merged = view_in(merged)
             merged.metadata.resource_version = current.metadata.resource_version
             try:
-                return self.update(merged)
+                updated = self.update(merged)
+                return (updated, False) if return_created else updated
             except ConflictError as err:
                 last = err
             except NotFoundError as err:

@@ -469,9 +469,14 @@ class _WireHandler(BaseHTTPRequestHandler):
                     raise InvalidError(
                         "fieldManager query parameter is required for apply")
                 force = q.get("force", "false") in ("true", "1")
-                updated = self.api.apply(
+                updated, created = self.api.apply(
                     rt.info.kind, rt.namespace or "", rt.name, patch,
-                    field_manager=manager, force=force, **hooks)
+                    field_manager=manager, force=force,
+                    return_created=True, **hooks)
+                # apply is an upsert: a create answers 201 like POST
+                self._send_json(200 if not created else 201,
+                                self._convert_out(updated.to_dict(), rt))
+                return
             elif "strategic-merge" in ctype:
                 # patchMergeKey-keyed list merge + $patch directives
                 # (kube.strategicmerge) — what kubectl sends for core types

@@ -182,6 +182,38 @@ class TestApplySemantics:
                  .body["spec"]["template"]["spec"]["containers"]]
         assert names == ["wb"]
 
+    def test_disjoint_fields_of_one_item_compose(self):
+        """Two managers owning different fields of the SAME container must
+        compose without conflict — item membership always co-owns."""
+        api = ApiServer()
+        api.apply("Notebook", "default", "wb", applied_nb(),
+                  field_manager="alice")
+        bob_cfg = {
+            "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+            "metadata": {"name": "wb", "namespace": "default"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "wb", "resources": {"limits": {"cpu": "1"}}}]}}},
+        }
+        api.apply("Notebook", "default", "wb", bob_cfg, field_manager="bob")
+        (c,) = api.get("Notebook", "default", "wb") \
+            .body["spec"]["template"]["spec"]["containers"]
+        assert c["image"] == "jupyter:1" and c["resources"] == {
+            "limits": {"cpu": "1"}}
+
+    def test_malformed_managed_fields_tolerated(self):
+        """A plain create can write arbitrary managedFields; the next
+        apply must treat a malformed fieldsV1 as empty, not crash."""
+        api = ApiServer()
+        bogus = applied_nb()
+        bogus["metadata"]["managedFields"] = [
+            {"manager": "weird", "operation": "Apply",
+             "fieldsV1": ["not-a-tree"]}]
+        api.create(KubeObject.from_dict(bogus))
+        out = api.apply("Notebook", "default", "wb", applied_nb(),
+                        field_manager="alice")
+        assert any(e["manager"] == "alice"
+                   for e in out.metadata.managed_fields)
+
     def test_empty_maps_cleaned_inside_keyed_items(self):
         api = ApiServer()
         first = applied_nb()
