@@ -372,8 +372,9 @@ def apply_update(
 
     new_meta = out.setdefault("metadata", {})
     kept = [e for e in (meta.get("managedFields") or [])
-            if not (e.get("operation") == "Apply"
-                    and e.get("manager") == manager)]
+            if isinstance(e, dict)  # non-dict junk from plain writes: drop
+            and not (e.get("operation") == "Apply"
+                     and e.get("manager") == manager)]
     kept = [e for e in kept if e.get("operation") != "Apply"
             or e.get("fieldsV1")]
     kept.append({

@@ -415,7 +415,7 @@ class ApiServer:
         self, kind: str, namespace: str, name: str, applied: dict,
         field_manager: str, force: bool = False,
         view_out=None, view_in=None, return_created: bool = False,
-    ) -> KubeObject:
+    ) -> "KubeObject | tuple[KubeObject, bool]":
         """Server-side apply (kube/apply.py): upsert with managedFields
         ownership.  ApplyConflict surfaces as ConflictError (409 with the
         owning managers in the message); same conflict retry and

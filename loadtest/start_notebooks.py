@@ -6,6 +6,12 @@ standalone stack and reports the north-star metric BASELINE.md defines:
 notebook-to-ready latency (p50/p95/max), for CPU and TPU shapes.
 
     python loadtest/start_notebooks.py -l 50 --tpu v5e:4x4
+
+`--wire` routes everything through the real-cluster backend instead of
+the in-memory store: the ApiServer is served over the k8s wire protocol
+and both the controllers (KubeClient + informers) and the load driver
+talk to it over sockets — end-to-end latency including the REST/watch
+round trips.
 """
 
 from __future__ import annotations
@@ -31,9 +37,25 @@ def main(argv=None) -> int:
     parser.add_argument("--tpu", default="",
                         help="accelerator:topology, e.g. v5e:4x4 (default CPU)")
     parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--wire", action="store_true",
+                        help="drive through the wire-protocol backend "
+                        "(real sockets + informers) instead of in-memory")
     args = parser.parse_args(argv)
 
-    mgr, api, cluster, _ = build_manager()
+    srv = client = None
+    if args.wire:
+        from kubeflow_tpu.kube import ApiServer, FakeCluster
+        from kubeflow_tpu.kube.client import KubeClient, RestConfig
+        from kubeflow_tpu.kube.wire import KubeApiWireServer
+
+        store = ApiServer()
+        cluster = FakeCluster(store)
+        srv = KubeApiWireServer(store).start()
+        client = KubeClient(RestConfig(server=srv.url))
+        mgr, api, _, _ = build_manager(api=client)
+        client.start_informers(mgr.watched_kinds())
+    else:
+        mgr, api, cluster, _ = build_manager()
     cluster.add_node("cpu-node", allocatable={"cpu": "512", "memory": "2048Gi"})
     tpu = None
     if args.tpu:
@@ -47,30 +69,36 @@ def main(argv=None) -> int:
     mgr.start()
 
     latencies: list[float] = []
-    t_start = time.perf_counter()
-    for i in range(args.count):
-        name = f"loadtest-nb-{i}"
-        t0 = time.perf_counter()
-        api.create(Notebook.new(name, args.namespace, tpu=tpu).obj)
-        deadline = t0 + args.timeout
-        while time.perf_counter() < deadline:
-            live = api.try_get("Notebook", args.namespace, name)
-            status = (live.body.get("status") or {}) if live else {}
-            expected = tpu.shape.num_hosts if tpu else 1
-            if status.get("readyReplicas") == expected:
-                latencies.append(time.perf_counter() - t0)
-                break
-            time.sleep(0.01)
-        else:
-            print(f"TIMEOUT waiting for {name}", file=sys.stderr)
-            mgr.stop()
-            return 1
-    total = time.perf_counter() - t_start
-    mgr.stop()
+    try:
+        t_start = time.perf_counter()
+        for i in range(args.count):
+            name = f"loadtest-nb-{i}"
+            t0 = time.perf_counter()
+            api.create(Notebook.new(name, args.namespace, tpu=tpu).obj)
+            deadline = t0 + args.timeout
+            while time.perf_counter() < deadline:
+                live = api.try_get("Notebook", args.namespace, name)
+                status = (live.body.get("status") or {}) if live else {}
+                expected = tpu.shape.num_hosts if tpu else 1
+                if status.get("readyReplicas") == expected:
+                    latencies.append(time.perf_counter() - t0)
+                    break
+                time.sleep(0.01)
+            else:
+                print(f"TIMEOUT waiting for {name}", file=sys.stderr)
+                return 1
+        total = time.perf_counter() - t_start
+    finally:
+        mgr.stop()
+        if client is not None:
+            client.stop_informers()
+        if srv is not None:
+            srv.stop()
 
     latencies.sort()
     print(json.dumps({
         "notebooks": args.count,
+        "backend": "wire" if args.wire else "in-memory",
         "tpu": args.tpu or "cpu",
         "total_s": round(total, 3),
         "ready_latency_p50_s": round(statistics.median(latencies), 4),

@@ -206,6 +206,7 @@ class TestApplySemantics:
         api = ApiServer()
         bogus = applied_nb()
         bogus["metadata"]["managedFields"] = [
+            "not-even-a-dict",
             {"manager": "weird", "operation": "Apply",
              "fieldsV1": ["not-a-tree"]}]
         api.create(KubeObject.from_dict(bogus))
