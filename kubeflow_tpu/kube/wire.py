@@ -23,7 +23,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from .errors import ApiError, GoneError, InvalidError, ServerError
+from .errors import (
+    ApiError,
+    GoneError,
+    InvalidError,
+    NotFoundError,
+    ServerError,
+)
 from .meta import KubeObject
 from .resources import DEFAULT_SCHEME, ResourceInfo, Scheme
 from .store import ApiServer, WatchEvent, match_labels
@@ -341,12 +347,10 @@ class _WireHandler(BaseHTTPRequestHandler):
             rv, all_items, converted = snap
             items = all_items[cursor:]
         else:
-            selector = parse_label_selector(q.get("labelSelector", ""))
-            try:
-                fields = parse_field_selector(q.get("fieldSelector", ""))
-            except ValueError as err:
-                self._send_json(400, status_body(400, "BadRequest", str(err)))
+            parsed = self._parse_selectors(q)
+            if parsed is None:
                 return
+            selector, fields = parsed
             objs, rv = self.api.list_with_rv(rt.info.kind, rt.namespace,
                                              selector or None)
             if fields:
@@ -499,23 +503,66 @@ class _WireHandler(BaseHTTPRequestHandler):
         if not self._guard():
             return
         rt = self._route()
-        if rt is None or rt.name is None:
+        if rt is None:
             return
         try:
+            if rt.name is None:
+                self._delete_collection(rt)
+                return
             self.api.delete(rt.info.kind, rt.namespace or "", rt.name)
             self._send_json(200, status_body(200, "", "deleted")
                             | {"status": "Success"})
         except ApiError as err:
             self._send_error_status(err)
 
-    # -- watch streaming ------------------------------------------------------
-    def _serve_watch(self, rt: _Route, q: dict[str, str]) -> None:
+    def _parse_selectors(self, q: dict[str, str]):
+        """(labels, fields) from the query, or None after answering 400 —
+        the one selector-parsing path for list/watch/deletecollection."""
         selector = parse_label_selector(q.get("labelSelector", ""))
         try:
             fields = parse_field_selector(q.get("fieldSelector", ""))
         except ValueError as err:
             self._send_json(400, status_body(400, "BadRequest", str(err)))
+            return None
+        return selector, fields
+
+    def _delete_collection(self, rt: "_Route") -> None:
+        """DELETE on a collection path (kubectl delete --all): remove every
+        object matching the label/field selectors and answer the list of
+        deleted items, as the apiserver's deletecollection verb does.
+        Finalizer-bearing objects begin terminating rather than vanish —
+        identical to per-object deletes."""
+        parsed = self._parse_selectors(self._query())
+        if parsed is None:
             return
+        selector, fields = parsed
+        objs, _ = self.api.list_with_rv(rt.info.kind, rt.namespace,
+                                        selector or None)
+        items = self._convert_out_many([o.to_dict() for o in objs], rt)
+        if fields:
+            items = [d for d in items if match_fields(d, fields)]
+        for d in items:
+            try:
+                # each item's OWN namespace: a cluster-scope collection
+                # delete spans namespaces (rt.namespace is None there)
+                self.api.delete(rt.info.kind,
+                                d["metadata"].get("namespace", ""),
+                                d["metadata"]["name"])
+            except NotFoundError:
+                pass  # raced another deleter: already gone
+        self._send_json(200, {
+            "kind": f"{rt.info.kind}List",
+            "apiVersion": rt.info.api_version,
+            "metadata": {"resourceVersion": str(self.api.resource_version)},
+            "items": items,
+        })
+
+    # -- watch streaming ------------------------------------------------------
+    def _serve_watch(self, rt: _Route, q: dict[str, str]) -> None:
+        parsed = self._parse_selectors(q)
+        if parsed is None:
+            return
+        selector, fields = parsed
         since_rv = int(q["resourceVersion"]) if q.get("resourceVersion") else None
         events: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
 

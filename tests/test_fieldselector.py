@@ -95,6 +95,44 @@ class TestOverTheWire:
             field_selector="involvedObject.name=wb2,involvedObject.kind=Notebook")
         assert [o.name for o in got] == ["ev-wb2"]
 
+    def test_delete_collection_with_selectors(self, wire):
+        """DELETE on the collection path (kubectl delete --all): only
+        selector matches go, and the deleted items come back as a List."""
+        import json
+        import urllib.request
+        api, client = wire
+        for name, team in [("a", "ml"), ("b", "web"), ("c", "ml")]:
+            nb = Notebook.new(name, "default").obj
+            nb.metadata.labels["team"] = team
+            client.create(nb)
+        req = urllib.request.Request(
+            client.config.server
+            + "/apis/kubeflow.org/v1/namespaces/default/notebooks"
+            + "?labelSelector=team%3Dml&fieldSelector=metadata.name%21%3Da",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.load(resp)
+        assert body["kind"] == "NotebookList"
+        assert [i["metadata"]["name"] for i in body["items"]] == ["c"]
+        assert sorted(o.name for o in client.list("Notebook", "default")) \
+            == ["a", "b"]
+
+    def test_delete_collection_cluster_scope_spans_namespaces(self, wire):
+        """A cluster-scope collection DELETE (no namespace segment) must
+        delete each item in its OWN namespace, not silently no-op."""
+        import json
+        import urllib.request
+        api, client = wire
+        for ns in ("team-a", "team-b"):
+            client.create(Notebook.new("wb", ns).obj)
+        req = urllib.request.Request(
+            client.config.server + "/apis/kubeflow.org/v1/notebooks",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.load(resp)
+        assert len(body["items"]) == 2
+        assert client.list("Notebook") == []
+
     def test_invalid_selector_answers_400(self, wire):
         import urllib.error
         import urllib.request
