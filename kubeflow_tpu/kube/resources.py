@@ -114,6 +114,16 @@ class Scheme:
             raise KeyError(f"kind {kind!r} not registered in scheme")
         return info
 
+    def served(self) -> list[ResourceInfo]:
+        """Every served (group, version, plural) mapping — the discovery
+        document source (storage versions AND path aliases)."""
+        return list(self._by_path.values())
+
+    def storage_versions(self) -> set[tuple[str, str]]:
+        """(group, version) pairs that are some kind's storage/default
+        version — discovery marks these preferred."""
+        return {(i.group, i.version) for i in self._by_kind.values()}
+
     def by_path(self, group: str, version: str, plural: str) -> ResourceInfo | None:
         return self._by_path.get((group, version, plural))
 

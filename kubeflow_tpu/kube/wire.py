@@ -300,6 +300,8 @@ class _WireHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if not self._guard():
             return
+        if self._serve_discovery():
+            return
         rt = self._route()
         if rt is None:
             return
@@ -314,6 +316,77 @@ class _WireHandler(BaseHTTPRequestHandler):
                 self._serve_list(rt, q)
         except ApiError as err:
             self._send_error_status(err)
+
+    # standard verbs discovery advertises for every resource; the server
+    # serves all of them (deletecollection included)
+    _VERBS = ["create", "delete", "deletecollection", "get", "list",
+              "patch", "update", "watch"]
+
+    def _serve_discovery(self) -> bool:
+        """API discovery: /api, /apis, /api/v1, /apis/{g}[/{v}] built from
+        the scheme — the first thing kubectl asks any server for."""
+        parts = [p for p in urlsplit(self.path).path.split("/") if p]
+        # cheap shape check first: data-plane GETs (4+ segments, or
+        # /api/v1/{plural}) must not pay the scheme scan
+        if not parts or parts[0] not in ("api", "apis") or len(parts) > 3 \
+                or (parts[0] == "api" and len(parts) > 2):
+            return False
+        storage = self.scheme.storage_versions()
+        infos = self.scheme.served()
+        if self.converter is None:
+            # without a conversion webhook, alias versions 404 on the data
+            # path — discovery must not advertise what can't be served
+            infos = [i for i in infos if (i.group, i.version) in storage]
+        groups: dict[str, set[str]] = {}
+        for i in infos:
+            if i.group:
+                groups.setdefault(i.group, set()).add(i.version)
+
+        def resource_list(group: str, version: str) -> dict:
+            gv = f"{group}/{version}" if group else version
+            return {
+                "kind": "APIResourceList",
+                "apiVersion": "v1",
+                "groupVersion": gv,
+                "resources": [
+                    {"name": i.plural, "singularName": "",
+                     "namespaced": i.namespaced, "kind": i.kind,
+                     "verbs": self._VERBS}
+                    for i in infos
+                    if i.group == group and i.version == version
+                ],
+            }
+
+        def group_doc(name: str) -> dict:
+            versions = sorted(groups[name])
+            pref = next((v for v in versions if (name, v) in storage),
+                        versions[0])
+            return {
+                "name": name,
+                "versions": [{"groupVersion": f"{name}/{v}", "version": v}
+                             for v in versions],
+                "preferredVersion": {"groupVersion": f"{name}/{pref}",
+                                     "version": pref},
+            }
+
+        if parts == ["api"]:
+            self._send_json(200, {"kind": "APIVersions", "versions": ["v1"],
+                                  "serverAddressByClientCIDRs": []})
+        elif parts == ["api", "v1"]:
+            self._send_json(200, resource_list("", "v1"))
+        elif parts == ["apis"]:
+            self._send_json(200, {
+                "kind": "APIGroupList", "apiVersion": "v1",
+                "groups": [group_doc(g) for g in sorted(groups)]})
+        elif len(parts) == 2 and parts[0] == "apis" and parts[1] in groups:
+            self._send_json(200, {"kind": "APIGroup", "apiVersion": "v1"}
+                            | group_doc(parts[1]))
+        elif len(parts) == 3 and parts[0] == "apis" \
+                and parts[1] in groups and parts[2] in groups[parts[1]]:
+            self._send_json(200, resource_list(parts[1], parts[2]))
+        else:
+            return False
+        return True
 
     def _serve_list(self, rt: "_Route", q: dict[str, str]) -> None:
         """LIST with limit/continue pagination.  Every page of one list is

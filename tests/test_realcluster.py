@@ -744,6 +744,72 @@ class TestManagerCli:
 # -- JSON Patch ---------------------------------------------------------------
 
 
+class TestDiscovery:
+    """API discovery documents (/api, /apis, APIResourceList) — kubectl's
+    first requests against any server; built from the scheme registry."""
+
+    @pytest.fixture()
+    def wire(self):
+        api = ApiServer()
+        srv = KubeApiWireServer(api).start()
+        yield srv
+        srv.stop()
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(srv.url + path, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def test_core_and_group_listing(self, wire):
+        assert self._get(wire, "/api")["versions"] == ["v1"]
+        groups = self._get(wire, "/apis")
+        assert groups["kind"] == "APIGroupList"
+        names = {g["name"] for g in groups["groups"]}
+        assert {"kubeflow.org", "apps", "gateway.networking.k8s.io"} <= names
+
+    def test_core_resource_list(self, wire):
+        doc = self._get(wire, "/api/v1")
+        assert doc["kind"] == "APIResourceList"
+        by_name = {r["name"]: r for r in doc["resources"]}
+        assert by_name["configmaps"]["namespaced"] is True
+        assert by_name["nodes"]["namespaced"] is False
+        assert "deletecollection" in by_name["configmaps"]["verbs"]
+
+    def test_no_converter_advertises_storage_only(self, wire):
+        """Without a conversion webhook the alias versions 404 on the data
+        path — discovery must not advertise what cannot be served."""
+        grp = self._get(wire, "/apis/kubeflow.org")
+        assert {v["version"] for v in grp["versions"]} == {"v1"}
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                wire.url + "/apis/kubeflow.org/v1beta1", timeout=5)
+        assert exc.value.code == 404
+
+    def test_group_versions_and_preferred_with_converter(self):
+        from kubeflow_tpu.odh.webhook_server import RemoteConverter
+
+        api = ApiServer()
+        bundle = mint_serving_cert()
+        whsrv = AdmissionReviewServer([], bundle=bundle).start()
+        converter = RemoteConverter(whsrv.url, ca_pem=bundle.ca_cert_pem)
+        srv = KubeApiWireServer(api, converter=converter).start()
+        try:
+            grp = self._get(srv, "/apis/kubeflow.org")
+            versions = {v["version"] for v in grp["versions"]}
+            assert versions == {"v1", "v1beta1", "v1alpha1"}
+            assert grp["preferredVersion"]["version"] == "v1", \
+                "storage version is preferred"
+            doc = self._get(srv, "/apis/kubeflow.org/v1beta1")
+            assert [r["kind"] for r in doc["resources"]] == ["Notebook"]
+        finally:
+            srv.stop()
+            whsrv.stop()
+
+    def test_unknown_paths_still_404(self, wire):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(wire.url + "/apis/nope.io/v1", timeout=5)
+        assert exc.value.code == 404
+
+
 class TestJsonPatch:
     def test_diff_apply_roundtrip(self):
         old = {"a": 1, "b": {"c": [1, 2, 3], "d": "x"}, "gone": True}
