@@ -340,15 +340,18 @@ def apply_update(
     applied_fs = field_set(applied)
     meta = current.get("metadata") or {}
     entries = [e for e in (meta.get("managedFields") or [])
-               if isinstance(e, dict) and e.get("operation") == "Apply"]
+               if isinstance(e, dict)]  # non-dict junk from plain writes
+    mine_entry: Optional[dict] = None
     mine_old: dict = {}
     others: list[tuple[str, dict]] = []
     for e in entries:
+        if e.get("operation") != "Apply":
+            continue
         fs = e.get("fieldsV1")
         if not isinstance(fs, dict):
             fs = {}  # malformed tree written via plain update: ignore
         if e.get("manager") == manager:
-            mine_old = fs
+            mine_entry, mine_old = e, fs
         else:
             others.append((e.get("manager", "?"), fs))
 
@@ -371,20 +374,33 @@ def apply_update(
     drop_empty_structures(out, forest)
 
     new_meta = out.setdefault("metadata", {})
-    kept = [e for e in (meta.get("managedFields") or [])
-            if isinstance(e, dict)  # non-dict junk from plain writes: drop
-            and not (e.get("operation") == "Apply"
-                     and e.get("manager") == manager)]
-    kept = [e for e in kept if e.get("operation") != "Apply"
-            or e.get("fieldsV1")]
-    kept.append({
+    if mine_entry is not None and mine_entry.get("fieldsV1") == applied_fs:
+        # unchanged field set: keep the old timestamp so an identical
+        # re-apply is a byte-identical object — the store's no-op
+        # suppression then skips the RV bump, and a GitOps loop
+        # re-applying on a timer doesn't wake every watcher each pass
+        now = mine_entry.get("time", now)
+    new_entry = {
         "manager": manager,
         "operation": "Apply",
         "apiVersion": api_version,
         "fieldsType": "FieldsV1",
         "fieldsV1": applied_fs,
         **({"time": now} if now else {}),
-    })
+    }
+    # replace IN PLACE: filter-then-append would permute entry order, so
+    # two managers alternating identical re-applies would never produce a
+    # byte-identical object and would bump the RV forever
+    kept: list[dict] = []
+    for e in entries:
+        if e is mine_entry:
+            kept.append(new_entry)
+        elif e.get("operation") == "Apply" and not e.get("fieldsV1"):
+            continue  # emptied by a forced steal: drop the husk
+        else:
+            kept.append(e)
+    if mine_entry is None:
+        kept.append(new_entry)
     new_meta["managedFields"] = kept
     return out
 

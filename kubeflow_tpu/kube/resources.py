@@ -41,12 +41,15 @@ class ResourceInfo:
 _CORE = [
     ("Pod", "pods"), ("Service", "services"), ("ConfigMap", "configmaps"),
     ("Secret", "secrets"), ("ServiceAccount", "serviceaccounts"),
-    ("Event", "events"), ("Namespace", "namespaces"),
+    ("Event", "events"),
 ]
 
 _BUILTIN: list[ResourceInfo] = [
     *[ResourceInfo(k, "", "v1", p) for k, p in _CORE],
     ResourceInfo("Node", "", "v1", "nodes", namespaced=False),
+    # cluster-scoped: discovery must say namespaced=false (the route
+    # special-case in wire.route_path already treats it that way)
+    ResourceInfo("Namespace", "", "v1", "namespaces", namespaced=False),
     ResourceInfo("StatefulSet", "apps", "v1", "statefulsets"),
     ResourceInfo("Deployment", "apps", "v1", "deployments"),
     ResourceInfo("NetworkPolicy", "networking.k8s.io", "v1", "networkpolicies"),

@@ -335,8 +335,14 @@ class _WireHandler(BaseHTTPRequestHandler):
         infos = self.scheme.served()
         if self.converter is None:
             # without a conversion webhook, alias versions 404 on the data
-            # path — discovery must not advertise what can't be served
-            infos = [i for i in infos if (i.group, i.version) in storage]
+            # path — discovery must not advertise what can't be served.
+            # Per KIND: another kind's storage version in the same group
+            # does not make this kind's alias servable
+            def is_storage(i) -> bool:
+                s = self.scheme.by_kind(i.kind)
+                return (s.group, s.version) == (i.group, i.version)
+
+            infos = [i for i in infos if is_storage(i)]
         groups: dict[str, set[str]] = {}
         for i in infos:
             if i.group:

@@ -248,6 +248,45 @@ class TestApplySemantics:
                         field_manager="alice")
         assert out.metadata.uid, "recreated through the upsert path"
 
+    def test_identical_reapply_is_a_noop(self):
+        """A GitOps loop re-applies the same config on a timer; identical
+        applies must not bump resourceVersion (or wake watchers)."""
+        api = ApiServer()
+        first = api.apply("Notebook", "default", "wb", applied_nb(),
+                          field_manager="gitops")
+        events = []
+        api.subscribe(lambda ev: events.append(ev),
+                      since_rv=first.metadata.resource_version)
+        again = api.apply("Notebook", "default", "wb", applied_nb(),
+                          field_manager="gitops")
+        assert again.metadata.resource_version == \
+            first.metadata.resource_version
+        assert events == []
+
+    def test_alternating_managers_reapply_is_a_noop(self):
+        """Entry ORDER must stay stable across applies — two managers
+        alternating identical re-applies must settle, not flip the
+        managedFields list and bump the RV forever."""
+        api = ApiServer()
+        bob_cfg = {
+            "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+            "metadata": {"name": "wb", "namespace": "default",
+                         "labels": {"team": "ml"}},
+        }
+        api.apply("Notebook", "default", "wb", applied_nb(),
+                  field_manager="alice")
+        api.apply("Notebook", "default", "wb", bob_cfg, field_manager="bob")
+        settled = api.get("Notebook", "default", "wb")
+        rvs = []
+        for _ in range(3):
+            rvs.append(api.apply("Notebook", "default", "wb", applied_nb(),
+                                 field_manager="alice")
+                       .metadata.resource_version)
+            rvs.append(api.apply("Notebook", "default", "wb", bob_cfg,
+                                 field_manager="bob")
+                       .metadata.resource_version)
+        assert set(rvs) == {settled.metadata.resource_version}, rvs
+
     def test_reapply_of_read_object_is_clean(self):
         """Read-modify-apply: server-populated metadata in the sent body
         (uid, resourceVersion, managedFields) must not be applied."""

@@ -772,6 +772,8 @@ class TestDiscovery:
         by_name = {r["name"]: r for r in doc["resources"]}
         assert by_name["configmaps"]["namespaced"] is True
         assert by_name["nodes"]["namespaced"] is False
+        assert by_name["namespaces"]["namespaced"] is False, \
+            "a RESTMapper building paths from discovery needs this right"
         assert "deletecollection" in by_name["configmaps"]["verbs"]
 
     def test_no_converter_advertises_storage_only(self, wire):

@@ -184,6 +184,44 @@ class TestDirectives:
                                          "env": [{"name": "B", "value": "2"}]}]}
 
 
+class TestInvariants:
+    """Property-style invariants over generated pod-spec-shaped objects."""
+
+    def _objects(self):
+        # deterministic generator: nested maps, keyed + atomic lists
+        for seed in range(8):
+            n = seed % 3 + 1
+            yield {
+                "metadata": {"labels": {f"l{i}": str(i) for i in range(n)}},
+                "spec": {
+                    "replicas": seed,
+                    "args": [f"--{i}" for i in range(n)],
+                    "template": {"spec": {"containers": [
+                        {"name": f"c{i}", "image": f"img:{seed}",
+                         "env": [{"name": f"E{j}", "value": str(j)}
+                                 for j in range(i + 1)]}
+                        for i in range(n)]}},
+                },
+            }
+
+    def test_empty_patch_is_identity(self):
+        for obj in self._objects():
+            assert strategic_merge(obj, {}) == obj
+
+    def test_self_merge_is_identity(self):
+        # merging an object into itself changes nothing: keyed lists merge
+        # item-by-item, atomic lists replace with equal content
+        for obj in self._objects():
+            assert strategic_merge(obj, obj) == obj
+
+    def test_merge_is_idempotent(self):
+        patch = {"spec": {"template": {"spec": {"containers": [
+            {"name": "c0", "image": "patched"}]}}}}
+        for obj in self._objects():
+            once = strategic_merge(obj, patch)
+            assert strategic_merge(once, patch) == once
+
+
 class TestOverTheWire:
     @pytest.fixture()
     def wire(self):
