@@ -471,6 +471,22 @@ class TestAdmissionOverHttps:
         with pytest.raises(ForbiddenError, match="mlflow"):
             client.update(cur)
 
+    def test_tpu_image_swap_via_https(self, admission_stack):
+        """The TPU image swap — a spec.template mutation, not just an
+        annotation — must survive the AdmissionReview JSONPatch round
+        trip through the HTTPS callout."""
+        from kubeflow_tpu.api.types import TPUSpec
+
+        client, _ = admission_stack
+        nb = Notebook.new(
+            "tpu-wb", "default", tpu=TPUSpec("v5e", "2x2"),
+            pod_spec={"containers": [
+                {"name": "tpu-wb", "image": "cuda-notebook:1"}]}).obj
+        created = client.create(nb)
+        (c,) = created.body["spec"]["template"]["spec"]["containers"]
+        assert c["image"] == "jupyter-tpu-jax:latest", \
+            "CUDA image swapped for the JAX/libtpu image over the wire"
+
     def test_webhook_readyz(self, admission_stack):
         _, whsrv = admission_stack
         import ssl
