@@ -49,6 +49,24 @@ def env():
     return make_env()
 
 
+def make_cm(api, name, key, value, ns="user1"):
+    """Source-ConfigMap helper shared by the CA-bundle/runtime-image
+    scenario classes."""
+    api.create(KubeObject(
+        api_version="v1", kind="ConfigMap",
+        metadata=ObjectMeta(name=name, namespace=ns),
+        body={"data": {key: value}}))
+
+
+def fake_cert(tag: bytes) -> str:
+    """A structurally-valid PEM whose DER payload embeds `tag`, so merged
+    bundles can be checked for WHICH source contributed."""
+    der = b"\x30\x82\x01\x0a" + tag + b"\x00" * (32 - len(tag))
+    return ("-----BEGIN CERTIFICATE-----\n"
+            + base64.b64encode(der).decode()
+            + "\n-----END CERTIFICATE-----")
+
+
 def create_nb(api, mgr, name="wb", ns="user1", annotations=None, labels=None,
               tpu=None, pod_spec=None):
     nb = Notebook.new(name, ns, tpu=tpu, pod_spec=pod_spec,
@@ -451,6 +469,103 @@ class TestMLflow:
         nb = api.get("Notebook", "user1", "wb")
         del nb.metadata.annotations[C.ANNOTATION_MLFLOW_INSTANCE]
         api.update(nb)  # no raise
+
+
+class TestClusterProxyEnv:
+    """HTTP(S)_PROXY/NO_PROXY injection from the cluster Proxy CR under
+    INJECT_CLUSTER_PROXY_ENV (notebook_mutating_webhook.go:648-698)."""
+
+    @pytest.fixture()
+    def proxy_env(self):
+        return make_env(inject_cluster_proxy_env=True)
+
+    def _proxy_cr(self, api, http="http://proxy:3128",
+                  https="https://proxy:3129", no="cluster.local"):
+        api.create(KubeObject(
+            api_version="config.openshift.io/v1", kind="Proxy",
+            metadata=ObjectMeta(name="cluster"),
+            body={"status": {"httpProxy": http, "httpsProxy": https,
+                             "noProxy": no}}))
+
+    def test_env_injected_from_proxy_status(self, proxy_env):
+        api, _, mgr, _ = proxy_env
+        self._proxy_cr(api)
+        live = create_nb(api, mgr)
+        env = {e["name"]: e["value"]
+               for e in Notebook(live).pod_spec["containers"][0]["env"]}
+        assert env["HTTP_PROXY"] == "http://proxy:3128"
+        assert env["HTTPS_PROXY"] == "https://proxy:3129"
+        assert env["NO_PROXY"] == "cluster.local"
+
+    def test_user_value_overwritten_empty_skipped(self, proxy_env):
+        api, _, mgr, _ = proxy_env
+        self._proxy_cr(api, https="", no="")
+        live = create_nb(api, mgr, pod_spec={"containers": [{
+            "name": "wb",
+            "env": [{"name": "HTTP_PROXY", "value": "http://stale:1"}]}]})
+        env_list = Notebook(live).pod_spec["containers"][0]["env"]
+        # the stale entry is updated IN PLACE — assert on the whole list so
+        # an append-instead-of-overwrite regression (duplicate env var)
+        # cannot hide behind a last-wins dict collapse
+        assert env_list == [
+            {"name": "HTTP_PROXY", "value": "http://proxy:3128"},
+        ], env_list
+
+    def test_no_proxy_cr_is_noop(self, proxy_env):
+        api, _, mgr, _ = proxy_env
+        live = create_nb(api, mgr)
+        env = {e["name"] for e in
+               Notebook(live).pod_spec["containers"][0].get("env", [])}
+        assert not ({"HTTP_PROXY", "HTTPS_PROXY", "NO_PROXY"} & env)
+
+    def test_disabled_by_default(self, env):
+        api, _, mgr, _ = env
+        self._proxy_cr(api)
+        live = create_nb(api, mgr)
+        names = {e["name"] for e in
+                 Notebook(live).pod_spec["containers"][0].get("env", [])}
+        assert "HTTP_PROXY" not in names
+
+
+class TestCABundleSources:
+    """The workbench bundle merges THREE namespace ConfigMaps
+    (notebook_controller.go:549-635): odh-trusted-ca-bundle (gate),
+    kube-root-ca.crt, openshift-service-ca.crt."""
+
+    def test_three_sources_each_contribute_once(self, env):
+        api, _, mgr, _ = env
+        odh, root, svc = (fake_cert(b"odh"), fake_cert(b"root"),
+                          fake_cert(b"svc"))
+        make_cm(api, C.ODH_TRUSTED_CA_BUNDLE_CONFIGMAP,
+                C.TRUSTED_CA_BUNDLE_FILE, odh)
+        make_cm(api, C.KUBE_ROOT_CA_CONFIGMAP, "ca.crt", root)
+        make_cm(api, C.OPENSHIFT_SERVICE_CA_CONFIGMAP, "service-ca.crt", svc)
+        create_nb(api, mgr)
+        bundle = api.get("ConfigMap", "user1",
+                         C.WORKBENCH_TRUSTED_CA_BUNDLE_CONFIGMAP)
+        merged = bundle.body["data"][C.TRUSTED_CA_BUNDLE_FILE]
+        for cert in (odh, root, svc):
+            assert merged.count(cert) == 1, "each source exactly once"
+
+    def test_absent_odh_bundle_gates_everything(self, env):
+        # without odh-trusted-ca-bundle, cert injection is someone else's
+        # job — the other two sources alone must NOT produce a bundle
+        api, _, mgr, _ = env
+        make_cm(api, C.KUBE_ROOT_CA_CONFIGMAP, "ca.crt", FAKE_CERT)
+        make_cm(api, C.OPENSHIFT_SERVICE_CA_CONFIGMAP, "service-ca.crt",
+                FAKE_CERT)
+        create_nb(api, mgr)
+        assert api.try_get("ConfigMap", "user1",
+                           C.WORKBENCH_TRUSTED_CA_BUNDLE_CONFIGMAP) is None
+
+    def test_empty_odh_key_means_injector_handles_it(self, env):
+        api, _, mgr, _ = env
+        make_cm(api, C.ODH_TRUSTED_CA_BUNDLE_CONFIGMAP,
+                C.TRUSTED_CA_BUNDLE_FILE, "")
+        make_cm(api, C.KUBE_ROOT_CA_CONFIGMAP, "ca.crt", FAKE_CERT)
+        create_nb(api, mgr)
+        assert api.try_get("ConfigMap", "user1",
+                           C.WORKBENCH_TRUSTED_CA_BUNDLE_CONFIGMAP) is None
 
 
 class TestFirstDifference:
