@@ -139,6 +139,16 @@ class OpenshiftNotebookReconciler:
         want = [C.HTTPROUTE_FINALIZER, C.REFERENCEGRANT_FINALIZER]
         if self._auth_enabled(nb):
             want.append(C.KUBE_RBAC_PROXY_FINALIZER)
+        if C.OAUTH_CLIENT_FINALIZER not in nb.metadata.finalizers \
+                and self.api.try_get(
+                    "OAuthClient", "",
+                    oauth.oauth_client_name(nb)) is not None:
+            # a legacy RHOAI 2.x client exists for this notebook: gate its
+            # deletion-time cleanup (without this the _handle_deletion
+            # branch at OAUTH_CLIENT_FINALIZER is unreachable).  The
+            # already-present check keeps the cluster-scoped lookup off
+            # the steady-state reconcile path
+            want.append(C.OAUTH_CLIENT_FINALIZER)
         missing = [f for f in want if f not in nb.metadata.finalizers]
         if not missing:
             return False

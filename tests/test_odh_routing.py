@@ -146,6 +146,40 @@ class TestReferenceGrant:
         assert api.try_get("ReferenceGrant", "user1", C.REFERENCEGRANT_NAME) is None
 
 
+class TestOAuthClientCleanup:
+    """Legacy RHOAI 2.x OAuthClient removal on notebook deletion
+    (notebook_oauth.go:67-96)."""
+
+    def test_matching_client_deleted_with_notebook(self, env):
+        from kubeflow_tpu.kube import KubeObject, ObjectMeta
+
+        api, _, mgr, _ = env
+        api.create(KubeObject(
+            api_version="oauth.openshift.io/v1", kind="OAuthClient",
+            metadata=ObjectMeta(name="wb-user1-oauth-client"),
+            body={"grantMethod": "auto"}))
+        # a DIFFERENT notebook's client must survive
+        api.create(KubeObject(
+            api_version="oauth.openshift.io/v1", kind="OAuthClient",
+            metadata=ObjectMeta(name="other-user1-oauth-client"),
+            body={"grantMethod": "auto"}))
+        create_nb(api, mgr)
+        api.delete("Notebook", "user1", "wb")
+        mgr.run_until_idle()
+        assert api.try_get("Notebook", "user1", "wb") is None
+        assert api.try_get("OAuthClient", "", "wb-user1-oauth-client") \
+            is None, "legacy client cleaned by the deletion finalizer"
+        assert api.try_get("OAuthClient", "", "other-user1-oauth-client") \
+            is not None
+
+    def test_deletion_without_client_succeeds(self, env):
+        api, _, mgr, _ = env
+        create_nb(api, mgr)
+        api.delete("Notebook", "user1", "wb")
+        mgr.run_until_idle()
+        assert api.try_get("Notebook", "user1", "wb") is None
+
+
 class TestNetworkPolicies:
     def test_notebook_and_proxy_policies(self, env):
         api, _, mgr, _ = env

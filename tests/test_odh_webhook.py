@@ -25,12 +25,17 @@ from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
 
 CENTRAL_NS = "opendatahub"
 
-# minimal structurally-valid PEM: base64 DER starting with a SEQUENCE tag
-FAKE_CERT = (
-    "-----BEGIN CERTIFICATE-----\n"
-    + base64.b64encode(b"\x30\x82\x01\x0a" + b"\x00" * 32).decode()
-    + "\n-----END CERTIFICATE-----"
-)
+def fake_cert(tag: bytes = b"") -> str:
+    """Minimal structurally-valid PEM (base64 DER starting with a SEQUENCE
+    tag); `tag` is embedded in the payload so merged bundles can be
+    checked for WHICH source contributed."""
+    der = b"\x30\x82\x01\x0a" + tag + b"\x00" * (32 - len(tag))
+    return ("-----BEGIN CERTIFICATE-----\n"
+            + base64.b64encode(der).decode()
+            + "\n-----END CERTIFICATE-----")
+
+
+FAKE_CERT = fake_cert()
 
 
 def make_env(**cfg_kwargs):
@@ -56,15 +61,6 @@ def make_cm(api, name, key, value, ns="user1"):
         api_version="v1", kind="ConfigMap",
         metadata=ObjectMeta(name=name, namespace=ns),
         body={"data": {key: value}}))
-
-
-def fake_cert(tag: bytes) -> str:
-    """A structurally-valid PEM whose DER payload embeds `tag`, so merged
-    bundles can be checked for WHICH source contributed."""
-    der = b"\x30\x82\x01\x0a" + tag + b"\x00" * (32 - len(tag))
-    return ("-----BEGIN CERTIFICATE-----\n"
-            + base64.b64encode(der).decode()
-            + "\n-----END CERTIFICATE-----")
 
 
 def create_nb(api, mgr, name="wb", ns="user1", annotations=None, labels=None,
