@@ -73,6 +73,65 @@ class TestLeaderElection:
         finally:
             srv.stop()
 
+    def test_renew_deadline_abdicates_before_takeover_is_possible(self):
+        """client-go semantics (RenewDeadline < LeaseDuration): when the
+        apiserver becomes unreachable, the leader must stop leading at the
+        renew deadline — STRICTLY BEFORE the lease expires — so there is
+        never a moment with two writers."""
+        from kubeflow_tpu.kube.errors import ServerError
+
+        class FlakyApi:
+            """Delegates to the real store until `fail` is set."""
+
+            def __init__(self, api):
+                self._api = api
+                self.fail = False
+
+            def __getattr__(self, name):
+                target = getattr(self._api, name)
+                if not callable(target):
+                    return target
+
+                def guarded(*a, **kw):
+                    if self.fail:
+                        raise ServerError("apiserver unreachable")
+                    return target(*a, **kw)
+
+                return guarded
+
+        api = ApiServer()
+        flaky = FlakyApi(api)
+        started, stopped = [], []
+        # lease_duration far above the renew deadline: the rival check
+        # below stays deterministic even if CI deschedules this process
+        # for tens of seconds
+        elector = LeaderElector(
+            flaky, "test-mgr", "system", "mgr-a",
+            lease_duration_s=30.0, renew_period_s=0.05, retry_period_s=0.05,
+            renew_deadline_s=0.4)
+        elector.start_background(lambda: started.append(1),
+                                 lambda: stopped.append(1))
+        try:
+            deadline = time.time() + 5
+            while not started and time.time() < deadline:
+                time.sleep(0.01)
+            assert started
+            flaky.fail = True
+            deadline = time.time() + 5
+            while not stopped and time.time() < deadline:
+                time.sleep(0.01)
+            assert stopped, "unreachable apiserver must trigger abdication"
+            # the moment the old leader stopped, the lease (last successful
+            # renew seconds ago, duration 30s) is still FRESH: no rival can
+            # acquire yet — the single-writer window never overlapped
+            rival = LeaderElector(api, "test-mgr", "system", "mgr-b",
+                                  lease_duration_s=30.0, renew_period_s=0.05,
+                                  retry_period_s=0.05)
+            assert not rival.try_acquire_or_renew(), \
+                "abdication happened while the lease was still unexpired"
+        finally:
+            elector.stop()
+
     def test_background_run_invokes_callbacks(self):
         api = ApiServer()
         started, stopped = [], []

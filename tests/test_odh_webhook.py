@@ -739,6 +739,41 @@ class TestElyraSecret:
         assert payload["metadata"]["public_api_endpoint"] == \
             "https://gw.apps.example.com/external/elyra/user1"
 
+    def test_route_fallback_by_owner_uid(self, elyra_env):
+        """The Route fallback also accepts routes OWNED by the gateway
+        (ownerReference uid match), not just labeled ones
+        (notebook_dspa_secret.go:152-186)."""
+        from kubeflow_tpu.odh.gateway import get_hostname_for_public_endpoint
+
+        api, _, _, cfg = elyra_env
+        gw = api.create(KubeObject(
+            api_version="gateway.networking.k8s.io/v1", kind="Gateway",
+            metadata=ObjectMeta(name=cfg.gateway_name,
+                                namespace=cfg.gateway_namespace),
+            body={"spec": {"listeners": [{"name": "https"}]}}))
+        # decoy FIRST in list order: owned by some OTHER object — a uid
+        # mismatch must be skipped, not treated as "has an owner"
+        stranger = api.create(KubeObject(
+            api_version="v1", kind="ConfigMap",
+            metadata=ObjectMeta(name="stranger",
+                                namespace=cfg.gateway_namespace)))
+        decoy = KubeObject(
+            api_version="route.openshift.io/v1", kind="Route",
+            metadata=ObjectMeta(name="a-decoy",
+                                namespace=cfg.gateway_namespace),
+            body={"spec": {"host": "decoy.apps.example.com"}})
+        decoy.metadata.owner_references.append(stranger.owner_reference())
+        api.create(decoy)
+        owned = KubeObject(
+            api_version="route.openshift.io/v1", kind="Route",
+            metadata=ObjectMeta(name="gw-owned",
+                                namespace=cfg.gateway_namespace),
+            body={"spec": {"host": "owned.apps.example.com"}})
+        owned.metadata.owner_references.append(gw.owner_reference())
+        api.create(owned)
+        assert get_hostname_for_public_endpoint(api, cfg) == \
+            "owned.apps.example.com"
+
     def test_secret_updates_when_dspa_changes(self, elyra_env):
         import json as _json
 
