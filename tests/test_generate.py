@@ -52,6 +52,27 @@ class TestGenerate:
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
+    def test_accepts_stacked_training_params(self):
+        """Train-then-serve: params from a scan_layers=True TRAINING run
+        (stacked 'layers' subtree) must decode identically to the unrolled
+        decode layout — generate converts the tree on the fly."""
+        cfg = TINY  # scan_layers=True: the training layout
+        train_model = Transformer(cfg)
+        stacked = train_model.init(jax.random.PRNGKey(0),
+                                   jnp.ones((1, 8), jnp.int32))["params"]
+        assert "layers" in stacked  # really the stacked layout
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                    cfg.vocab_size)
+        out = generate(cfg, stacked, prompt, max_new_tokens=4)
+        assert out.shape == (2, 9)
+
+        # the same weights pre-unrolled give the same tokens
+        from kubeflow_tpu.models.generate import unroll_params
+
+        unrolled = unroll_params(stacked, cfg.num_layers)
+        out2 = generate(cfg, unrolled, prompt, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
     def test_single_new_token(self):
         cfg = TINY
         params = _init_params(cfg)
