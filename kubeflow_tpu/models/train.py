@@ -110,7 +110,11 @@ def chunked_cross_entropy(
         h_c, t_c = xs
         return carry + chunk_nll(h_c, t_c), None
 
-    total, _ = jax.lax.scan(body, jnp.float32(0.0), (h, t))
+    # inside a pipeline stage the accumulator joins a carry varying over
+    # the manual axis; match VMA types (shared helper with the engines)
+    from ..parallel.pipeline import match_vma
+
+    total, _ = jax.lax.scan(body, match_vma(jnp.float32(0.0), hidden), (h, t))
     return total / tokens
 
 
@@ -165,13 +169,111 @@ def make_pipeline_forward(model: nn.Module, mesh: Mesh,
     return forward
 
 
+def make_1f1b_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES,
+                         mesh: Optional[Mesh] = None,
+                         pipeline_microbatches: int = 0):
+    """Train step on the 1F1B pipeline engine (parallel.pipeline.
+    pipeline_1f1b): the engine owns the schedule AND the gradients, so
+    this step assembles the grad tree manually instead of differentiating
+    a forward — embed gradients come from an outer vjp fed the engine's
+    input cotangent, head/final-norm gradients from the engine's in-
+    schedule loss vjp, layer gradients stage-sharded from the engine."""
+    from ..parallel.pipeline import pipeline_1f1b
+    from .transformer import _REMAT_POLICIES, DecoderLayer
+
+    cfg = model.cfg
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True")
+    moe = cfg.moe_experts > 0
+    loss_chunks = cfg.loss_chunks or 1
+    microbatches = pipeline_microbatches or 2 * int(mesh.shape["pipeline"])
+    template = DecoderLayer(cfg, model.mesh)
+
+    def apply_one(layer_params, x_mb):
+        positions = jnp.broadcast_to(
+            jnp.arange(x_mb.shape[1]), x_mb.shape[:2])
+        with nn.logical_axis_rules(()):
+            return template.apply({"params": layer_params}, x_mb, positions)
+
+    def head_loss(hp, y_mb, t_mb):
+        # final norm (model.head with return_hidden) + chunked CE against
+        # the LM head kernel — the per-microbatch mean loss whose vjp is
+        # what enters the backward ring on the last stage
+        with nn.logical_axis_rules(()):
+            hidden = model.apply({"params": hp}, y_mb, True, method="head")
+            if cfg.tie_embeddings:
+                kernel = nn.unbox(hp["embed"]["embedding"]).T
+            else:
+                kernel = nn.unbox(hp["lm_head"]["kernel"])
+            return chunked_cross_entropy(hidden, t_mb, kernel, loss_chunks,
+                                         cfg.logits_softcap)
+
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        params = state.params
+        head_keys = ["final_norm"] + (
+            ["embed"] if cfg.tie_embeddings else ["lm_head"])
+        hp = {k: params[k] for k in head_keys}
+
+        with nn.logical_axis_rules(list(rules)):
+            x, embed_vjp = jax.vjp(
+                lambda ep: model.apply({"params": {"embed": ep}},
+                                       batch["inputs"],
+                                       method="embed_tokens"),
+                params["embed"])
+            loss, aux, dlayers, dhead, dx = pipeline_1f1b(
+                apply_one, nn.unbox(params["layers"]), head_loss, hp,
+                x, batch["targets"], mesh, microbatches,
+                remat_layer=cfg.remat,
+                remat_policy=_REMAT_POLICIES[cfg.remat_policy](),
+                layer_has_aux=moe, aux_weight=cfg.moe_aux_weight)
+            (dembed,) = embed_vjp(dx)
+
+        # rebox the raw layer grads with the stacked tree's partitioning
+        # metadata so the grad tree mirrors the (boxed) param tree
+        def rebox(box, g):
+            if isinstance(box, nn.Partitioned):
+                return box.replace_boxed(g)
+            return g
+
+        grads = {
+            "embed": dembed,
+            "layers": jax.tree.map(
+                rebox, params["layers"], dlayers,
+                is_leaf=lambda b: isinstance(b, nn.Partitioned)),
+            **{k: dhead[k] for k in head_keys if k not in ("embed",)},
+        }
+        if cfg.tie_embeddings:
+            # the embedding gets cotangents from both uses (lookup + head)
+            grads["embed"] = jax.tree.map(
+                lambda a, b: a + b, grads["embed"], dhead["embed"])
+        new_state = state.apply_gradients(grads=grads)
+        total = loss + cfg.moe_aux_weight * aux if moe else loss
+        metrics = {
+            "loss": total,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step,
+        }
+        if moe:
+            metrics["ce_loss"] = loss
+            metrics["moe_aux_loss"] = aux
+        return new_state, metrics
+
+    return step
+
+
 def make_train_step(model: nn.Module, optimizer, rules=DEFAULT_RULES,
                     mesh: Optional[Mesh] = None,
-                    pipeline_microbatches: int = 0):
+                    pipeline_microbatches: int = 0,
+                    pipeline_schedule: str = "gpipe"):
     cfg = getattr(model, "cfg", None)
     loss_chunks = getattr(cfg, "loss_chunks", 0) or 0
     moe = getattr(cfg, "moe_experts", 0) > 0
     stages = int(mesh.shape.get("pipeline", 1)) if mesh is not None else 1
+    if stages > 1 and pipeline_schedule == "1f1b":
+        return make_1f1b_train_step(model, optimizer, rules, mesh,
+                                    pipeline_microbatches)
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     if stages > 1:
         microbatches = pipeline_microbatches or 2 * stages
         forward = make_pipeline_forward(model, mesh, microbatches)
@@ -229,13 +331,16 @@ def setup_training(
     rules=None,
     batch_shape: Optional[tuple[int, int]] = None,
     pipeline_microbatches: int = 0,
+    pipeline_schedule: str = "gpipe",
 ) -> TrainSetup:
     """Initialize a sharded TrainState on `mesh` and return a jitted train
     step with explicit in/out shardings (single compiled SPMD program; XLA
     inserts the psums/all-gathers the rules imply).  A populated "pipeline"
-    mesh axis switches the layer stack to the GPipe schedule
-    (parallel.pipeline) with `pipeline_microbatches` microbatches
-    (default 2x stages)."""
+    mesh axis runs the layer stack under `pipeline_schedule`:
+    "gpipe" (default — forward pipeline differentiated by outer AD) or
+    "1f1b" (parallel.pipeline.pipeline_1f1b — in-schedule backward,
+    activation stash capped at `stages` microbatches), with
+    `pipeline_microbatches` microbatches (default 2x stages)."""
     from ..parallel.sharding import rules_for_mesh
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -263,7 +368,8 @@ def setup_training(
         batch_sharding = logical_sharding(mesh, ("batch", None), rules)
         step = jax.jit(
             make_train_step(model, optimizer, rules, mesh=mesh,
-                            pipeline_microbatches=pipeline_microbatches),
+                            pipeline_microbatches=pipeline_microbatches,
+                            pipeline_schedule=pipeline_schedule),
             in_shardings=(state_shardings, {"inputs": batch_sharding,
                                             "targets": batch_sharding}),
             out_shardings=(state_shardings, None),

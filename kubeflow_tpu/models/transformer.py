@@ -68,9 +68,20 @@ class RMSNorm(nn.Module):
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding on [B, S, H, D]; fp32 trig, split-half convention."""
+    """Rotary embedding on [B, S, H, D]; fp32 trig, split-half convention.
+
+    The frequency table is a trace-time numpy constant, not a traced iota
+    chain: a traced rank-1 freq gets closure-captured as an operand of the
+    ring-attention manual computation when rope runs inside the pipeline's
+    shard_map, and sdy propagation assigns it an inconsistent sharding
+    (manual_computation verifier failure with check_vma=True).  A constant
+    inlines into each region instead.
+    """
+    import numpy as np
+
     half = x.shape[-1] // 2
-    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    freq = jnp.asarray(
+        theta ** (-np.arange(0, half, dtype=np.float32) / half))
     angle = positions[..., None].astype(jnp.float32) * freq  # [B, S, half]
     cos = jnp.cos(angle)[:, :, None, :]
     sin = jnp.sin(angle)[:, :, None, :]
@@ -173,7 +184,8 @@ class Attention(nn.Module):
         if use_ring:
             if self.mesh is None:
                 raise ValueError("ring attention requires a mesh")
-            out = ring_attention(q, k, v, self.mesh, causal=True)
+            out = ring_attention(q, k, v, self.mesh, causal=True,
+                                 positions=positions)
         else:
             impl = cfg.attention_impl if cfg.attention_impl != "ring" else "auto"
             out = attention(q, k, v, causal=True, impl=impl,

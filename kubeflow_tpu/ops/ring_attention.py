@@ -9,9 +9,19 @@ full attention without ever materializing [S, S] or gathering K/V.
 
 Compute/communication overlap is XLA's job: the ppermute for step i+1 is
 independent of step i's einsum, and latency hiding on TPU comes from the
-async collective scheduler.  Causality is enforced per-block with global
-position offsets; fully-masked blocks still traverse the ring (uniform
-control flow keeps the collective schedule identical on every shard).
+async collective scheduler.  Causality is enforced with GLOBAL POSITION
+VECTORS that ride the ring: each shard's kv-position block rotates with its
+k/v block, so the causal mask is a pure input-data comparison — no
+`axis_index` anywhere in the mask.  That keeps the mask chains
+input-dependent, which matters under composition: input-independent
+`axis_index` chains get hoisted out of the manual region as zero-operand
+manual computations, and when ring nests inside the pipeline engine's
+partially-manual shard_map, sdy propagation assigns those hoisted
+computations inconsistent shardings (MLIR verifier failure with
+check_vma=True on jax 0.9).  Position vectors as real operands also make
+packed/shifted sequences work unchanged.  Fully-masked blocks still
+traverse the ring (uniform control flow keeps the collective schedule
+identical on every shard).
 """
 
 from __future__ import annotations
@@ -29,36 +39,44 @@ def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    positions: Optional[jax.Array],
     axis_name: str,
     causal: bool,
     softmax_scale: Optional[float],
 ) -> jax.Array:
-    """Per-shard body (runs under shard_map).  q/k/v: [B, S_blk, H, D]."""
+    """Per-shard body (runs under shard_map).  q/k/v: [B, S_blk, H, D];
+    positions: [B, S_blk] global token positions of this shard's block
+    (required when causal)."""
     n = jax.lax.axis_size(axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
     batch, q_len, num_heads, head_dim = q.shape
-    kv_len = k.shape[1]
     scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
     k = _repeat_kv(k, num_heads)
     v = _repeat_kv(v, num_heads)
 
-    # the accumulators join a carry with device-varying k/v blocks; pvary
-    # marks the zero inits as varying over the same manual axes as q so the
-    # loop carry is VMA-consistent (check_vma=True catches the unreduced-
-    # cotangent bugs that silently broke nesting under the pipeline axis)
+    # the accumulators join a carry with device-varying k/v blocks; pcast
+    # to='varying' marks the zero inits as varying over the same manual axes
+    # as q so the loop carry is VMA-consistent (check_vma=True catches the
+    # unreduced-cotangent bugs that silently broke nesting under the
+    # pipeline axis)
     vma = tuple(jax.typeof(q).vma)
-    out = jax.lax.pvary(
-        jnp.zeros((batch, num_heads, q_len, head_dim), jnp.float32), vma)
-    row_max = jax.lax.pvary(
-        jnp.full((batch, num_heads, q_len), -jnp.inf, jnp.float32), vma)
-    row_sum = jax.lax.pvary(
-        jnp.zeros((batch, num_heads, q_len), jnp.float32), vma)
+    out = jax.lax.pcast(
+        jnp.zeros((batch, num_heads, q_len, head_dim), jnp.float32), vma,
+        to="varying")
+    row_max = jax.lax.pcast(
+        jnp.full((batch, num_heads, q_len), -jnp.inf, jnp.float32), vma,
+        to="varying")
+    row_sum = jax.lax.pcast(
+        jnp.zeros((batch, num_heads, q_len), jnp.float32), vma,
+        to="varying")
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    if causal and positions is None:
+        raise ValueError("causal ring attention requires positions")
+
     def step(i, carry):
-        out, row_max, row_sum, k_blk, v_blk = carry
-        # after i rotations we hold the block originally on shard my_idx - i
-        src = (my_idx - i) % n
+        # kv positions (causal only) rotate around the ring WITH their k/v
+        # block, so the mask is a pure input-data comparison
+        out, row_max, row_sum, k_blk, v_blk, *kv_pos = carry
         scores = (
             jnp.einsum(
                 "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
@@ -66,12 +84,10 @@ def _ring_attention_local(
             * scale
         )
         if causal:
-            q_pos = my_idx * q_len + jnp.arange(q_len)
-            kv_pos = src * kv_len + jnp.arange(kv_len)
             bias = jnp.where(
-                q_pos[:, None] >= kv_pos[None, :], 0.0, -jnp.inf
+                positions[:, :, None] >= kv_pos[0][:, None, :], 0.0, -jnp.inf
             ).astype(jnp.float32)
-            scores = scores + bias
+            scores = scores + bias[:, None, :, :]
         blk_max = jnp.max(scores, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         # fully-masked rows keep -inf max; exp(-inf - -inf) guards below
@@ -89,13 +105,28 @@ def _ring_attention_local(
         row_max = new_max
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return out, row_max, row_sum, k_blk, v_blk
+        kv_pos = [jax.lax.ppermute(p, axis_name, perm) for p in kv_pos]
+        return (out, row_max, row_sum, k_blk, v_blk, *kv_pos)
 
-    out, row_max, row_sum, _, _ = jax.lax.fori_loop(
-        0, n, step, (out, row_max, row_sum, k, v)
-    )
+    init = (out, row_max, row_sum, k, v) + (
+        (positions,) if causal else ())
+    out, row_max, row_sum, *_ = jax.lax.fori_loop(0, n, step, init)
     out = out / jnp.maximum(row_sum, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _shard_mapped(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map against the CONTEXT mesh when already inside a
+    (partially-)manual shard_map — the pipeline engine's stage body —
+    so the same axes compose; the concrete mesh otherwise."""
+    context = jax.sharding.get_abstract_mesh()
+    return jax.shard_map(
+        fn,
+        mesh=mesh if context.empty else context,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=True,
+    )
 
 
 def ring_attention(
@@ -108,22 +139,57 @@ def ring_attention(
     softmax_scale: Optional[float] = None,
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel exact attention.  Inputs [B, S, H, D] with S
     sharded over `axis_name`; composes with batch sharding over
-    `batch_axes` and head (tensor) sharding over `head_axis`."""
+    `batch_axes` and head (tensor) sharding over `head_axis`.  positions
+    [B, S] are the global token positions (default arange) — they enter the
+    shard_map as data and their kv copy rotates with the k/v blocks.
+
+    Differentiation is a custom VJP whose backward runs `jax.vjp` of the
+    per-shard body INSIDE a fresh shard_map region (one forward recompute
+    per backward — the framework's full-remat default does that anyway).
+    Letting JAX transpose through the shard_map instead breaks when ring
+    nests inside the pipeline engine's partially-manual region: the
+    transpose machinery closure-captures residuals across the nested
+    manual_computation boundary and sdy propagation assigns them
+    inconsistent shardings (an MLIR verifier failure with check_vma=True
+    on jax 0.9).  With the VJP self-contained, both directions are single
+    manual regions and check_vma=True holds everywhere.
+    """
     spec = P(batch_axes, axis_name, head_axis, None)
-    # when already inside a (partially-)manual shard_map — the pipeline
-    # engine's stage body — the nested shard_map must be built against the
-    # CONTEXT mesh (same axes, some already manual), not the concrete one
-    context = jax.sharding.get_abstract_mesh()
-    local = jax.shard_map(
-        lambda q_, k_, v_: _ring_attention_local(
-            q_, k_, v_, axis_name, causal, softmax_scale
-        ),
-        mesh=mesh if context.empty else context,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=True,
-    )
-    return local(q, k, v)
+    pos_spec = P(batch_axes, axis_name)
+    if causal and positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(q.shape[1], dtype=jnp.int32), q.shape[:2])
+    if positions is None:
+        positions = jnp.zeros(q.shape[:2], jnp.int32)
+
+    def local_fwd(q_, k_, v_, pos_):
+        return _ring_attention_local(q_, k_, v_, pos_, axis_name, causal,
+                                     softmax_scale)
+
+    @jax.custom_vjp
+    def ring(q, k, v, pos):
+        return _shard_mapped(
+            local_fwd, mesh, (spec,) * 3 + (pos_spec,), spec)(q, k, v, pos)
+
+    def ring_fwd(q, k, v, pos):
+        return ring(q, k, v, pos), (q, k, v, pos)
+
+    def ring_bwd(res, dout):
+        q, k, v, pos = res
+
+        def local_bwd(q_, k_, v_, pos_, d_):
+            _, vjp = jax.vjp(
+                lambda a, b, c: local_fwd(a, b, c, pos_), q_, k_, v_)
+            return vjp(d_)
+
+        dq, dk, dv = _shard_mapped(
+            local_bwd, mesh, (spec,) * 3 + (pos_spec, spec),
+            (spec,) * 3)(q, k, v, pos, dout)
+        return dq, dk, dv, None
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring(q, k, v, positions)

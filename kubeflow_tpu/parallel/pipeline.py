@@ -38,6 +38,30 @@ def num_stages(mesh: Mesh, axis_name: str = PIPELINE_AXIS) -> int:
     return int(mesh.shape.get(axis_name, 1))
 
 
+def match_vma(value, ref):
+    """Give `value` the same varying-manual-axes (VMA) type as `ref` so the
+    two can share a loop carry inside a shard_map region with
+    check_vma=True; a no-op outside manual regions."""
+    vma = tuple(getattr(jax.typeof(ref), "vma", ()))
+    return jax.lax.pcast(value, vma, to="varying") if vma else value
+
+
+def _scan_layers(layer_fn, params, x_in, layer_has_aux: bool):
+    """Scan `layer_fn` over stacked layer params, accumulating the
+    per-layer aux into the carry — shared by gpipe's single-stage fallback,
+    each gpipe stage, and the 1F1B stage body."""
+    def body(carry, layer_params):
+        x, aux = carry
+        if layer_has_aux:
+            x, layer_aux = layer_fn(layer_params, x)
+            return (x, aux + layer_aux), None
+        return (layer_fn(layer_params, x), aux), None
+
+    aux0 = match_vma(jnp.float32(0.0), x_in)
+    (out, aux), _ = jax.lax.scan(body, (x_in, aux0), params)
+    return out, aux
+
+
 def gpipe(
     apply_layer: Callable[[Any, jax.Array], jax.Array],
     stacked_params: Any,
@@ -73,28 +97,9 @@ def gpipe(
     sharding group — pick num_microbatches accordingly (e.g.
     B // (data*fsdp)).
     """
-    def scan_layers(layer_fn, params, x_in):
-        """Scan `layer_fn` over stacked layer params, accumulating the
-        per-layer aux into the carry (shared by the single-stage fallback
-        and each pipeline stage)."""
-        def body(carry, layer_params):
-            x, aux = carry
-            if layer_has_aux:
-                x, layer_aux = layer_fn(layer_params, x)
-                return (x, aux + layer_aux), None
-            return (layer_fn(layer_params, x), aux), None
-        aux0 = jnp.float32(0.0)
-        # inside a pipeline stage the aux joins a carry varying over the
-        # manual axis; match VMA types (see the pvary note below)
-        vma = tuple(getattr(jax.typeof(x_in), "vma", ()))
-        if vma:
-            aux0 = jax.lax.pvary(aux0, vma)
-        (out, aux), _ = jax.lax.scan(body, (x_in, aux0), params)
-        return out, aux
-
     stages = num_stages(mesh, axis_name)
     if stages <= 1:
-        out, aux = scan_layers(apply_layer, stacked_params, x)
+        out, aux = _scan_layers(apply_layer, stacked_params, x, layer_has_aux)
         return (out, aux) if layer_has_aux else out
 
     layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -119,15 +124,19 @@ def gpipe(
         perm = [(i, (i + 1) % stages) for i in range(stages)]
 
         def apply_stage(x_in):
-            return scan_layers(one_layer, stage_params, x_in)
+            return _scan_layers(one_layer, stage_params, x_in, layer_has_aux)
 
-        # pvary: the zero inits join a carry whose other leg (y, rotated
-        # activations) varies over the pipeline axis — consistent VMA types
-        # let check_vma=True verify the collective placement statically
-        # (the safeguard that caught the ring-under-pipeline gradient bug)
-        buf = jax.lax.pvary(jnp.zeros_like(x_all[0]), (axis_name,))
-        out = jax.lax.pvary(jnp.zeros_like(x_all), (axis_name,))
-        aux_acc = jax.lax.pvary(jnp.float32(0.0), (axis_name,))
+        # pcast to='varying': the zero inits join a carry whose other leg
+        # (y, rotated activations) varies over the pipeline axis —
+        # consistent VMA types let check_vma=True verify the collective
+        # placement statically (the safeguard that caught the
+        # ring-under-pipeline gradient bug)
+        buf = jax.lax.pcast(jnp.zeros_like(x_all[0]), (axis_name,),
+                            to="varying")
+        out = jax.lax.pcast(jnp.zeros_like(x_all), (axis_name,),
+                            to="varying")
+        aux_acc = jax.lax.pcast(jnp.float32(0.0), (axis_name,),
+                                to="varying")
 
         def tick(carry, t):
             buf, out, aux_acc = carry
@@ -161,17 +170,199 @@ def gpipe(
         in_specs=(P(axis_name), P()),
         out_specs=(P(), P()),
         axis_names={axis_name},
-        # check_vma=True on THIS outer shard_map trips an sdy
-        # manual_computation lowering error when ring attention's (vma-
-        # checked) shard_map nests inside; the engine's collective
-        # placement is instead pinned dynamically by the SGD parameter-
-        # update allclose gates (tests/test_pipeline.py, dryrun_multichip),
-        # which hold to ~1e-7 across device counts
-        check_vma=False,
+        # the static VMA check holds for the pipeline engine itself; it must
+        # stay off only when ring attention's shard_map NESTS inside the
+        # stage body (mesh sequence axis > 1): jax 0.9's sdy export then
+        # hoists/splits the nested region and propagates inconsistent
+        # shardings onto the pieces (MLIR manual_computation verifier
+        # failure regardless of user-code structure).  The gradient-bug
+        # class check_vma guarded there is closed a different way: ring
+        # attention's VJP is self-contained (custom_vjp, both directions
+        # their own check_vma=True regions), so JAX never transposes
+        # through the nested shard_map, and the parameter-update allclose
+        # gates (tests/test_pipeline.py, dryrun_multichip) pin the
+        # numerics dynamically.
+        check_vma=int(mesh.shape.get("sequence", 1)) <= 1,
     )
     out, aux = run(stacked_params, x.reshape(m_shape))
     out = out.reshape(x.shape)
     return (out, aux) if layer_has_aux else out
 
 
-__all__ = ["gpipe", "num_stages", "PIPELINE_AXIS"]
+def pipeline_1f1b(
+    apply_layer: Callable[[Any, jax.Array], Any],
+    stacked_params: Any,
+    head_loss: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    head_params: Any,
+    x: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = PIPELINE_AXIS,
+    remat_layer: bool = False,
+    remat_policy=None,
+    layer_has_aux: bool = False,
+    aux_weight: float = 0.0,
+):
+    """1F1B pipeline TRAINING engine: returns (loss, aux, dstacked, dhead, dx).
+
+    Unlike `gpipe` (a forward pass differentiated by outer AD, which keeps
+    every tick's activations live through the backward), this engine owns
+    the whole schedule and computes gradients itself, so backward work for
+    microbatch m starts as soon as its forward leaves the last stage — the
+    activation stash is capped at `stages` microbatch inputs per stage
+    instead of all `M` ticks.  That requires the per-microbatch loss INSIDE
+    the schedule: `head_loss(head_params, y_mb, targets_mb)` must map the
+    last stage's output microbatch to its MEAN loss (final norm + LM head +
+    CE in the decoder case); its gradient is what enters the backward ring.
+
+    Schedule (non-interleaved 1F1B / PipeDream-flush): with S stages and M
+    microbatches, stage s runs the forward of microbatch m at tick
+    `s + 2m` and its backward at tick `2S-1-s + 2m`.  The two tick sets
+    have opposite parities, so every stage does exactly one op per tick —
+    one `jax.vjp` whose forward recompute doubles as the F op (the vjp
+    runs on every stage every tick; masks select which result is real:
+    SPMD uniform control flow, same as gpipe's bubbles).  Total ticks:
+    2(M + S - 1).  Cotangents ride the reverse ring one stage per tick.
+
+    FLOPs trade vs gpipe: ~4/3x (each tick pays forward + transpose, and
+    there are 2(M+S-1) ticks vs gpipe's 3 fwd-equivalents over M+S-1) —
+    bought memory: stash is min(S, M)/(M+S-1) of gpipe's live set, which
+    is what makes pp usable at the 7B/v5p scale BASELINE.md names.
+
+    Gradient outputs: dstacked matches stacked_params (stage-sharded),
+    dhead matches head_params (nonzero contributions only from the last
+    stage, psum-replicated), dx matches x (cotangent of the embedded
+    input, for the embedding's outer vjp).  loss/aux are batch means.
+    MoE: with layer_has_aux, apply_layer returns (x, aux_mb) and
+    `aux_weight * mean(aux)` joins the optimized loss inside the engine.
+    """
+    stages = num_stages(mesh, axis_name)
+    batch = x.shape[0]
+    if batch % num_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by {num_microbatches} microbatches")
+    if stages <= 1:
+        raise ValueError("pipeline_1f1b requires a populated pipeline axis")
+    layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if layers % stages != 0:
+        raise ValueError(f"{layers} layers not divisible by {stages} stages")
+
+    one_layer = apply_layer
+    if remat_layer:
+        one_layer = jax.checkpoint(apply_layer, policy=remat_policy)
+
+    M = num_microbatches
+    mb = batch // M
+    m_shape = (M, mb) + x.shape[1:]
+    t_shape = (M, mb) + targets.shape[1:]
+
+    def body(stage_params, hparams, x_all, t_all):
+        s = jax.lax.axis_index(axis_name)
+        S = stages
+        is_last = s == S - 1
+        ticks = 2 * (M + S - 1)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def stage_fn(sp, hp, x_in, t_mb):
+            y, aux = _scan_layers(one_layer, sp, x_in, layer_has_aux)
+            loss_mb = head_loss(hp, y, t_mb)
+            return y, aux, loss_mb
+
+        def vary(v):
+            return jax.lax.pcast(v, (axis_name,), to="varying")
+
+        def zeros_g(tree):
+            return jax.tree.map(
+                lambda l: vary(jnp.zeros(l.shape, l.dtype)), tree)
+
+        stash = vary(jnp.zeros((S, mb) + x.shape[1:], x.dtype))
+        fwd_buf = vary(jnp.zeros((mb,) + x.shape[1:], x.dtype))
+        bwd_buf = vary(jnp.zeros((mb,) + x.shape[1:], jnp.float32))
+        dstack = zeros_g(stage_params)
+        dhead = zeros_g(hparams)
+        # the embed cotangent is inherently batch-sized (gpipe materializes
+        # the same buffer transiently in its backward); keep it in the
+        # activation dtype so it doesn't dominate the carry
+        dx_out = vary(jnp.zeros(m_shape, x.dtype))
+        loss_acc = vary(jnp.float32(0.0))
+        aux_acc = vary(jnp.float32(0.0))
+
+        def tick(carry, t):
+            stash, fwd_buf, bwd_buf, dstack, dhead, dx_out, loss_acc, aux_acc = carry
+            f_off = t - s
+            m_f = f_off // 2
+            do_f = (f_off >= 0) & (f_off % 2 == 0) & (m_f < M)
+            b_off = t - (2 * S - 1 - s)
+            m_b = b_off // 2
+            do_b = (b_off >= 0) & (b_off % 2 == 0) & (m_b < M)
+
+            m_f_c = jnp.clip(m_f, 0, M - 1)
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            x_inject = jnp.where(s == 0, x_all[m_f_c], fwd_buf)
+            x_sel = jnp.where(do_b, stash[m_b_c % S], x_inject)
+            t_sel = t_all[m_b_c]
+
+            (y, aux, loss_mb), vjp_fn = jax.vjp(
+                stage_fn, stage_params, hparams, x_sel, t_sel)
+
+            inv_m = jnp.float32(1.0 / M)
+            cot_y = jnp.where(is_last, 0.0, bwd_buf).astype(y.dtype)
+            cot_aux = jnp.where(do_b, jnp.float32(aux_weight) * inv_m, 0.0)
+            cot_loss = jnp.where(do_b & is_last, inv_m, 0.0)
+            dsp, dhp, dx_in, _ = vjp_fn((cot_y, cot_aux, cot_loss))
+
+            mask_b = do_b
+            dstack = jax.tree.map(
+                lambda a, g: a + jnp.where(mask_b, g, 0.0).astype(a.dtype),
+                dstack, dsp)
+            dhead = jax.tree.map(
+                lambda a, g: a + jnp.where(mask_b, g, 0.0).astype(a.dtype),
+                dhead, dhp)
+            loss_acc = loss_acc + jnp.where(mask_b & is_last,
+                                            loss_mb * inv_m, 0.0)
+            aux_acc = aux_acc + jnp.where(mask_b, aux * inv_m, 0.0)
+            dx_out = jnp.where(
+                mask_b & (s == 0),
+                dx_out.at[m_b_c].set(dx_in.astype(dx_out.dtype)),
+                dx_out)
+            stash = jnp.where(do_f, stash.at[m_f_c % S].set(x_sel), stash)
+
+            fwd_buf = jax.lax.ppermute(
+                jnp.where(do_f, y, jnp.zeros_like(y)), axis_name, fwd_perm)
+            bwd_buf = jax.lax.ppermute(
+                jnp.where(do_b, dx_in.astype(jnp.float32),
+                          jnp.zeros_like(bwd_buf)),
+                axis_name, bwd_perm)
+            return (stash, fwd_buf, bwd_buf, dstack, dhead, dx_out,
+                    loss_acc, aux_acc), None
+
+        carry = (stash, fwd_buf, bwd_buf, dstack, dhead, dx_out,
+                 loss_acc, aux_acc)
+        (stash, fwd_buf, bwd_buf, dstack, dhead, dx_out,
+         loss_acc, aux_acc), _ = jax.lax.scan(
+            tick, carry, jnp.arange(ticks))
+
+        # loss/aux/dhead/dx live on specific stages (masked zeros elsewhere)
+        loss = jax.lax.psum(loss_acc, axis_name)
+        aux_total = jax.lax.psum(aux_acc, axis_name)
+        dhead = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), dhead)
+        dx_out = jax.lax.psum(dx_out, axis_name)
+        return loss, aux_total, dstack, dhead, dx_out
+
+    run = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P(), P()),
+        out_specs=(P(), P(), P(axis_name), P(), P()),
+        axis_names={axis_name},
+        check_vma=int(mesh.shape.get("sequence", 1)) <= 1,  # see gpipe note
+    )
+    loss, aux_total, dstack, dhead, dx = run(
+        stacked_params, head_params, x.reshape(m_shape),
+        targets.reshape(t_shape))
+    return loss, aux_total, dstack, dhead, dx.reshape(x.shape).astype(x.dtype)
+
+
+__all__ = ["gpipe", "pipeline_1f1b", "num_stages", "PIPELINE_AXIS"]
