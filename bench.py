@@ -116,7 +116,7 @@ def main_decode(num_steps: int) -> None:
     }))
 
 
-def main(long_context: bool = False) -> None:
+def main(long_context: bool = False, moe: bool = False) -> None:
     num_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     backend = jax.default_backend()
     devices = jax.devices()
@@ -126,6 +126,15 @@ def main(long_context: bool = False) -> None:
 
     config = BENCH_CHIP
     batch, seq = 48, 2048
+    if moe:
+        # MoE config (configs.BENCH_MOE): 4 experts, top-2, ~0.76B total /
+        # ~0.48B activated.  batch 16 is the largest 16-GiB fit (the
+        # GShard dense-dispatch buffers [E, B, C, D] plus one-hot
+        # dispatch/combine tensors take the headroom; 24 OOMs).  MFU uses
+        # activated FLOPs, so the dispatch einsums are honest overhead.
+        from kubeflow_tpu.models.configs import BENCH_MOE
+
+        config, batch = BENCH_MOE, 16
     if long_context:
         # seq-4096 config: the round-4 sweep winner (ci/longctx_sweep.py,
         # ci/longctx_results.jsonl) — the causal-attention FLOP share
@@ -138,8 +147,8 @@ def main(long_context: bool = False) -> None:
         from kubeflow_tpu.models.configs import TINY
 
         config, batch, seq = TINY, 4, 128
-        long_context = False  # keep the metric name honest: this measures
-        # the tiny smoke config, not the seq-4096 workload
+        long_context = moe = False  # keep the metric name honest: this
+        # measures the tiny smoke config, not the seq-4096/MoE workloads
 
     mesh = make_mesh(MeshConfig(data=len(devices)), devices=devices)
     setup = setup_training(config, mesh, optimizer=optimizer,
@@ -168,13 +177,16 @@ def main(long_context: bool = False) -> None:
     print(
         json.dumps(
             {
-                "metric": "train_mfu_v5e_seq4096" if long_context
-                else "train_mfu_v5e",
+                "metric": ("train_mfu_v5e_seq4096" if long_context
+                           else "train_mfu_v5e_moe" if moe
+                           else "train_mfu_v5e"),
                 "value": round(achieved_mfu, 4),
                 "unit": "fraction",
                 "vs_baseline": round(achieved_mfu / MFU_TARGET, 4),
                 "detail": {
-                    "model": "bench-chip-470m" if backend != "cpu" else "tiny-cpu",
+                    "model": ("tiny-cpu" if backend == "cpu"
+                              else "bench-moe-760m" if moe
+                              else "bench-chip-470m"),
                     "tokens_per_s": round(result["tokens_per_s"], 1),
                     "step_time_s": round(result["step_time_s"], 4),
                     "final_loss": round(result["loss"], 4),
@@ -196,5 +208,8 @@ if __name__ == "__main__":
     elif "--long-context" in sys.argv:
         sys.argv.remove("--long-context")
         main(long_context=True)
+    elif "--moe" in sys.argv:
+        sys.argv.remove("--moe")
+        main(moe=True)
     else:
         main()

@@ -138,6 +138,19 @@ BENCH_CHIP = TransformerConfig(
     flash_block_k=256,
 )
 
+# single-chip MoE bench config: BENCH_CHIP's trunk with the dense MLP
+# replaced by 4 experts of half the hidden (top-2 routing) — ~0.76B total
+# params, ~0.48B activated, sized so fp32 master + Adam second moment +
+# bf16 first moment (~7.5 GiB) leave room for the expert dispatch buffers
+# in 16 GiB.  MFU uses the activated-FLOPs convention (configs.py
+# flops_per_token), so the one-hot dispatch/combine einsums GShard-style
+# dense dispatch pays are honest overhead, not numerator.
+BENCH_MOE = BENCH_CHIP.with_(
+    moe_experts=4,
+    moe_top_k=2,
+    moe_mlp_dim=3072,
+)
+
 # CI/test config: tiny but structurally identical (GQA, scan, remat)
 TINY = TransformerConfig(
     vocab_size=256,
@@ -157,5 +170,6 @@ PRESETS = {
     "gemma-7b": GEMMA_7B,
     "llama2-350m": LLAMA2_350M,
     "bench-chip": BENCH_CHIP,
+    "bench-moe": BENCH_MOE,
     "tiny": TINY,
 }
