@@ -126,7 +126,41 @@ def check_cpu_lifecycle(c: Client) -> None:
     print("PASS cpu lifecycle + annotation protocol")
 
 
-def check_tpu_topology(c: Client) -> None:
+def check_tpu_pods_scheduled(c: Client, name: str, slices: int,
+                             hosts: int) -> None:
+    """Real-substrate gang check: every slice's pods must actually BIND to
+    nodes — which only happens when the nodes advertise `google.com/tpu`
+    allocatable (the fake device plugin on kind, real TPU nodes on GKE).
+    Asserts the full gang (slices x hosts pods, hosts read off the
+    observed StatefulSet replicas so the check stays black-box) is
+    scheduled and that the per-pod identity env resolves ordinal order
+    (TPU_WORKER_ID from the pod name, hostnames list in ordinal order)."""
+    def gang():
+        status, pods = c.req(
+            "GET", f"/api/v1/namespaces/{c.ns}/pods"
+                   f"?labelSelector=notebook-name%3D{name}")
+        if status != 200:
+            return None
+        items = [p for p in pods.get("items", [])
+                 if p["spec"].get("nodeName")]
+        return items if len(items) == slices * hosts else None
+
+    pods = wait(gang, what=f"gang of {slices * hosts} pods scheduled",
+                timeout=120)
+    for pod in pods:
+        wb = pod["spec"]["containers"][0]
+        env = {e["name"]: e for e in wb.get("env", [])}
+        hostnames = env["TPU_WORKER_HOSTNAMES"]["value"].split(",")
+        assert len(hostnames) == hosts, hostnames
+        # ordinal order: entry i is the pod with STS ordinal i (its DNS
+        # name starts "<sts>-<ordinal>."), so index == TPU_WORKER_ID
+        for i, h in enumerate(hostnames):
+            pod_dns = h.split(".", 1)[0]
+            assert pod_dns.endswith(f"-{i}"), (i, hostnames)
+    print("PASS tpu gang scheduling on real nodes")
+
+
+def check_tpu_topology(c: Client, expect_scheduled: bool = False) -> None:
     name = "conf-tpu"
     slices = 2
     status, _ = c.req("POST", c.nb_path(), {
@@ -157,6 +191,9 @@ def check_tpu_topology(c: Client) -> None:
         if c.svc(f"{name}-workers")[0] == 200 else None,
         what="headless worker Service")
     assert headless["spec"].get("clusterIP") == "None", headless["spec"]
+    if expect_scheduled:
+        hosts = c.sts(f"{name}-slice-0")[1]["spec"]["replicas"]
+        check_tpu_pods_scheduled(c, name, slices, hosts=hosts)
     # slice-atomic stop: ALL slices go to 0
     c.req("PATCH", c.nb_path(name),
           {"metadata": {"annotations": {STOP: "2026-01-01T00:00:00Z"}}},
@@ -209,13 +246,17 @@ def main() -> int:
                         help="cluster has no TPU nodes")
     parser.add_argument("--skip-conversion", action="store_true",
                         help="CRD deployed without the conversion webhook")
+    parser.add_argument("--expect-scheduled", action="store_true",
+                        help="cluster has a real scheduler + TPU-capacity "
+                             "nodes (fake device plugin): assert the gang "
+                             "actually binds and worker env order is right")
     args = parser.parse_args()
     c = Client(args.server, args.namespace)
     check_cpu_lifecycle(c)
     if not args.skip_conversion:
         check_served_versions(c)
     if not args.skip_tpu:
-        check_tpu_topology(c)
+        check_tpu_topology(c, expect_scheduled=args.expect_scheduled)
     print("behavioral conformance: PASS")
     return 0
 

@@ -23,7 +23,10 @@ OUT=$(mktemp)
 # no --run-seconds cap: the trap below owns the manager's lifetime (a cap
 # could expire mid-suite on a slow machine and turn into opaque
 # connection-refused failures)
-python -m kubeflow_tpu.main --serve-api 0 --metrics-addr 0 >"$OUT" 2>&1 &
+# --fake-tpu-nodes 4: the in-memory analog of the kind lane's fake device
+# plugin — the TPU gang actually schedules, so the behavioral runner can
+# assert node binding (--expect-scheduled) here too
+python -m kubeflow_tpu.main --serve-api 0 --metrics-addr 0 --fake-tpu-nodes 4 >"$OUT" 2>&1 &
 MGR=$!
 trap 'kill $MGR 2>/dev/null || true; rm -f "$OUT"' EXIT
 URL=""
@@ -38,6 +41,6 @@ echo "== 2/3 apiserver wire-protocol fixtures ($URL) =="
 python -m kubeflow_tpu.kube.fixtures --server "$URL"
 
 echo "== 3/3 black-box behavioral contract =="
-python conformance/behavior.py --server "$URL"
+python conformance/behavior.py --server "$URL" --expect-scheduled
 
 echo "notebook conformance: PASS"

@@ -224,6 +224,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                              "over the Kubernetes REST wire protocol on "
                              "PORT (0 = ephemeral; used by the conformance "
                              "profile's black-box runner)")
+    parser.add_argument("--fake-tpu-nodes", type=int, default=0,
+                        metavar="N",
+                        help="standalone mode: seed N fake v5e TPU nodes "
+                             "(GKE labels + google.com/tpu allocatable) so "
+                             "TPU workloads actually schedule — the "
+                             "in-memory analog of the kind lane's fake "
+                             "device plugin (tpu/device_plugin.py)")
     parser.add_argument("--debug-log", action="store_true")
     args = parser.parse_args(argv)
 
@@ -241,6 +248,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     mgr, api, cluster, metrics = build_manager(api=backend)
     if cluster is not None:
         cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+        if args.fake_tpu_nodes > 0:
+            # v5e full hosts: 8 chips each (a 2x4 slice is one host)
+            cluster.add_tpu_slice_nodes(
+                "tpu-v5-lite-podslice", "2x4",
+                num_hosts=args.fake_tpu_nodes, chips_per_host=8)
     if args.expose_state and real:
         logging.warning("--expose-state ignored with a real cluster backend "
                         "(the KubeClient has no store to dump; /state stays 404)")

@@ -1,23 +1,63 @@
 #!/usr/bin/env bash
 # Build the controller image, load it into a kind cluster, deploy the
-# standalone profile, and wait for the manager (reference analog: the
-# integration workflow's podman build -> kind load -> make deploy,
-# odh_notebook_controller_integration_test.yaml:62-90).
+# FULL webhook-enabled profile (admission + conversion with minted
+# self-signed certs — the reference integration lane's shape,
+# odh_notebook_controller_integration_test.yaml:62-90,196-218), plus the
+# fake TPU device plugin so nodes advertise google.com/tpu, and wait for
+# the manager.
 set -euo pipefail
 cd "$(dirname "$0")/../.."
 CLUSTER="${CLUSTER:-kubeflow-tpu}"
 IMAGE="${IMAGE:-kubeflow-tpu-controller:kind}"
 NAMESPACE="${NAMESPACE:-kubeflow-tpu-system}"
+PROFILE="${PROFILE:-kubeflow}"
+FAKE_TPU="${FAKE_TPU:-1}"
+CHIPS="${CHIPS:-8}"
 
 docker build -t "$IMAGE" .
 kind load docker-image "$IMAGE" --name "$CLUSTER"
 
 kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
-# standalone profile: CRD without the conversion-webhook clause (no
-# cert-manager in the minimal cluster), RBAC, manager Deployment
-python -m kubeflow_tpu.deploy standalone --image "$IMAGE" \
+# webhook-enabled profile: CRD with conversion clause, admission webhook
+# configs, serving Service — caBundle patched with a freshly minted CA and
+# the serving pair delivered as a tls Secret (render_with_certs.py)
+python testing/kind/render_with_certs.py \
+  --namespace "$NAMESPACE" --image "$IMAGE" --profile "$PROFILE" \
   | sed "s/\$(NAMESPACE)/${NAMESPACE}/g" \
   | kubectl apply -n "$NAMESPACE" -f -
+
+if [[ "$FAKE_TPU" == "1" ]]; then
+  # real kubelet device plugin: google.com/tpu allocatable on every node
+  sed -e "s|image: kubeflow-tpu-controller:kind|image: ${IMAGE}|" \
+      -e "s|--chips=8|--chips=${CHIPS}|" \
+    testing/kind/fake_tpu_daemonset.yaml | kubectl apply -f -
+  kubectl -n kube-system rollout status daemonset/fake-tpu-device-plugin \
+    --timeout=120s
+  # GKE topology labels (the device plugin provides capacity; the labels
+  # come from the node labeler, as on GKE where the provisioner sets them).
+  # topology 2x4 = one v5e host of 8 chips — matches the conformance
+  # notebook's spec.tpu and the --chips default
+  for node in $(kubectl get nodes -o name); do
+    kubectl label --overwrite "$node" \
+      cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
+      cloud.google.com/gke-tpu-topology=2x4
+  done
+  # wait until EVERY node's kubelet reports the extended resource
+  node_count=$(kubectl get nodes --no-headers | wc -l)
+  ok=0
+  for i in $(seq 1 24); do
+    ok=$(kubectl get nodes -o jsonpath='{range .items[*]}{.status.allocatable.google\.com/tpu}{"\n"}{end}' \
+      | grep -cvE '^(0)?$' || true)
+    [[ "$ok" == "$node_count" ]] && break
+    sleep 5
+  done
+  if [[ "$ok" != "$node_count" ]]; then
+    echo "fake-tpu: only $ok/$node_count nodes advertise google.com/tpu" >&2
+    kubectl -n kube-system logs daemonset/fake-tpu-device-plugin --tail=50 >&2 || true
+    exit 1
+  fi
+  echo "fake-tpu: $ok/$node_count nodes advertise google.com/tpu=$CHIPS"
+fi
 
 kubectl -n "$NAMESPACE" rollout status deployment/notebook-controller-deployment \
   --timeout=180s

@@ -1,0 +1,74 @@
+"""Render the webhook-enabled profile for a real cluster with minted certs.
+
+The reference's integration lane generates a self-signed CA, patches its
+caBundle into the webhook configurations, and hands the serving pair to
+the controller via a Secret
+(/root/reference/.github/workflows/odh_notebook_controller_integration_test.yaml:196-218,
+components/testing/gh-actions/install_cert_manager.sh role).  This script
+is that step without cert-manager: mint a CA + serving cert for the
+webhook Service DNS names (kube/certs.py), emit (a) the full profile with
+caBundle patched into the Mutating/Validating webhook configs AND the CRD
+conversion clause, and (b) the tls Secret the manager Deployment mounts.
+
+Usage: python testing/kind/render_with_certs.py --namespace NS --image IMG \
+         > /tmp/manifests.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import yaml  # noqa: E402
+
+from kubeflow_tpu.deploy.manifests import render_profile  # noqa: E402
+from kubeflow_tpu.kube.certs import mint_serving_cert  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--namespace", default="kubeflow-tpu-system")
+    parser.add_argument("--image", default="kubeflow-tpu-controller:kind")
+    parser.add_argument("--profile", default="kubeflow")
+    args = parser.parse_args()
+
+    svc = "notebook-controller-webhook"
+    bundle = mint_serving_cert(
+        common_name=svc,
+        dns_names=(svc, f"{svc}.{args.namespace}",
+                   f"{svc}.{args.namespace}.svc",
+                   f"{svc}.{args.namespace}.svc.cluster.local"),
+    )
+    ca_b64 = base64.b64encode(bundle.ca_cert_pem).decode()
+
+    docs = render_profile(args.profile, image=args.image)
+    for doc in docs:
+        kind = doc.get("kind", "")
+        if kind in ("MutatingWebhookConfiguration",
+                    "ValidatingWebhookConfiguration"):
+            for wh in doc.get("webhooks", []):
+                wh["clientConfig"]["caBundle"] = ca_b64
+        elif kind == "CustomResourceDefinition":
+            conv = doc["spec"].get("conversion", {})
+            if conv.get("strategy") == "Webhook":
+                conv["webhook"]["clientConfig"]["caBundle"] = ca_b64
+
+    docs.append({
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": f"{svc}-certs"},
+        "type": "kubernetes.io/tls",
+        "data": {
+            "tls.crt": base64.b64encode(bundle.cert_pem).decode(),
+            "tls.key": base64.b64encode(bundle.key_pem).decode(),
+        },
+    })
+    print(yaml.safe_dump_all(docs, sort_keys=False))
+
+
+if __name__ == "__main__":
+    main()

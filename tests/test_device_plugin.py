@@ -1,0 +1,183 @@
+"""Fake TPU device plugin: protocol-certified against a kubelet harness.
+
+The harness plays the kubelet's two roles over real unix-domain sockets:
+a `v1beta1.Registration` gRPC server that receives the plugin's
+`Register` handshake, and a `v1beta1.DevicePlugin` CLIENT that drives
+`GetDevicePluginOptions` / `ListAndWatch` / `Allocate` against the
+plugin's socket — the exact call pattern kubelet uses, so a kind node
+with this plugin in a DaemonSet gets `google.com/tpu` allocatable
+(SURVEY.md §4.5's named gap).  The apiserver-side fallback
+(`label_tpu_node`) is certified against the in-memory ApiServer.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from kubeflow_tpu.tpu.device_plugin import (  # noqa: E402
+    API_VERSION,
+    DEFAULT_RESOURCE,
+    HEALTHY,
+    UNHEALTHY,
+    FakeTpuDevicePlugin,
+    label_tpu_node,
+    messages,
+)
+
+
+class KubeletHarness:
+    """The kubelet side of the handshake: Registration server + plugin
+    client helpers."""
+
+    def __init__(self, socket_dir: str):
+        self.socket_dir = socket_dir
+        self.register_requests: list = []
+        self.registered = threading.Event()
+        M = messages()
+        handlers = {
+            "Register": grpc.unary_unary_rpc_method_handler(
+                self._register,
+                request_deserializer=M["RegisterRequest"].FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        }
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self.server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                f"{API_VERSION}.Registration", handlers),
+        ))
+        self.server.add_insecure_port(
+            f"unix://{socket_dir}/kubelet.sock")
+        self.server.start()
+
+    def _register(self, request, context):
+        self.register_requests.append(request)
+        self.registered.set()
+        return messages()["Empty"]()
+
+    def plugin_channel(self, endpoint: str):
+        return grpc.insecure_channel(f"unix://{self.socket_dir}/{endpoint}")
+
+    def stop(self):
+        self.server.stop(grace=0.2)
+
+
+@pytest.fixture
+def socket_dir(tmp_path):
+    return str(tmp_path)
+
+
+@pytest.fixture
+def harness(socket_dir):
+    h = KubeletHarness(socket_dir)
+    yield h
+    h.stop()
+
+
+@pytest.fixture
+def plugin(socket_dir, harness):
+    p = FakeTpuDevicePlugin(socket_dir, chips=4)
+    p.start()
+    yield p
+    p.stop()
+
+
+def _stub(chan, method, req_cls, resp_cls, stream=False):
+    kind = chan.unary_stream if stream else chan.unary_unary
+    return kind(
+        f"/{API_VERSION}.DevicePlugin/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString)
+
+
+class TestRegistration:
+    def test_plugin_registers_with_kubelet(self, plugin, harness):
+        assert harness.registered.wait(timeout=5)
+        (req,) = harness.register_requests
+        assert req.version == API_VERSION
+        assert req.resource_name == DEFAULT_RESOURCE
+        assert req.endpoint == plugin.endpoint
+
+
+class TestDevicePluginService:
+    def test_options(self, plugin, harness):
+        M = messages()
+        with harness.plugin_channel(plugin.endpoint) as chan:
+            opts = _stub(chan, "GetDevicePluginOptions", M["Empty"],
+                         M["DevicePluginOptions"])(M["Empty"](), timeout=5)
+        assert not opts.pre_start_required
+
+    def test_list_and_watch_streams_devices_and_health(self, plugin,
+                                                       harness):
+        M = messages()
+        with harness.plugin_channel(plugin.endpoint) as chan:
+            stream = _stub(chan, "ListAndWatch", M["Empty"],
+                           M["ListAndWatchResponse"], stream=True)(
+                M["Empty"](), timeout=10)
+            first = next(stream)
+            assert [d.ID for d in first.devices] == [
+                "tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+            assert all(d.health == HEALTHY for d in first.devices)
+
+            # a dead chip re-streams the list with the device Unhealthy
+            plugin.set_health("tpu-2", healthy=False)
+            second = next(stream)
+            by_id = {d.ID: d.health for d in second.devices}
+            assert by_id["tpu-2"] == UNHEALTHY
+            assert by_id["tpu-0"] == HEALTHY
+
+    def test_allocate_returns_device_specs_and_env(self, plugin, harness):
+        M = messages()
+        req = M["AllocateRequest"]()
+        creq = req.container_requests.add()
+        creq.devicesIDs.extend(["tpu-0", "tpu-3"])
+        with harness.plugin_channel(plugin.endpoint) as chan:
+            resp = _stub(chan, "Allocate", M["AllocateRequest"],
+                         M["AllocateResponse"])(req, timeout=5)
+        (cresp,) = resp.container_responses
+        assert [d.host_path for d in cresp.devices] == [
+            "/dev/accel0", "/dev/accel3"]
+        assert all(d.permissions == "rw" for d in cresp.devices)
+        assert cresp.envs["TPU_FAKE_DEVICE_IDS"] == "tpu-0,tpu-3"
+        assert cresp.envs["TPU_CHIPS_ALLOCATED"] == "2"
+
+    def test_set_health_unknown_device(self, socket_dir):
+        p = FakeTpuDevicePlugin(socket_dir, chips=1)
+        with pytest.raises(KeyError):
+            p.set_health("tpu-9", healthy=False)
+
+
+class TestNodeLabelFallback:
+    def test_label_tpu_node_patches_capacity_and_labels(self):
+        from kubeflow_tpu.kube.meta import KubeObject, ObjectMeta
+        from kubeflow_tpu.kube.store import ApiServer
+        from kubeflow_tpu.tpu.device_plugin import (
+            LABEL_ACCELERATOR,
+            LABEL_TOPOLOGY,
+        )
+
+        api = ApiServer()
+        api.create(KubeObject("v1", "Node", ObjectMeta(name="worker-0")))
+
+        class DirectClient:
+            def get(self, kind, namespace, name):
+                return api.get(kind, namespace, name)
+
+            def update(self, obj):
+                return api.update(obj)
+
+            def update_status(self, obj):
+                return api.update(obj, subresource="status")
+
+        node = label_tpu_node(DirectClient(), "worker-0", chips=8,
+                              topology="2x4")
+        assert node.metadata.labels[LABEL_ACCELERATOR] == \
+            "tpu-v5-lite-podslice"
+        assert node.metadata.labels[LABEL_TOPOLOGY] == "2x4"
+        stored = api.get("Node", "", "worker-0")
+        assert stored.status["capacity"]["google.com/tpu"] == "8"
+        assert stored.status["allocatable"]["google.com/tpu"] == "8"
