@@ -1,6 +1,25 @@
 #!/usr/bin/env bash
 # Unit + integration suite on the 8-device virtual CPU mesh
-# (reference .github/workflows unit job analog).
+# (reference .github/workflows unit job analog), preceded by every static
+# gate the environment can actually run: the hermetic linter always, and
+# ruff/mypy whenever they are installed (pyproject.toml pins their
+# config), so the lint/typecheck workflows enforce outside GitHub too.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+python ci/lint.py
+if command -v ruff >/dev/null 2>&1; then
+  RUFF="ruff"
+elif python -c "import ruff" 2>/dev/null; then
+  RUFF="python -m ruff"
+else
+  RUFF=""
+fi
+if [[ -n "$RUFF" ]]; then
+  echo "== ruff =="
+  $RUFF check kubeflow_tpu tests ci
+fi
+if python -c "import mypy" 2>/dev/null; then
+  echo "== mypy =="
+  python -m mypy kubeflow_tpu
+fi
 python -m pytest tests/ -q "$@"

@@ -300,6 +300,8 @@ class _WireHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if not self._guard():
             return
+        if self._serve_openapi():
+            return
         if self._serve_discovery():
             return
         rt = self._route()
@@ -322,6 +324,142 @@ class _WireHandler(BaseHTTPRequestHandler):
     _VERBS = ["create", "delete", "deletecollection", "get", "list",
               "patch", "update", "watch"]
 
+    _MERGE_NODE = "dev.kubeflow-tpu.MergeAwareObject"
+
+    def _served_infos(self) -> list:
+        """Served resources the DATA PATH can actually answer for: without
+        a conversion webhook, alias versions 404, so neither discovery nor
+        OpenAPI may advertise them (per kind — another kind's storage
+        version in the same group does not make this kind's alias
+        servable)."""
+        infos = self.scheme.served()
+        if self.converter is not None:
+            return infos
+
+        def is_storage(i) -> bool:
+            s = self.scheme.by_kind(i.kind)
+            return (s.group, s.version) == (i.group, i.version)
+
+        return [i for i in infos if is_storage(i)]
+
+    def _openapi_schemas(self, ref_prefix: str) -> dict:
+        """Schema definitions for every served kind, plus one
+        self-referential "merge-aware object" node carrying the
+        strategic-merge metadata (x-kubernetes-patch-merge-key /
+        patch-strategy) for each mergeable list field.
+
+        Fidelity note: this server's strategic-merge engine keys on FIELD
+        NAMES at any depth (kube/strategicmerge.py MERGE_KEYS — mirroring
+        the patchMergeKey struct tags, which are consistent per field name
+        across k8s.io/api), so the schema expresses exactly that: every
+        object is the same merge-aware node whose list properties declare
+        their merge keys, self-referencing through items and
+        additionalProperties.  A client deriving patch strategy from this
+        document computes the same merges the server executes — the gap
+        docs/wire_compat.md used to document as "absent"."""
+        from .strategicmerge import MERGE_KEYS, PRIMITIVE_MERGE_FIELDS
+
+        node_ref = {"$ref": f"{ref_prefix}{self._MERGE_NODE}"}
+        props: dict = {}
+        for fname, keys in sorted(MERGE_KEYS.items()):
+            props[fname] = {
+                "type": "array",
+                "items": dict(node_ref),
+                "x-kubernetes-patch-merge-key": keys[0],
+                "x-kubernetes-patch-strategy": "merge",
+            }
+            if len(keys) > 1:
+                # candidate keys beyond the first (Container.ports keys on
+                # containerPort, ServiceSpec.ports on port) — a server
+                # extension; kubectl uses the primary
+                props[fname]["x-kubeflow-tpu-merge-key-candidates"] = \
+                    list(keys)
+        for fname in sorted(PRIMITIVE_MERGE_FIELDS):
+            props[fname] = {
+                "type": "array",
+                "items": {"type": "string"},
+                "x-kubernetes-patch-strategy": "merge",
+            }
+        schemas = {
+            self._MERGE_NODE: {
+                "type": "object",
+                "properties": props,
+                "additionalProperties": dict(node_ref),
+            }
+        }
+        for i in self._served_infos():
+            group = i.group or "core"
+            name = f"{group}.{i.version}.{i.kind}"
+            schemas[name] = {
+                "type": "object",
+                "x-kubernetes-group-version-kind": [
+                    {"group": i.group, "kind": i.kind, "version": i.version}
+                ],
+                "properties": {
+                    "apiVersion": {"type": "string"},
+                    "kind": {"type": "string"},
+                    "metadata": dict(node_ref),
+                    "spec": dict(node_ref),
+                    "status": dict(node_ref),
+                },
+                "additionalProperties": dict(node_ref),
+            }
+        return schemas
+
+    def _serve_openapi(self) -> bool:
+        """/openapi/v2 (swagger 2.0) and /openapi/v3 (discovery root +
+        per-groupVersion documents), built from the scheme registry the
+        same way discovery is."""
+        parts = [p for p in urlsplit(self.path).path.split("/") if p]
+        if not parts or parts[0] != "openapi":
+            return False
+        if parts[1:] == ["v2"]:
+            self._send_json(200, {
+                "swagger": "2.0",
+                "info": {"title": "kubeflow-tpu wire apiserver",
+                         "version": "v1"},
+                "paths": {
+                    i.collection_path(None if not i.namespaced
+                                      else "{namespace}"): {}
+                    for i in self._served_infos()
+                },
+                "definitions": self._openapi_schemas("#/definitions/"),
+            })
+            return True
+        if parts[1:] == ["v3"]:
+            gvs = sorted({
+                (f"api/{i.version}" if not i.group
+                 else f"apis/{i.group}/{i.version}")
+                for i in self._served_infos()
+            })
+            self._send_json(200, {"paths": {
+                gv: {"serverRelativeURL": f"/openapi/v3/{gv}"} for gv in gvs
+            }})
+            return True
+        if len(parts) >= 3 and parts[1] == "v3":
+            want = "/".join(parts[2:])
+            gvs = {
+                (f"api/{i.version}" if not i.group
+                 else f"apis/{i.group}/{i.version}")
+                for i in self._served_infos()
+            }
+            if want not in gvs:
+                self._send_json(404, status_body(
+                    404, "NotFound", f"no OpenAPI doc for {want}"))
+                return True
+            self._send_json(200, {
+                "openapi": "3.0.0",
+                "info": {"title": "kubeflow-tpu wire apiserver",
+                         "version": "v1"},
+                "paths": {},
+                "components": {
+                    "schemas": self._openapi_schemas(
+                        "#/components/schemas/"),
+                },
+            })
+            return True
+        return False
+
     def _serve_discovery(self) -> bool:
         """API discovery: /api, /apis, /api/v1, /apis/{g}[/{v}] built from
         the scheme — the first thing kubectl asks any server for."""
@@ -332,17 +470,7 @@ class _WireHandler(BaseHTTPRequestHandler):
                 or (parts[0] == "api" and len(parts) > 2):
             return False
         storage = self.scheme.storage_versions()
-        infos = self.scheme.served()
-        if self.converter is None:
-            # without a conversion webhook, alias versions 404 on the data
-            # path — discovery must not advertise what can't be served.
-            # Per KIND: another kind's storage version in the same group
-            # does not make this kind's alias servable
-            def is_storage(i) -> bool:
-                s = self.scheme.by_kind(i.kind)
-                return (s.group, s.version) == (i.group, i.version)
-
-            infos = [i for i in infos if is_storage(i)]
+        infos = self._served_infos()
         groups: dict[str, set[str]] = {}
         for i in infos:
             if i.group:

@@ -828,6 +828,77 @@ class TestDiscovery:
         assert exc.value.code == 404
 
 
+class TestOpenApi:
+    """OpenAPI schema endpoints (docs/wire_compat.md row): /openapi/v2 and
+    the v3 discovery root + per-groupVersion docs, with strategic-merge
+    metadata that matches what the server's merge engine actually does."""
+
+    @pytest.fixture()
+    def wire(self):
+        srv = KubeApiWireServer(ApiServer()).start()
+        yield srv
+        srv.stop()
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(srv.url + path, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def test_v2_document_shape(self, wire):
+        doc = self._get(wire, "/openapi/v2")
+        assert doc["swagger"] == "2.0"
+        defs = doc["definitions"]
+        nb = defs["kubeflow.org.v1.Notebook"]
+        assert nb["x-kubernetes-group-version-kind"] == [
+            {"group": "kubeflow.org", "kind": "Notebook", "version": "v1"}]
+        # collection paths advertised for every served resource
+        assert any(p.endswith("/notebooks") for p in doc["paths"])
+
+    def test_v2_merge_metadata_matches_engine(self, wire):
+        """The schema's patch metadata must be generated FROM the merge
+        engine's tables — a client deriving strategy from this document
+        computes the merges the server executes."""
+        from kubeflow_tpu.kube.strategicmerge import (
+            MERGE_KEYS,
+            PRIMITIVE_MERGE_FIELDS,
+        )
+
+        defs = self._get(wire, "/openapi/v2")["definitions"]
+        node = defs["dev.kubeflow-tpu.MergeAwareObject"]
+        props = node["properties"]
+        for fname, keys in MERGE_KEYS.items():
+            assert props[fname]["x-kubernetes-patch-merge-key"] == keys[0]
+            assert props[fname]["x-kubernetes-patch-strategy"] == "merge"
+            # self-referential: nested lists resolve merge keys at depth
+            assert props[fname]["items"]["$ref"].endswith("MergeAwareObject")
+        for fname in PRIMITIVE_MERGE_FIELDS:
+            assert props[fname]["x-kubernetes-patch-strategy"] == "merge"
+            assert "x-kubernetes-patch-merge-key" not in props[fname]
+
+    def test_openapi_agrees_with_discovery_on_alias_versions(self, wire):
+        """Without a conversion webhook the alias versions 404 on the data
+        path; discovery hides them and OpenAPI must agree — a
+        schema-driven client must never target a groupVersion the server
+        can't serve."""
+        defs = self._get(wire, "/openapi/v2")["definitions"]
+        assert "kubeflow.org.v1.Notebook" in defs
+        assert "kubeflow.org.v1beta1.Notebook" not in defs
+        root = self._get(wire, "/openapi/v3")
+        assert "apis/kubeflow.org/v1" in root["paths"]
+        assert "apis/kubeflow.org/v1beta1" not in root["paths"]
+
+    def test_v3_root_and_group_docs(self, wire):
+        root = self._get(wire, "/openapi/v3")
+        assert "apis/kubeflow.org/v1" in root["paths"]
+        assert "api/v1" in root["paths"]
+        gv = self._get(wire, "/openapi/v3/apis/kubeflow.org/v1")
+        assert gv["openapi"].startswith("3.")
+        assert "kubeflow.org.v1.Notebook" in gv["components"]["schemas"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                wire.url + "/openapi/v3/apis/nope/v9", timeout=5)
+        assert exc.value.code == 404
+
+
 class TestJsonPatch:
     def test_diff_apply_roundtrip(self):
         old = {"a": 1, "b": {"c": [1, 2, 3], "d": "x"}, "gone": True}
