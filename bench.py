@@ -117,7 +117,8 @@ def main_decode(num_steps: int) -> None:
 
 
 def main(long_context: bool = False, moe: bool = False) -> None:
-    num_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    numeric = [a for a in sys.argv[1:] if a.isdigit()]
+    num_steps = int(numeric[0]) if numeric else 10
     backend = jax.default_backend()
     devices = jax.devices()
     from kubeflow_tpu.tpu.topology import accelerator_from_device_kind
@@ -163,14 +164,23 @@ def main(long_context: bool = False, moe: bool = False) -> None:
     # interference (whole measurement windows run at exactly half speed,
     # then recover) — time several windows on the SAME compiled step and
     # report the best, the standard interference-rejection for shared
-    # hardware; per-window numbers stay in detail for transparency
+    # hardware; per-window numbers stay in detail for transparency.
+    # --sustained reports the MEDIAN of 5 windows instead (first window
+    # discarded as dispatch-pipeline warmup): the conservative estimator —
+    # interference windows count against the number
+    sustained = "--sustained" in sys.argv
+    n_windows = 1 if backend == "cpu" else (6 if sustained else 3)
     windows = []
-    for w in range(3 if backend != "cpu" else 1):
+    for w in range(n_windows):
         windows.append(
             timed_steps(setup, data, num_steps=num_steps,
                         warmup=2 if w == 0 else 0)
         )
-    result = max(windows, key=lambda r: r["tokens_per_s"])
+    if sustained and backend != "cpu":
+        ranked = sorted(windows[1:], key=lambda r: r["tokens_per_s"])
+        result = ranked[len(ranked) // 2]
+    else:
+        result = max(windows, key=lambda r: r["tokens_per_s"])
     achieved_mfu = mfu(
         result["tokens_per_s"], config, seq, num_chips=len(devices), accelerator=accel
     )
@@ -192,6 +202,8 @@ def main(long_context: bool = False, moe: bool = False) -> None:
                     "final_loss": round(result["loss"], 4),
                     "chips": len(devices),
                     "backend": backend,
+                    "estimator": ("sustained-median" if sustained
+                                  else "best-of-windows"),
                     "window_tokens_per_s": [
                         round(w["tokens_per_s"], 1) for w in windows
                     ],

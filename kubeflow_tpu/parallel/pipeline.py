@@ -236,6 +236,13 @@ def pipeline_1f1b(
     input, for the embedding's outer vjp).  loss/aux are batch means.
     MoE: with layer_has_aux, apply_layer returns (x, aux_mb) and
     `aux_weight * mean(aux)` joins the optimized loss inside the engine.
+
+    Known jax-0.9 limit: a PER-SHARD microbatch batch of 1 — i.e.
+    batch / num_microbatches / (data*fsdp) == 1 — combined with a
+    populated sequence axis (ring attention inside the stage) aborts in
+    XLA's SPMD partitioner (spmd_partitioner_util.cc:495 check failure);
+    keep the per-shard microbatch batch >= 2 on such meshes
+    (dryrun_multichip picks its microbatch count accordingly).
     """
     stages = num_stages(mesh, axis_name)
     batch = x.shape[0]
