@@ -15,6 +15,15 @@ residual stream passes them through unchanged), exactly Switch's behavior;
 the load-balance auxiliary loss (Switch eq. 4: E * sum_e f_e * P_e) keeps
 routing uniform so drops stay rare.
 
+Dispatch paths (cfg.moe_dispatch): "einsum" (default) is the GShard
+one-hot recipe above; "sort" routes by argsort + scatter/gather, skipping
+the O(E*C*D) dispatch FLOPs entirely.  Measured on v5e (round 4,
+BENCH_MOE): sort is SLOWER — 0.17-0.21 vs einsum's 0.21-0.23 MFU
+single-window — TPU scatters/gathers of embed-wide rows lose to dense
+MXU einsums at this expert count, which is exactly why GShard chose
+one-hot dispatch on TPU.  The sort path stays as an option for regimes
+where the einsum's E*C factor dominates (many experts, high capacity).
+
 The reference has no compute plane (SURVEY.md §2.5); this extends the
 in-notebook model zoo the TPU build adds.
 """
@@ -96,6 +105,16 @@ class MoEMLP(nn.Module):
         gate_vals = gate_vals / jnp.maximum(
             jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
+        if cfg.moe_dispatch == "sort":
+            out = self._sort_dispatch(x, gate_vals, gate_idx)
+            top1 = jax.nn.one_hot(gate_idx[..., 0], num_experts,
+                                  dtype=jnp.float32)
+            aux = load_balance_loss(probs.reshape(-1, num_experts),
+                                    top1.reshape(-1, num_experts))
+            return out, aux
+        if cfg.moe_dispatch != "einsum":
+            raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+
         # fixed per-expert capacity over each row's tokens
         capacity = max(1, int(cfg.moe_capacity_factor * seq * top_k
                               / num_experts))
@@ -141,6 +160,68 @@ class MoEMLP(nn.Module):
         aux = load_balance_loss(probs.reshape(-1, num_experts),
                                 top1.reshape(-1, num_experts))
         return out, aux
+
+    def _sort_dispatch(self, x, gate_vals, gate_idx):
+        """Sort-based dispatch: argsort (token, choice) pairs by expert,
+        rank within each expert's segment, scatter the first `capacity`
+        into the expert buffers, gather+weight back after the FFN.
+
+        Same routing semantics as the one-hot path but WITHOUT the
+        O(E*C*D) dispatch/combine einsum FLOPs — those cost ~94M
+        FLOPs/token/layer at BENCH_MOE scale, ~55% of the activated
+        expert FLOPs (BASELINE.md).  The data movement is two
+        gathers/scatters of [N, D] rows (pure HBM traffic).  Capacity is
+        GLOBAL (cf * tokens * k / E) rather than per-batch-row: the
+        standard modern convention, and strictly better balanced (drops
+        only when an expert is oversubscribed across the whole batch).
+        """
+        cfg = self.cfg
+        num_experts, top_k = cfg.moe_experts, cfg.moe_top_k
+        batch, seq, dim = x.shape
+        tokens = batch * seq
+        n = tokens * top_k
+        capacity = max(1, int(cfg.moe_capacity_factor * tokens * top_k
+                              / num_experts))
+
+        xf = x.reshape(tokens, dim)
+        e_flat = gate_idx.reshape(-1)            # [N], token-major
+        g_flat = gate_vals.reshape(-1).astype(jnp.float32)
+        tok = jnp.repeat(jnp.arange(tokens), top_k)
+
+        order = jnp.argsort(e_flat, stable=True)  # token order kept per expert
+        e_s = e_flat[order]
+        tok_s = tok[order]
+        g_s = g_flat[order]
+        counts = jnp.bincount(e_flat, length=num_experts)
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(n) - starts[e_s]
+        keep = rank < capacity
+        # kept entries get unique slots; dropped entries collide on their
+        # expert's last slot but contribute an added zero, so .add is safe
+        slot = e_s * capacity + jnp.minimum(rank, capacity - 1)
+
+        buf = jnp.zeros((num_experts * capacity, dim), x.dtype)
+        gathered = jnp.where(keep[:, None], xf[tok_s], 0)
+        expert_in = buf.at[slot].add(gathered).reshape(
+            num_experts, capacity, dim)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", None, "embed"))
+
+        expert_out = nn.vmap(
+            _ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(cfg, name="experts")(expert_in)        # [E, C, D]
+        expert_out = nn.with_logical_constraint(
+            expert_out, ("expert", None, "embed"))
+
+        rows = expert_out.reshape(num_experts * capacity, dim)[slot]
+        weighted = rows.astype(jnp.float32) * (g_s * keep)[:, None]
+        out = jnp.zeros((tokens, dim), jnp.float32).at[tok_s].add(weighted)
+        out = out.astype(x.dtype).reshape(batch, seq, dim)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
 
 
 __all__ = ["MoEMLP", "load_balance_loss"]
