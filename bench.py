@@ -64,6 +64,7 @@ def main_decode(num_steps: int) -> None:
     devices = jax.devices()
     accel = (accelerator_from_device_kind(devices[0].device_kind)
              if backend == "tpu" else "v5e")
+    int8 = "--int8" in sys.argv
     config, batch, prompt_len, new_tokens = BENCH_CHIP, 16, 128, 256
     if backend == "cpu":  # CI smoke
         config, batch, prompt_len, new_tokens = TINY, 2, 8, 16
@@ -76,6 +77,13 @@ def main_decode(num_steps: int) -> None:
     params = jax.jit(model.init)(rng, prompt)["params"]
     # decode is weight-bandwidth bound: stream bf16 weights, not fp32
     params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    if int8:
+        # opt-in int8 weight streaming (models.quant): halves the weight
+        # bytes each step streams; the roofline recomputes accordingly
+        from kubeflow_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
+        config = config.with_(weight_dtype="int8")
 
     import numpy as np
 
@@ -93,14 +101,19 @@ def main_decode(num_steps: int) -> None:
         np.asarray(run(params, p))
         dt = time.perf_counter() - t0
         best = max(best, batch * new_tokens / dt)
-    param_bytes = config.num_params * 2  # bf16
+    if int8:
+        from kubeflow_tpu.models.quant import quantized_bytes
+
+        param_bytes = quantized_bytes(params)  # int8 kernels + scales
+    else:
+        param_bytes = config.num_params * 2  # bf16
     kv_bytes = (2 * batch * config.max_seq_len * config.num_kv_heads
                 * config.head_dim * 2 * config.num_layers)
     roofline_steps = (ACCELERATORS[accel].hbm_gbps * 1e9
                       / (param_bytes + kv_bytes))
     roofline_tok_s = roofline_steps * batch
     print(json.dumps({
-        "metric": f"decode_tok_s_{accel}",
+        "metric": f"decode_tok_s_{accel}" + ("_int8" if int8 else ""),
         "value": round(best, 1),
         "unit": "tokens/s",
         "vs_baseline": round(best / roofline_tok_s, 4),
@@ -215,7 +228,7 @@ def main(long_context: bool = False, moe: bool = False) -> None:
 
 if __name__ == "__main__":
     if "--decode" in sys.argv:
-        args = [a for a in sys.argv[1:] if a != "--decode"]
+        args = [a for a in sys.argv[1:] if a.isdigit()]
         main_decode(int(args[0]) if args else 12)
     elif "--long-context" in sys.argv:
         sys.argv.remove("--long-context")

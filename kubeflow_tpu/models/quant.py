@@ -1,0 +1,130 @@
+"""Int8 weight streaming for decode.
+
+Decode is weight-bandwidth bound (every matmul weight streams from HBM
+once per token step — BASELINE.md's decode roofline), so halving the
+bytes halves the floor.  This module provides the opt-in int8 path:
+
+- `Int8DenseGeneral`: a DenseGeneral twin whose parameters are an int8
+  `kernel_q` plus a per-output-channel `kernel_scale`; at apply time the
+  kernel is upcast and scaled right at the matmul operand
+  (`w = kernel_q.astype(bf16) * scale`), which XLA fuses into the operand
+  load — the int8 bytes are what crosses HBM.
+- `quantize_params`: post-training transform from a trained param tree
+  (fp32/bf16 `kernel`s) to the quantized tree (`kernel_q`,
+  `kernel_scale`) the int8 model consumes.  Symmetric per-output-channel
+  absmax quantization; norms/router/embedding stay in their original
+  dtype (tiny, and the embedding is a lookup, not a stream).
+
+Use: `cfg.with_(weight_dtype="int8")` makes the Transformer build its
+dense layers as Int8DenseGeneral; feed it `quantize_params(params)`.
+The reference has no inference path at all (notebook controller); this
+extends the in-notebook compute plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class Int8DenseGeneral(nn.Module):
+    """Drop-in for nn.DenseGeneral(use_bias=False) with quantized weights.
+
+    Kernel layout matches DenseGeneral exactly — (contract dims...,
+    feature dims...) — so `quantize_params` is a pure tree transform."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Any = jnp.bfloat16
+    logical_axes: tuple = ()    # kernel's logical axis names, as _dense
+                                # passes DenseGeneral — int8 weights shard
+                                # by the same rule table as full-precision
+
+    @nn.compact
+    def __call__(self, x):
+        features = (self.features if isinstance(self.features, (tuple, list))
+                    else (self.features,))
+        axis = (self.axis if isinstance(self.axis, (tuple, list))
+                else (self.axis,))
+        axis = tuple(a % x.ndim for a in axis)
+        contract_shape = tuple(x.shape[a] for a in axis)
+        kernel_shape = contract_shape + tuple(features)
+        # per-LAST-dim scales (see _quantize_kernel): broadcast over every
+        # other kernel dim
+        scale_shape = (1,) * (len(kernel_shape) - 1) + (kernel_shape[-1],)
+
+        k_axes = self.logical_axes or (None,) * len(kernel_shape)
+        s_axes = (None,) * (len(scale_shape) - 1) + (k_axes[-1],)
+        kq = self.param("kernel_q",
+                        nn.with_logical_partitioning(
+                            nn.initializers.zeros_init(), tuple(k_axes)),
+                        kernel_shape, jnp.int8)
+        ks = self.param("kernel_scale",
+                        nn.with_logical_partitioning(
+                            nn.initializers.ones_init(), s_axes),
+                        scale_shape, jnp.bfloat16)
+        kq, ks = nn.unbox(kq), nn.unbox(ks)
+        w = kq.astype(self.dtype) * ks.astype(self.dtype)
+        return jax.lax.dot_general(
+            x.astype(self.dtype), w,
+            (((tuple(axis)), tuple(range(len(contract_shape)))), ((), ())),
+        )
+
+
+def _quantize_kernel(kernel: jax.Array, stacked: bool = False) -> dict:
+    """Symmetric per-LAST-dim absmax int8: one scale per slot of the
+    kernel's final dimension, shared across every other dim.  Exact
+    per-output-channel for rank-2 kernels ([in, out]); coarser for
+    multi-dim features ([in, heads, head_dim] shares a scale across
+    heads) — the tree transform cannot know how many trailing dims are
+    features, and the last dim is always an output dim in this model's
+    layouts.  `stacked` additionally keeps the leading scan-layer axis
+    (kernels [L, ..., out] quantize per layer, scales [L, 1, ..., out] —
+    what nn.scan's variable_axes slicing expects)."""
+    k32 = kernel.astype(jnp.float32)
+    axes = tuple(range(1 if stacked else 0, k32.ndim - 1))
+    absmax = jnp.max(jnp.abs(k32), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(k32 / scale), -127, 127).astype(jnp.int8)
+    return {"kernel_q": q, "kernel_scale": scale.astype(jnp.bfloat16)}
+
+
+def quantize_params(params,
+                    skip: tuple = ("embed", "router", "experts")) -> Any:
+    """Trained params -> the tree Int8DenseGeneral expects.
+
+    Every dict holding a `kernel` leaf is rewritten to
+    {kernel_q, kernel_scale}; subtrees named in `skip` and non-kernel
+    params (norm scales) pass through unchanged.  The default skip list:
+    the embedding (a lookup, not a weight stream), the MoE router
+    (fp32 on purpose — routing is precision-sensitive, moe.py), and the
+    expert FFNs (MoEMLP has no int8 module yet — quantizing their
+    kernels would produce a tree the model cannot consume)."""
+    def walk(node, name="", stacked=False):
+        if isinstance(node, dict):
+            if name in skip:
+                return node
+            if "kernel" in node and not isinstance(node["kernel"], dict):
+                rest = {k: v for k, v in node.items() if k != "kernel"}
+                return {**rest,
+                        **_quantize_kernel(nn.unbox(node["kernel"]),
+                                           stacked=stacked)}
+            return {k: walk(v, k, stacked or k == "layers")
+                    for k, v in node.items()}
+        return node
+
+    return walk(nn.unbox(params))
+
+
+def quantized_bytes(params) -> int:
+    """HBM bytes one decode step streams with the quantized tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+__all__ = ["Int8DenseGeneral", "quantize_params", "quantized_bytes"]

@@ -98,7 +98,16 @@ def _dense(
     dtype=jnp.bfloat16,
     param_dtype=jnp.float32,
     contract_axes=(-1,),
+    weight_dtype="",
 ):
+    if weight_dtype == "int8":
+        # decode-time int8 weight streaming (models.quant): params are
+        # kernel_q/kernel_scale from quantize_params, upcast fused into
+        # the matmul operand load; same logical axes as the dense kernel
+        from .quant import Int8DenseGeneral
+
+        return Int8DenseGeneral(features, axis=contract_axes, dtype=dtype,
+                                logical_axes=tuple(axes), name=name)
     return nn.DenseGeneral(
         features,
         axis=contract_axes,
@@ -122,15 +131,15 @@ class Attention(nn.Module):
         dtype = _dtype(cfg.dtype)
         q = _dense(
             (cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q",
-            dtype, _dtype(cfg.param_dtype),
+            dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
         )(x)
         k = _dense(
             (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"), "k",
-            dtype, _dtype(cfg.param_dtype),
+            dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
         )(x)
         v = _dense(
             (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"), "v",
-            dtype, _dtype(cfg.param_dtype),
+            dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
         )(x)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
@@ -170,6 +179,7 @@ class Attention(nn.Module):
             return _dense(
                 cfg.embed_dim, ("heads", "kv", "embed"), "out",
                 dtype, _dtype(cfg.param_dtype), contract_axes=(-2, -1),
+                weight_dtype=cfg.weight_dtype,
             )(out)
 
         use_ring = (
@@ -198,6 +208,7 @@ class Attention(nn.Module):
         return _dense(
             cfg.embed_dim, ("heads", "kv", "embed"), "out",
             dtype, _dtype(cfg.param_dtype), contract_axes=(-2, -1),
+            weight_dtype=cfg.weight_dtype,
         )(out)
 
 
@@ -208,11 +219,15 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
-        gate = _dense(cfg.mlp_dim, ("embed", "mlp"), "gate", dtype, pdtype)(x)
-        up = _dense(cfg.mlp_dim, ("embed", "mlp"), "up", dtype, pdtype)(x)
+        wd = cfg.weight_dtype
+        gate = _dense(cfg.mlp_dim, ("embed", "mlp"), "gate", dtype, pdtype,
+                      weight_dtype=wd)(x)
+        up = _dense(cfg.mlp_dim, ("embed", "mlp"), "up", dtype, pdtype,
+                    weight_dtype=wd)(x)
         hidden = nn.silu(gate) * up
         hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "mlp"))
-        return _dense(cfg.embed_dim, ("mlp", "embed"), "down", dtype, pdtype)(hidden)
+        return _dense(cfg.embed_dim, ("mlp", "embed"), "down", dtype, pdtype,
+                      weight_dtype=wd)(hidden)
 
 
 class DecoderLayer(nn.Module):
@@ -288,7 +303,8 @@ class Transformer(nn.Module):
         self.final_norm = RMSNorm(cfg.norm_eps, dtype, name="final_norm")
         if not cfg.tie_embeddings:
             self.lm_head = _dense(
-                cfg.vocab_size, ("embed", "vocab"), "lm_head", dtype, pdtype
+                cfg.vocab_size, ("embed", "vocab"), "lm_head", dtype, pdtype,
+                weight_dtype=cfg.weight_dtype,
             )
 
     def embed_tokens(self, tokens):
