@@ -149,7 +149,13 @@ def main(long_context: bool = False, moe: bool = False) -> None:
         from kubeflow_tpu.models.configs import BENCH_MOE
 
         config, batch = BENCH_MOE, 16
-    if long_context:
+    if long_context == 8192:
+        # seq-8192: batch 8 is the largest fit (12 OOMs); block_k 1024
+        # edges out 512 at this kv length (ci/longctx probes)
+        batch, seq = 8, 8192
+        config = config.with_(max_seq_len=8192,
+                              flash_block_q=512, flash_block_k=1024)
+    elif long_context:
         # seq-4096 config: the round-4 sweep winner (ci/longctx_sweep.py,
         # ci/longctx_results.jsonl) — the causal-attention FLOP share
         # doubles at 4k and the flash tile optimum moves from 256x256 to
@@ -200,7 +206,7 @@ def main(long_context: bool = False, moe: bool = False) -> None:
     print(
         json.dumps(
             {
-                "metric": ("train_mfu_v5e_seq4096" if long_context
+                "metric": (f"train_mfu_v5e_seq{seq}" if long_context
                            else "train_mfu_v5e_moe" if moe
                            else "train_mfu_v5e"),
                 "value": round(achieved_mfu, 4),
@@ -230,9 +236,10 @@ if __name__ == "__main__":
     if "--decode" in sys.argv:
         args = [a for a in sys.argv[1:] if a.isdigit()]
         main_decode(int(args[0]) if args else 12)
-    elif "--long-context" in sys.argv:
-        sys.argv.remove("--long-context")
-        main(long_context=True)
+    elif any(a.startswith("--long-context") for a in sys.argv):
+        arg = next(a for a in sys.argv if a.startswith("--long-context"))
+        sys.argv.remove(arg)
+        main(long_context=int(arg.split("=", 1)[1]) if "=" in arg else 4096)
     elif "--moe" in sys.argv:
         sys.argv.remove("--moe")
         main(moe=True)
