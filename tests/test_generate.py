@@ -73,6 +73,20 @@ class TestGenerate:
         out2 = generate(cfg, unrolled, prompt, max_new_tokens=4)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
 
+    def test_moe_config_decodes(self):
+        """The KV-cache decode path composes with MoE layers (DecoderLayer
+        returns (x, aux) there; the unrolled decode stack must thread it)."""
+        cfg = TINY.with_(moe_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 8), jnp.int32))["params"]
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0,
+                                    cfg.vocab_size)
+        out = generate(cfg, params, prompt, max_new_tokens=4)
+        assert out.shape == (2, 9)
+        assert jnp.isfinite(out).sum() == out.size  # int tokens, all valid
+        assert int(out.max()) < cfg.vocab_size
+
     def test_single_new_token(self):
         cfg = TINY
         params = _init_params(cfg)
