@@ -65,6 +65,7 @@ def main_decode(num_steps: int) -> None:
     accel = (accelerator_from_device_kind(devices[0].device_kind)
              if backend == "tpu" else "v5e")
     int8 = "--int8" in sys.argv
+    int4 = "--int4" in sys.argv
     config, batch, prompt_len, new_tokens = BENCH_CHIP, 16, 128, 256
     if backend == "cpu":  # CI smoke
         config, batch, prompt_len, new_tokens = TINY, 2, 8, 16
@@ -84,6 +85,12 @@ def main_decode(num_steps: int) -> None:
 
         params = quantize_params(params)
         config = config.with_(weight_dtype="int8")
+    elif int4:
+        # int4: nibble-packed, group scales — quarter the weight bytes
+        from kubeflow_tpu.models.quant import quantize_params_int4
+
+        params = quantize_params_int4(params)
+        config = config.with_(weight_dtype="int4")
 
     import numpy as np
 
@@ -101,10 +108,10 @@ def main_decode(num_steps: int) -> None:
         np.asarray(run(params, p))
         dt = time.perf_counter() - t0
         best = max(best, batch * new_tokens / dt)
-    if int8:
+    if int8 or int4:
         from kubeflow_tpu.models.quant import quantized_bytes
 
-        param_bytes = quantized_bytes(params)  # int8 kernels + scales
+        param_bytes = quantized_bytes(params)  # quantized kernels + scales
     else:
         param_bytes = config.num_params * 2  # bf16
     kv_bytes = (2 * batch * config.max_seq_len * config.num_kv_heads
@@ -113,7 +120,8 @@ def main_decode(num_steps: int) -> None:
                       / (param_bytes + kv_bytes))
     roofline_tok_s = roofline_steps * batch
     print(json.dumps({
-        "metric": f"decode_tok_s_{accel}" + ("_int8" if int8 else ""),
+        "metric": f"decode_tok_s_{accel}" + (
+            "_int8" if int8 else "_int4" if int4 else ""),
         "value": round(best, 1),
         "unit": "tokens/s",
         "vs_baseline": round(best / roofline_tok_s, 4),

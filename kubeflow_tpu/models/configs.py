@@ -26,10 +26,10 @@ class TransformerConfig:
     dtype: str = "bfloat16"          # activation/compute dtype
     param_dtype: str = "float32"     # master weights
     weight_dtype: str = ""           # decode-time weight streaming format:
-                                     # "" = param_dtype as-is; "int8" =
-                                     # per-channel-quantized kernels
-                                     # (models.quant) — halves the HBM
-                                     # traffic decode is bound by
+                                     # "" = param_dtype as-is; "int8" /
+                                     # "int4" = quantized kernels
+                                     # (models.quant) — halve/quarter the
+                                     # HBM traffic decode is bound by
     attention_impl: str = "auto"     # auto | flash | xla | ring
     remat: bool = True               # checkpoint each layer (HBM for FLOPs)
     scan_layers: bool = True         # lax.scan over layers (compile time)

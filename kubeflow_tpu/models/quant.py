@@ -28,6 +28,7 @@ from typing import Any, Sequence, Union
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class Int8DenseGeneral(nn.Module):
@@ -127,4 +128,144 @@ def quantized_bytes(params) -> int:
     return total
 
 
-__all__ = ["Int8DenseGeneral", "quantize_params", "quantized_bytes"]
+# ---------------------------------------------------------------------------
+# int4: nibble-packed int8 storage + per-group scales
+
+
+INT4_GROUP = 64  # contract-dim group size per scale
+
+
+class Int4DenseGeneral(nn.Module):
+    """DenseGeneral with 4-bit weights packed two-per-int8 byte.
+
+    Storage is int8 (the relay cannot transfer jnp.int4 arrays), packed
+    along the FIRST contract dim: byte i holds rows 2i (low nibble) and
+    2i+1 (high nibble), sign-extended with arithmetic shifts.  Scales are
+    per (contract-group, last-dim) — INT4_GROUP rows share a scale, which
+    keeps 4-bit error acceptable where a whole-column absmax would not.
+
+    MEASURED NEGATIVE on v5e (round 4, BASELINE.md): int4 decodes SLOWER
+    than int8 on this XLA version — 5.9k vs 10.4k tok/s on the 470M
+    bench.  The interleaving unpack materializes the bf16 weights (1.7k
+    tok/s); the shipped even/odd split-matmul form (x @ W == x_even @ lo
+    + x_odd @ hi, operands pure elementwise shifts) recovers to 5.9k but
+    the group-scale reshape-multiply still defeats full operand fusion.
+    Kept as an option: the capacity win is real (a 13B-class model fits
+    one chip), and a Pallas dequant-matmul kernel is the known fix."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Any = jnp.bfloat16
+    logical_axes: tuple = ()
+
+    @nn.compact
+    def __call__(self, x):
+        features = (self.features if isinstance(self.features, (tuple, list))
+                    else (self.features,))
+        axis = (self.axis if isinstance(self.axis, (tuple, list))
+                else (self.axis,))
+        axis = tuple(a % x.ndim for a in axis)
+        contract_shape = tuple(x.shape[a] for a in axis)
+        kernel_shape = contract_shape + tuple(features)
+        flat_in = 1
+        for d in contract_shape:
+            flat_in *= d
+        flat_out = 1
+        for d in features:
+            flat_out *= d
+        if flat_in % (2 * INT4_GROUP) != 0:
+            raise ValueError(
+                f"contract size {flat_in} not divisible by "
+                f"2*INT4_GROUP={2 * INT4_GROUP}")
+
+        k_axes = self.logical_axes or (None,) * len(kernel_shape)
+        kq = self.param("kernel_q4",
+                        nn.with_logical_partitioning(
+                            nn.initializers.zeros_init(),
+                            (None, k_axes[-1])),
+                        (flat_in // 2, flat_out), jnp.int8)
+        ks = self.param("kernel_scale",
+                        nn.with_logical_partitioning(
+                            nn.initializers.ones_init(),
+                            (None, None, k_axes[-1])),
+                        (flat_in // INT4_GROUP, 1, flat_out), jnp.bfloat16)
+        kq, ks = nn.unbox(kq), nn.unbox(ks)
+
+        # sign-extending unpack: low nibble via <<4 then arithmetic >>4.
+        # NO interleave anywhere: byte i holds contract rows 2i (lo) and
+        # 2i+1 (hi), so instead of re-interleaving the weight matrix
+        # (which XLA cannot fuse into the dot operand — it materializes
+        # the bf16 copy, measured as a big slowdown), the INPUT's even and
+        # odd contract rows each matmul their own half:
+        #   x @ W  ==  x[..., 0::2] @ lo + x[..., 1::2] @ hi
+        # where lo/hi are pure elementwise shifts+scales of the packed
+        # buffer — operand-fusable.
+        lo = jax.lax.shift_right_arithmetic(
+            jax.lax.shift_left(kq, jnp.int8(4)), jnp.int8(4))
+        hi = jax.lax.shift_right_arithmetic(kq, jnp.int8(4))
+        half_group = INT4_GROUP // 2
+        sc = ks.astype(self.dtype)
+
+        def dequant(part):  # [in/2, out] int8 -> scaled, group-wise
+            g = part.astype(self.dtype).reshape(
+                flat_in // INT4_GROUP, half_group, flat_out)
+            return (g * sc).reshape(flat_in // 2, flat_out)
+
+        x2 = x.reshape(x.shape[:min(axis)] + (flat_in,)) \
+            if len(axis) > 1 else x
+        x2 = x2.astype(self.dtype)
+        dn = (((x2.ndim - 1,), (0,)), ((), ()))
+        out = (jax.lax.dot_general(x2[..., 0::2], dequant(lo), dn)
+               + jax.lax.dot_general(x2[..., 1::2], dequant(hi), dn))
+        return out.reshape(out.shape[:-1] + tuple(features)) \
+            if len(features) > 1 else out
+
+
+def _quantize_kernel_int4(kernel: jax.Array, n_contract: int = 1) -> dict:
+    """Kernel [contract..., features...] -> nibble-packed int8 + group
+    scales, in Int4DenseGeneral's flat [in, out] layout.  `n_contract`
+    says how many LEADING dims are contracted (1 for [in, out] and
+    [in, heads, dh]; 2 for the attention out projection [h, dh, out])."""
+    k32 = np.asarray(jax.device_get(kernel), dtype=np.float32)
+    shape = k32.shape
+    n_in = 1
+    for d in shape[:n_contract]:
+        n_in *= d
+    flat = k32.reshape(n_in, -1)
+    n_out = flat.shape[1]
+    g = flat.reshape(n_in // INT4_GROUP, INT4_GROUP, n_out)
+    absmax = np.max(np.abs(g), axis=1, keepdims=True)
+    scale = np.maximum(absmax / 7.0, 1e-12)
+    q = np.clip(np.round(g / scale), -8, 7).astype(np.int8)
+    q = q.reshape(n_in, n_out)
+    packed = ((q[1::2] << 4) | (q[0::2] & 0x0F)).astype(np.int8)
+    return {"kernel_q4": jnp.asarray(packed),
+            "kernel_scale": jnp.asarray(scale.astype("float32")
+                                        ).astype(jnp.bfloat16)}
+
+
+def quantize_params_int4(params,
+                         skip: tuple = ("embed", "router", "experts")):
+    """Trained params -> the Int4DenseGeneral tree (see quantize_params
+    for the walk/skips; int4 ignores the stacked layout — decode always
+    unrolls).  The attention out projection ([heads, head_dim, embed]) is
+    the model family's one multi-dim-contract kernel; everything else
+    contracts a single leading dim."""
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            if name in skip:
+                return node
+            if "kernel" in node and not isinstance(node["kernel"], dict):
+                rest = {k: v for k, v in node.items() if k != "kernel"}
+                kernel = nn.unbox(node["kernel"])
+                n_contract = 2 if name == "out" and kernel.ndim == 3 else 1
+                return {**rest,
+                        **_quantize_kernel_int4(kernel, n_contract)}
+            return {k: walk(v, k) for k, v in node.items()}
+        return node
+
+    return walk(nn.unbox(params))
+
+
+__all__ = ["Int8DenseGeneral", "Int4DenseGeneral", "quantize_params",
+           "quantize_params_int4", "quantized_bytes", "INT4_GROUP"]

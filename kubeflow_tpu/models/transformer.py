@@ -100,14 +100,15 @@ def _dense(
     contract_axes=(-1,),
     weight_dtype="",
 ):
-    if weight_dtype == "int8":
-        # decode-time int8 weight streaming (models.quant): params are
-        # kernel_q/kernel_scale from quantize_params, upcast fused into
-        # the matmul operand load; same logical axes as the dense kernel
-        from .quant import Int8DenseGeneral
+    if weight_dtype in ("int8", "int4"):
+        # decode-time quantized weight streaming (models.quant): params
+        # come from quantize_params/_int4, upcast fused into the matmul
+        # operand load; same logical axes as the dense kernel
+        from .quant import Int4DenseGeneral, Int8DenseGeneral
 
-        return Int8DenseGeneral(features, axis=contract_axes, dtype=dtype,
-                                logical_axes=tuple(axes), name=name)
+        cls = Int8DenseGeneral if weight_dtype == "int8" else Int4DenseGeneral
+        return cls(features, axis=contract_axes, dtype=dtype,
+                   logical_axes=tuple(axes), name=name)
     return nn.DenseGeneral(
         features,
         axis=contract_axes,

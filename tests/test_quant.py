@@ -74,3 +74,57 @@ class TestQuantizeParams:
     def test_decode_config_preserves_weight_dtype(self):
         assert decode_config(
             TINY.with_(weight_dtype="int8")).weight_dtype == "int8"
+
+
+class TestInt4:
+    """Nibble-packed int4 with group scales: decode must still track the
+    full-precision model (coarser than int8, so a looser cosine bar)."""
+
+    def _cfg(self):
+        # contract dims must divide 2*INT4_GROUP=128: widen TINY
+        return TINY.with_(embed_dim=256, mlp_dim=512, num_heads=4,
+                          num_kv_heads=2, head_dim=64, scan_layers=False)
+
+    def test_pack_unpack_roundtrip(self):
+        from kubeflow_tpu.models.quant import (
+            Int4DenseGeneral,
+            _quantize_kernel_int4,
+        )
+
+        k = jax.random.normal(jax.random.PRNGKey(0), (256, 32)) * 0.05
+        packed = _quantize_kernel_int4(k)
+        assert packed["kernel_q4"].shape == (128, 32)
+        assert packed["kernel_q4"].dtype == jnp.int8
+        mod = Int4DenseGeneral(32, axis=-1, dtype=jnp.float32)
+        x = jnp.eye(256, dtype=jnp.float32)
+        w = mod.apply({"params": packed}, x)  # identity input -> dequant w
+        err = np.max(np.abs(np.asarray(w) - np.asarray(k)))
+        # int4 with group-128 scales: |err| <= absmax/7 per group
+        assert err < float(np.max(np.abs(np.asarray(k)))) / 6.0
+
+    def test_int4_generate_tracks_dense(self):
+        from kubeflow_tpu.models.quant import quantize_params_int4
+
+        cfg = self._cfg()
+        params = Transformer(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        dense = Transformer(cfg).apply({"params": params}, tokens)
+        q = Transformer(cfg.with_(weight_dtype="int4")).apply(
+            {"params": quantize_params_int4(params)}, tokens)
+        a = np.asarray(dense, np.float32).ravel()
+        b = np.asarray(q, np.float32).ravel()
+        cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+        # random init weights are int4's worst case (no structure for the
+        # group scales to exploit — every weight ~absmax/7 error); trained
+        # weights track tighter.  0.984 measured here; the bar catches
+        # sign/packing bugs, not quantization noise
+        assert cos > 0.97, cos
+
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                                    cfg.vocab_size)
+        out = generate(cfg.with_(weight_dtype="int4"),
+                       quantize_params_int4(params), prompt,
+                       max_new_tokens=4)
+        assert out.shape == (2, 9)
