@@ -1,0 +1,67 @@
+"""Numerics gate for the Pallas int4 dequant-matmul kernel (run on TPU).
+
+Compares ops/int4_matmul.py against a host-side dequantized reference at
+the bench shapes.  Mirrors ci/flash_numerics.py's role for the flash
+kernel; the CPU test suite only exercises the XLA fallback path, so this
+is the kernel's correctness pin.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.models.quant import INT4_GROUP, _quantize_kernel_int4  # noqa: E402
+from kubeflow_tpu.ops.int4_matmul import int4_matmul, supported  # noqa: E402
+
+
+def check(m: int, k_dim: int, n: int, seed: int = 0) -> float:
+    k = jax.random.normal(jax.random.PRNGKey(seed), (k_dim, n)) * 0.05
+    packed = _quantize_kernel_int4(k)
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (m, k_dim),
+                          jnp.bfloat16)
+    assert supported(m, k_dim, n, INT4_GROUP), (m, k_dim, n)
+    out = int4_matmul(x, packed["kernel_q4"], packed["kernel_scale"],
+                      group=INT4_GROUP)
+
+    q4 = np.asarray(packed["kernel_q4"])
+    lo = ((q4.astype(np.int8) << 4) >> 4).astype(np.float32)
+    hi = (q4.astype(np.int8) >> 4).astype(np.float32)
+    w = np.zeros((k_dim, n), np.float32)
+    w[0::2] = lo
+    w[1::2] = hi
+    sc = np.asarray(packed["kernel_scale"], np.float32).reshape(
+        k_dim // INT4_GROUP, n)
+    w = (w.reshape(k_dim // INT4_GROUP, INT4_GROUP, n)
+         * sc[:, None, :]).reshape(k_dim, n)
+    ref = np.asarray(x, np.float32) @ w
+    got = np.asarray(out, np.float32)
+    return float(np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-9))
+
+
+def main() -> None:
+    if jax.default_backend() != "tpu":
+        print("int4 kernel check: SKIP (needs TPU)")
+        return
+    shapes = [
+        (16, 1536, 6144),    # mlp up, decode batch
+        (16, 6144, 1536),    # mlp down
+        (16, 1536, 32000 // 2 * 2),  # lm_head-ish (bn=256 path)
+        (128, 1536, 1536),   # prefill rows
+    ]
+    for m, k_dim, n in shapes:
+        err = check(m, k_dim, n)
+        status = "OK" if err < 0.02 else "FAIL"
+        print(f"int4 kernel [{m}x{k_dim}x{n}]: rel_err={err:.5f} {status}")
+        assert err < 0.02, (m, k_dim, n, err)
+    print("int4 kernel numerics: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -191,32 +191,48 @@ class Int4DenseGeneral(nn.Module):
                         (flat_in // INT4_GROUP, 1, flat_out), jnp.bfloat16)
         kq, ks = nn.unbox(kq), nn.unbox(ks)
 
-        # sign-extending unpack: low nibble via <<4 then arithmetic >>4.
-        # NO interleave anywhere: byte i holds contract rows 2i (lo) and
-        # 2i+1 (hi), so instead of re-interleaving the weight matrix
-        # (which XLA cannot fuse into the dot operand — it materializes
-        # the bf16 copy, measured as a big slowdown), the INPUT's even and
-        # odd contract rows each matmul their own half:
-        #   x @ W  ==  x[..., 0::2] @ lo + x[..., 1::2] @ hi
-        # where lo/hi are pure elementwise shifts+scales of the packed
-        # buffer — operand-fusable.
-        lo = jax.lax.shift_right_arithmetic(
-            jax.lax.shift_left(kq, jnp.int8(4)), jnp.int8(4))
-        hi = jax.lax.shift_right_arithmetic(kq, jnp.int8(4))
-        half_group = INT4_GROUP // 2
-        sc = ks.astype(self.dtype)
-
-        def dequant(part):  # [in/2, out] int8 -> scaled, group-wise
-            g = part.astype(self.dtype).reshape(
-                flat_in // INT4_GROUP, half_group, flat_out)
-            return (g * sc).reshape(flat_in // 2, flat_out)
-
         x2 = x.reshape(x.shape[:min(axis)] + (flat_in,)) \
             if len(axis) > 1 else x
         x2 = x2.astype(self.dtype)
-        dn = (((x2.ndim - 1,), (0,)), ((), ()))
-        out = (jax.lax.dot_general(x2[..., 0::2], dequant(lo), dn)
-               + jax.lax.dot_general(x2[..., 1::2], dequant(hi), dn))
+        lead = x2.shape[:-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+
+        from ..ops import int4_matmul as i4
+
+        if jax.default_backend() == "tpu" and i4.supported(
+                rows, flat_in, flat_out, INT4_GROUP):
+            # Pallas dequant-matmul: each packed tile is unpacked+scaled
+            # in VMEM and fed to the MXU — HBM sees exactly the int4
+            # bytes (ops/int4_matmul.py)
+            out = i4.int4_matmul(x2.reshape(rows, flat_in), kq, ks,
+                                 group=INT4_GROUP, out_dtype=self.dtype)
+            out = out.reshape(lead + (flat_out,))
+        else:
+            # XLA fallback.  NO interleave anywhere: byte i holds contract
+            # rows 2i (lo) and 2i+1 (hi), so instead of re-interleaving
+            # the weight matrix (which XLA cannot fuse into the dot
+            # operand — it materializes the bf16 copy, measured as a big
+            # slowdown), the INPUT's even and odd contract rows each
+            # matmul their own half:
+            #   x @ W  ==  x[..., 0::2] @ lo + x[..., 1::2] @ hi
+            # where lo/hi are pure elementwise shifts+scales of the
+            # packed buffer.
+            lo = jax.lax.shift_right_arithmetic(
+                jax.lax.shift_left(kq, jnp.int8(4)), jnp.int8(4))
+            hi = jax.lax.shift_right_arithmetic(kq, jnp.int8(4))
+            half_group = INT4_GROUP // 2
+            sc = ks.astype(self.dtype)
+
+            def dequant(part):  # [in/2, out] int8 -> scaled, group-wise
+                g = part.astype(self.dtype).reshape(
+                    flat_in // INT4_GROUP, half_group, flat_out)
+                return (g * sc).reshape(flat_in // 2, flat_out)
+
+            dn = (((x2.ndim - 1,), (0,)), ((), ()))
+            out = (jax.lax.dot_general(x2[..., 0::2], dequant(lo), dn)
+                   + jax.lax.dot_general(x2[..., 1::2], dequant(hi), dn))
         return out.reshape(out.shape[:-1] + tuple(features)) \
             if len(features) > 1 else out
 

@@ -1,0 +1,131 @@
+"""Pallas TPU kernel: matmul against nibble-packed int4 weights.
+
+The XLA formulation of int4 decode (models/quant.py Int4DenseGeneral)
+cannot keep the dequantized weights out of HBM — the group-scale multiply
+defeats operand fusion, measured at 5.9k tok/s vs int8's 10.4k on the
+470M bench (BASELINE.md).  This kernel is the fix: each [block_k/2,
+block_n] packed-int8 tile is DMA'd to VMEM, sign-extended with shifts,
+scaled by its group scales, and fed straight to the MXU — the bf16
+weights exist only tile-at-a-time in VMEM, so HBM sees exactly the int4
+bytes.
+
+Packing layout matches models/quant.py: byte i of the packed [K/2, N]
+buffer holds contract rows 2i (low nibble) and 2i+1 (high nibble), scales
+[K/G, N] with G = INT4_GROUP rows per scale.  The kernel avoids in-VMEM
+interleaving the same way the XLA path does:
+    x @ W == x_even @ lo + x_odd @ hi
+with x pre-split OUTSIDE the kernel (two [M, K/2] operands — cheap, they
+are activations, not weights).
+
+Grid: (M/bm, N/bn, K/bk) with K innermost; fp32 accumulator scratch in
+VMEM, written to the output on the last K step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+
+def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k: int,
+            group: int):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Mosaic has no int8 vector shifts (arith.shli on i8 fails to
+    # legalize); unpack in int32 — lo sign-extends via <<28 then
+    # arithmetic >>28, hi is the sign-extended byte arithmetic >>4.
+    # (An output-side-scaling variant with per-group batched dots — which
+    # would cut the per-weight VPU work — fails Mosaic layout inference
+    # ("unsupported shape cast" on the [M, G, half] transpose), so the
+    # scale applies weight-side.)
+    wp = w_ref[:].astype(jnp.int32)      # [bk/2, bn] packed pairs
+    lo = jax.lax.shift_right_arithmetic(
+        jax.lax.shift_left(wp, jnp.int32(28)), jnp.int32(28))
+    hi = jax.lax.shift_right_arithmetic(wp, jnp.int32(4))
+    sc = s_ref[:]                        # [bk/group, bn] f32
+    half = group // 2
+    bk2, bn = wp.shape
+
+    def dequant(part):  # -> bf16 MXU operand, built entirely in VMEM
+        g = part.astype(jnp.float32).reshape(bk2 // half, half, bn)
+        return (g * sc[:, None, :]).reshape(bk2, bn).astype(jnp.bfloat16)
+
+    acc_ref[:] += (
+        jnp.dot(xe_ref[:], dequant(lo),
+                preferred_element_type=jnp.float32)
+        + jnp.dot(xo_ref[:], dequant(hi),
+                  preferred_element_type=jnp.float32)
+    )
+
+    @pl.when(k == n_k - 1)
+    def _write():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _pick_block(dim: int, candidates=(512, 256, 128)) -> int:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return 0
+
+
+def supported(m: int, k: int, n: int, group: int) -> bool:
+    bm = _pick_block(m, (128, 64, 32, 16))
+    bk = _pick_block(k)
+    bn = _pick_block(n)
+    return bool(bm and bk and bn) and bk % (2 * group) == 0
+
+
+@functools.partial(jax.jit, static_argnames=("group", "out_dtype"))
+def int4_matmul(x, packed, scales, *, group: int = 64,
+                out_dtype=jnp.bfloat16):
+    """x [M, K] @ int4-packed W -> [M, N].
+
+    packed: [K/2, N] int8 (models/quant.py layout); scales: [K/group, N]
+    (any float dtype).  Caller guarantees `supported(M, K, N, group)`."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k_dim = x.shape
+    n = packed.shape[1]
+    bm = _pick_block(m, (128, 64, 32, 16))
+    bk = _pick_block(k_dim)
+    bn = _pick_block(n)
+    n_k = k_dim // bk
+
+    x = x.astype(jnp.bfloat16)
+    xe = x[:, 0::2]
+    xo = x[:, 1::2]
+    # models/quant.py stores scales [K/G, 1, N]; the kernel wants 2-D
+    scales = scales.reshape(scales.shape[0], scales.shape[-1]) \
+        .astype(jnp.float32)
+
+    grid = (m // bm, n // bn, n_k)
+    kernel = functools.partial(_kernel, n_k=n_k, group=group)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk // 2), lambda i, j, kk: (i, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, bk // 2), lambda i, j, kk: (i, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk // 2, bn), lambda i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk // group, bn), lambda i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+    )(xe, xo, packed, scales)
+
+
+__all__ = ["int4_matmul", "supported"]
