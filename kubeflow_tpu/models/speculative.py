@@ -1,0 +1,168 @@
+"""Greedy speculative decoding: draft gamma tokens, verify in one pass.
+
+Serving accelerator for the in-notebook compute plane: a small DRAFT
+model proposes `gamma` greedy tokens autoregressively; the TARGET model
+scores all of them in ONE forward (gamma+1 positions through its KV
+cache); the longest prefix where the target's greedy choice agrees is
+accepted, plus one corrected token from the target.  Greedy speculative
+decoding is EXACT — the emitted sequence equals the target's own greedy
+decode no matter how bad the draft is; the draft only changes speed
+(per outer step the target does one multi-token pass instead of
+accepted+1 single-token passes, and decode is weight-bandwidth bound, so
+a gamma-token pass costs nearly the same as a 1-token pass).
+
+This framework's KV-cache design makes the rewind free: the cache is a
+static ring indexed by a scalar `cache_index`, and causality masks
+positions >= the query's global offset, so rejecting draft tokens is a
+pure index reset — stale entries beyond the index are masked until
+overwritten (models/transformer.py decode path).
+
+Batch semantics: acceptance is the MINIMUM across rows.  That stays
+exact (rows that would have accepted more agreed with the target at the
+correction position anyway, so the emitted token is identical) and keeps
+one scalar cache index; it is conservative in speed only.
+
+The reference ships no inference path (SURVEY.md §2.5); this extends the
+serving story alongside int8 weight streaming (models/quant.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .configs import TransformerConfig
+from .generate import decode_config, unroll_params
+from .transformer import Transformer
+
+
+def _rewind(cache, new_index):
+    """Set every layer's scalar cache_index (a pure pytree update — the
+    ring's stale tail is masked by causality until overwritten)."""
+    def fix(path, leaf):
+        if path and getattr(path[-1], "key", None) == "cache_index":
+            return jnp.full_like(leaf, new_index)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params,
+    draft_cfg: TransformerConfig,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    gamma: int = 4,
+):
+    """prompt [B, P] -> ([B, P + max_new_tokens] greedy tokens,
+    outer_steps) — token-identical to `generate(target_cfg, ...)` with
+    temperature=0; `outer_steps` (a traced scalar) is the number of
+    draft-verify rounds, the speed diagnostic (ideal = ceil(N/(gamma+1))
+    at full acceptance, N at zero acceptance)."""
+    if gamma < 2:
+        raise ValueError("gamma must be >= 2 (acceptance caps at gamma-1)")
+    t_cfg = decode_config(target_cfg)
+    d_cfg = decode_config(draft_cfg)
+    target_params = unroll_params(target_params, t_cfg.num_layers)
+    draft_params = unroll_params(draft_params, d_cfg.num_layers)
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    # the verify pass appends up to gamma+1 positions past the last
+    # accepted token, so the ring needs headroom past `total`
+    t_cfg = t_cfg.with_(max_seq_len=total + gamma + 1)
+    d_cfg = d_cfg.with_(max_seq_len=total + gamma + 1)
+    target = Transformer(t_cfg)
+    draft = Transformer(d_cfg)
+
+    # prefill both caches on the prompt; the first emitted token is the
+    # target's greedy continuation
+    (t_logits, _), t_cache = target.apply(
+        {"params": target_params}, prompt, return_aux=True, decode=True,
+        mutable=["cache"])
+    (_, _), d_cache = draft.apply(
+        {"params": draft_params}, prompt, return_aux=True, decode=True,
+        mutable=["cache"])
+    first = jnp.argmax(t_logits[:, -1, :], axis=-1)  # [B]
+
+    tokens = jnp.zeros((batch, total + gamma + 1), jnp.int32)
+    tokens = tokens.at[:, :prompt_len].set(prompt)
+    tokens = tokens.at[:, prompt_len].set(first)
+
+    def position(n):  # [B, 1] global position for a single-token step
+        return jnp.broadcast_to(n, (batch, 1))
+
+    def draft_one(cache, tok, pos):
+        (logits, _), new_cache = draft.apply(
+            {"params": draft_params, **cache}, tok[:, None],
+            return_aux=True, decode=True, positions=position(pos),
+            mutable=["cache"])
+        return new_cache, jnp.argmax(logits[:, -1, :], axis=-1)
+
+    def body(carry):
+        tokens, t_cache, d_cache, n, steps = carry
+        # n = index of the next token to produce; tokens[:, n-1] is the
+        # last accepted token.  Draft gamma greedy continuations.
+        def scan_step(c, i):
+            cache, tok = c
+            # tok is the token AT position n-1+i; its consumption writes
+            # cache index n-1+i (kept aligned by the rewinds)
+            cache, nxt = draft_one(cache, tok, n - 1 + i)
+            return (cache, nxt), nxt
+
+        last = tokens[jnp.arange(batch), n - 1]
+        (d_cache2, _), proposals = jax.lax.scan(
+            scan_step, (d_cache, last), jnp.arange(gamma))
+        proposals = jnp.moveaxis(proposals, 0, 1)       # [B, gamma]
+
+        # one target pass over [last, proposals]: logits[i] scores the
+        # continuation AFTER consuming token i of the block
+        block = jnp.concatenate([last[:, None], proposals], axis=1)
+        positions = n - 1 + jnp.broadcast_to(
+            jnp.arange(gamma + 1), (batch, gamma + 1))
+        (logits, _), t_cache2 = target.apply(
+            {"params": target_params, **t_cache}, block, return_aux=True,
+            decode=True, positions=positions, mutable=["cache"])
+        greedy = jnp.argmax(logits, axis=-1)            # [B, gamma+1]
+
+        agree = (greedy[:, :gamma] == proposals)
+        m = jnp.min(jnp.sum(jnp.cumprod(agree.astype(jnp.int32),
+                                        axis=1), axis=1))
+        # cap at gamma-1: the draft only consumed its first gamma-1
+        # proposals (it never sees its own last one), so accepting all
+        # gamma would leave position n+gamma-1 missing from the draft
+        # cache after the rewind.  Costs at most one token per round.
+        m = jnp.minimum(m, gamma - 1)
+        # emit the m accepted proposals + the target's correction; exact
+        # for every row (rows accepting > m agreed at position m anyway)
+        width = tokens.shape[1]
+        col = jnp.arange(width)[None, :]
+        sel = (col >= n) & (col <= n + m)
+        src_idx = jnp.clip(col - n, 0, gamma - 1)
+        # place proposals[:, col - n] wherever sel; gather along axis 1
+        gathered = jnp.take_along_axis(
+            proposals, jnp.broadcast_to(src_idx, (batch, width)), axis=1)
+        # correction token sits at n+m regardless of how many proposals
+        # were accepted
+        corr = greedy[jnp.arange(batch), m]
+        gathered = jnp.where(col == n + m, corr[:, None], gathered)
+        tokens = jnp.where(sel, gathered, tokens)
+
+        # rewind both caches to the accepted frontier: the target
+        # consumed gamma+1 positions from n-1, the draft gamma from n
+        t_cache2 = _rewind(t_cache2, n + m)
+        d_cache2 = _rewind(d_cache2, n + m)
+        return tokens, t_cache2, d_cache2, n + m + 1, steps + 1
+
+    def cond(carry):
+        *_, n, _steps = carry
+        return n < total
+
+    tokens, _, _, n, steps = jax.lax.while_loop(
+        cond, body, (tokens, t_cache, d_cache,
+                     jnp.int32(prompt_len + 1), jnp.int32(0)))
+    return tokens[:, :total], steps
+
+
+__all__ = ["speculative_generate"]

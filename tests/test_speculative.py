@@ -1,0 +1,72 @@
+"""Speculative decoding: EXACTNESS is the whole contract.
+
+Greedy speculative output must be token-identical to the target's own
+greedy decode — with a perfect draft (the target itself), with a
+different tiny draft, and across batch rows (min-acceptance semantics).
+The steps counter pins the speed mechanics: a perfect draft finishes in
+~N/gamma rounds, a garbage draft degrades toward one token per round but
+never changes the tokens.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.configs import TINY
+from kubeflow_tpu.models.generate import generate
+from kubeflow_tpu.models.speculative import speculative_generate
+from kubeflow_tpu.models.transformer import Transformer
+
+
+def _params(cfg, seed=0):
+    return Transformer(cfg).init(jax.random.PRNGKey(seed),
+                                 jnp.ones((1, 8), jnp.int32))["params"]
+
+
+class TestSpeculative:
+    def _check_exact(self, draft_cfg, draft_params, gamma, n_new=12):
+        cfg = TINY
+        params = _params(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0,
+                                    cfg.vocab_size)
+        ref = generate(cfg, params, prompt, max_new_tokens=n_new)
+        out, steps = speculative_generate(
+            cfg, params, draft_cfg, draft_params, prompt, n_new,
+            gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        return int(steps)
+
+    def test_perfect_draft_is_exact_and_fast(self):
+        """Draft == target: full acceptance every round -> ~N/(gamma-1+1)
+        rounds (acceptance caps at gamma-1, +1 correction token)."""
+        cfg = TINY
+        params = _params(cfg)
+        steps = self._check_exact(cfg, params, gamma=4, n_new=12)
+        # 12 tokens, gamma-1=3 accepted + 1 correction per round = 4/round
+        # (first token comes from prefill) -> ceil(11/4) = 3 rounds
+        assert steps <= 4, steps
+
+    def test_mismatched_draft_is_still_exact(self):
+        """A differently-initialized draft (garbage agreement) must not
+        change a single output token."""
+        draft_cfg = TINY.with_(num_layers=1, embed_dim=32, num_heads=2,
+                               num_kv_heads=1, head_dim=16, mlp_dim=64)
+        draft_params = _params(draft_cfg, seed=7)
+        steps = self._check_exact(draft_cfg, draft_params, gamma=4,
+                                  n_new=12)
+        # garbage draft: close to one token per round, never more than N
+        assert steps <= 12, steps
+
+    def test_gamma_guard(self):
+        cfg = TINY
+        params = _params(cfg)
+        prompt = jnp.ones((1, 4), jnp.int32)
+        try:
+            speculative_generate(cfg, params, cfg, params, prompt, 4,
+                                 gamma=1)
+        except ValueError as e:
+            assert "gamma" in str(e)
+        else:
+            raise AssertionError("gamma=1 should be rejected")
