@@ -263,10 +263,19 @@ def _quantize_kernel_int4(kernel: jax.Array, n_contract: int = 1) -> dict:
 def quantize_params_int4(params,
                          skip: tuple = ("embed", "router", "experts")):
     """Trained params -> the Int4DenseGeneral tree (see quantize_params
-    for the walk/skips; int4 ignores the stacked layout — decode always
-    unrolls).  The attention out projection ([heads, head_dim, embed]) is
-    the model family's one multi-dim-contract kernel; everything else
-    contracts a single leading dim."""
+    for the walk/skips).  A stacked scan_layers=True training tree is
+    unrolled first (decode always unrolls; the layer count comes from the
+    stacked leading dim).  The attention out projection
+    ([heads, head_dim, embed]) is the model family's one
+    multi-dim-contract kernel; everything else contracts a single
+    leading dim."""
+    params = nn.unbox(params)
+    if isinstance(params, dict) and "layers" in params:
+        from .generate import unroll_params
+
+        num_layers = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+        params = unroll_params(params, num_layers)
+
     def walk(node, name=""):
         if isinstance(node, dict):
             if name in skip:

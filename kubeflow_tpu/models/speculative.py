@@ -59,8 +59,10 @@ def speculative_generate(
     """prompt [B, P] -> ([B, P + max_new_tokens] greedy tokens,
     outer_steps) — token-identical to `generate(target_cfg, ...)` with
     temperature=0; `outer_steps` (a traced scalar) is the number of
-    draft-verify rounds, the speed diagnostic (ideal = ceil(N/(gamma+1))
-    at full acceptance, N at zero acceptance)."""
+    draft-verify rounds, the speed diagnostic.  A round emits at most
+    gamma tokens (gamma-1 accepted + 1 correction, see the acceptance
+    cap below) and the first token comes from prefill, so the ideal is
+    ceil((N-1)/gamma) rounds at full acceptance, N-1 at zero."""
     if gamma < 2:
         raise ValueError("gamma must be >= 2 (acceptance caps at gamma-1)")
     t_cfg = decode_config(target_cfg)

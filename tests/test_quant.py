@@ -102,6 +102,22 @@ class TestInt4:
         # int4 with group-128 scales: |err| <= absmax/7 per group
         assert err < float(np.max(np.abs(np.asarray(k)))) / 6.0
 
+    def test_int4_accepts_stacked_training_params(self):
+        """The default scan_layers=True training tree quantizes directly
+        (unrolled internally — decode always unrolls)."""
+        from kubeflow_tpu.models.quant import quantize_params_int4
+
+        cfg = self._cfg().with_(scan_layers=True)
+        params = Transformer(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        q = quantize_params_int4(params)
+        assert "layers" not in q and "layer_0" in q
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0,
+                                    cfg.vocab_size)
+        out = generate(cfg.with_(weight_dtype="int4"), q, prompt,
+                       max_new_tokens=3)
+        assert out.shape == (1, 8)
+
     def test_int4_generate_tracks_dense(self):
         from kubeflow_tpu.models.quant import quantize_params_int4
 
