@@ -85,9 +85,13 @@ def time_variant(name: str, cfg, batch: int, prompt_len: int,
 
 def main() -> None:
     quick = "--quick" in sys.argv
-    print("measuring achievable HBM read bandwidth...")
-    gbps = measure_hbm_read_gbps()
-    print(f"hbm read: {gbps:.0f} GB/s achievable (spec 819)")
+    if "--probe-bw" in sys.argv:
+        # NOTE: this probe reads ~55 GB/s — useless through the relay
+        # (~120ms fixed round-trip swamps sub-second measurements; the
+        # decode loop itself demonstrates 540+ GB/s effective).  Kept
+        # behind a flag for when the code runs without the relay.
+        gbps = measure_hbm_read_gbps()
+        print(f"hbm read probe: {gbps:.0f} GB/s (spec 819; see note)")
 
     batch, prompt_len, new_tokens = 16, 128, 256
     base = BENCH_CHIP.with_(max_seq_len=prompt_len + new_tokens)
@@ -106,8 +110,8 @@ def main() -> None:
                      unroll_layers=unroll)
 
     t = decode_traffic_bytes(decode_config(base), batch)
-    honest_roofline = gbps * 1e9 / t["total"] * batch
-    print(f"honest roofline @ measured bw: {honest_roofline:,.0f} tok/s "
+    spec_roofline = 819e9 / t["total"] * batch
+    print(f"honest roofline @ spec bw: {spec_roofline:,.0f} tok/s "
           f"(weights {t['weight_bytes']/1e6:.0f}MB + kv {t['kv_bytes']/1e6:.0f}MB)")
 
 
