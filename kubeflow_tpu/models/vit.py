@@ -36,6 +36,21 @@ VIT_TINY = ViTConfig(
 )
 
 
+def vit_flops_per_image(cfg: ViTConfig) -> float:
+    """Training (fwd+bwd) matmul FLOPs per image — the same 6x-activated-
+    params convention as the decoder's MFU accounting (configs.py
+    flops_per_token), with the BIDIRECTIONAL attention term 12*L*S*D (no
+    causal halving)."""
+    tokens = (cfg.image_size // cfg.patch_size) ** 2
+    d = cfg.embed_dim
+    per_layer = 4 * d * d + 2 * d * cfg.mlp_dim
+    matmul_params = (cfg.num_layers * per_layer
+                     + cfg.patch_size * cfg.patch_size * 3 * d
+                     + d * cfg.num_classes)
+    attn = 12 * cfg.num_layers * tokens * d
+    return (6.0 * matmul_params + attn) * tokens
+
+
 class ViTBlock(nn.Module):
     cfg: ViTConfig
 
