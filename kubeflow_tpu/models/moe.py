@@ -57,22 +57,24 @@ def load_balance_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
 
 
 class _ExpertFFN(nn.Module):
-    """One expert's gated MLP; vmapped over the expert axis by MoEMLP."""
+    """One expert's gated MLP; vmapped over the expert axis by MoEMLP.
+    Uses the transformer's dense factory so `weight_dtype="int8"` serves
+    quantized experts (the vmap stacks the int8 kernels on the expert
+    axis exactly like the dense kernels)."""
 
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x):  # [tokens..., D]
+        from .transformer import _dense
+
         cfg = self.cfg
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         mlp_dim = cfg.moe_mlp_dim or cfg.mlp_dim
 
         def dense(features, axes, name):
-            return nn.DenseGeneral(
-                features, use_bias=False, dtype=dtype, param_dtype=pdtype,
-                kernel_init=nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), axes),
-                name=name)
+            return _dense(features, axes, name, dtype, pdtype,
+                          weight_dtype=cfg.weight_dtype)
 
         gate = dense(mlp_dim, ("embed", "mlp"), "gate")(x)
         up = dense(mlp_dim, ("embed", "mlp"), "up")(x)

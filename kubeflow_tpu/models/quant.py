@@ -75,36 +75,36 @@ class Int8DenseGeneral(nn.Module):
         )
 
 
-def _quantize_kernel(kernel: jax.Array, stacked: bool = False) -> dict:
+def _quantize_kernel(kernel: jax.Array, lead: int = 0) -> dict:
     """Symmetric per-LAST-dim absmax int8: one scale per slot of the
     kernel's final dimension, shared across every other dim.  Exact
     per-output-channel for rank-2 kernels ([in, out]); coarser for
     multi-dim features ([in, heads, head_dim] shares a scale across
     heads) — the tree transform cannot know how many trailing dims are
     features, and the last dim is always an output dim in this model's
-    layouts.  `stacked` additionally keeps the leading scan-layer axis
-    (kernels [L, ..., out] quantize per layer, scales [L, 1, ..., out] —
-    what nn.scan's variable_axes slicing expects)."""
+    layouts.  `lead` keeps that many leading STACK axes per-slice
+    (scan layers: [L, ..., out] -> scales [L, 1, ..., out]; vmapped
+    experts add another: [L, E, ..., out] -> [L, E, 1, ..., out]) —
+    what nn.scan/nn.vmap variable_axes slicing expects."""
     k32 = kernel.astype(jnp.float32)
-    axes = tuple(range(1 if stacked else 0, k32.ndim - 1))
+    axes = tuple(range(lead, k32.ndim - 1))
     absmax = jnp.max(jnp.abs(k32), axis=axes, keepdims=True)
     scale = jnp.maximum(absmax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(k32 / scale), -127, 127).astype(jnp.int8)
     return {"kernel_q": q, "kernel_scale": scale.astype(jnp.bfloat16)}
 
 
-def quantize_params(params,
-                    skip: tuple = ("embed", "router", "experts")) -> Any:
+def quantize_params(params, skip: tuple = ("embed", "router")) -> Any:
     """Trained params -> the tree Int8DenseGeneral expects.
 
     Every dict holding a `kernel` leaf is rewritten to
     {kernel_q, kernel_scale}; subtrees named in `skip` and non-kernel
     params (norm scales) pass through unchanged.  The default skip list:
-    the embedding (a lookup, not a weight stream), the MoE router
-    (fp32 on purpose — routing is precision-sensitive, moe.py), and the
-    expert FFNs (MoEMLP has no int8 module yet — quantizing their
-    kernels would produce a tree the model cannot consume)."""
-    def walk(node, name="", stacked=False):
+    the embedding (a lookup, not a weight stream) and the MoE router
+    (fp32 on purpose — routing is precision-sensitive, moe.py).  The
+    expert FFNs quantize per expert: their kernels carry a leading
+    expert axis from nn.vmap, handled like the scan-layer stack."""
+    def walk(node, name="", lead=0):
         if isinstance(node, dict):
             if name in skip:
                 return node
@@ -112,8 +112,9 @@ def quantize_params(params,
                 rest = {k: v for k, v in node.items() if k != "kernel"}
                 return {**rest,
                         **_quantize_kernel(nn.unbox(node["kernel"]),
-                                           stacked=stacked)}
-            return {k: walk(v, k, stacked or k == "layers")
+                                           lead=lead)}
+            return {k: walk(v, k,
+                            lead + (1 if k in ("layers", "experts") else 0))
                     for k, v in node.items()}
         return node
 

@@ -75,6 +75,38 @@ class TestQuantizeParams:
         assert decode_config(
             TINY.with_(weight_dtype="int8")).weight_dtype == "int8"
 
+    def test_moe_int8_tracks_full_precision(self):
+        """Expert FFNs quantize per expert (stacked lead axis from
+        nn.vmap); the router stays fp32 so routing is UNCHANGED and the
+        whole MoE model tracks full precision."""
+        cfg = TINY.with_(moe_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
+        params = _params(cfg)
+        q = quantize_params(params)
+        # router kernel untouched; expert kernels quantized per expert
+        layers = q["layers"] if "layers" in q else q["layer_0"]
+        assert "kernel" in layers["moe"]["router"]
+        ek = layers["moe"]["experts"]["gate"]
+        assert ek["kernel_q"].dtype == jnp.int8
+        # scales keep the (layers, experts) lead axes per-slice
+        assert ek["kernel_scale"].shape[:2] == ek["kernel_q"].shape[:2]
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        dense, _ = Transformer(cfg).apply({"params": params}, tokens,
+                                          return_aux=True)
+        qout, _ = Transformer(cfg.with_(weight_dtype="int8")).apply(
+            {"params": q}, tokens, return_aux=True)
+        a = np.asarray(dense, np.float32).ravel()
+        b = np.asarray(qout, np.float32).ravel()
+        cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+        assert cos > 0.999, cos
+
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                                    cfg.vocab_size)
+        out = generate(cfg.with_(weight_dtype="int8"), q, prompt,
+                       max_new_tokens=4)
+        assert out.shape == (2, 9)
+
 
 class TestInt4:
     """Nibble-packed int4 with group scales: decode must still track the
