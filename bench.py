@@ -110,12 +110,15 @@ def main_decode(num_steps: int) -> None:
         np.asarray(run(params, p))
         dt = time.perf_counter() - t0
         best = max(best, batch * new_tokens / dt)
-    if int8 or int4:
-        from kubeflow_tpu.models.quant import quantized_bytes
+    from kubeflow_tpu.models.quant import quantized_bytes
 
-        param_bytes = quantized_bytes(params)  # quantized kernels + scales
-    else:
-        param_bytes = config.num_params * 2  # bf16
+    # Streamed bytes per step: every matmul weight once.  The embedding
+    # table (vocab*d) is a per-token row lookup and does NOT stream —
+    # counting it understated the roofline ~10% at this scale (round-4
+    # advisor finding) — EXCEPT for tied configs, where the table is the
+    # LM-head matmul weight (transformer.py head()) and streams fully.
+    exclude = () if config.tie_embeddings else ("embed",)
+    param_bytes = quantized_bytes(params, exclude=exclude)
     kv_bytes = (2 * batch * config.max_seq_len * config.num_kv_heads
                 * config.head_dim * 2 * config.num_layers)
     roofline_steps = (ACCELERATORS[accel].hbm_gbps * 1e9

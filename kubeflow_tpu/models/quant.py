@@ -121,12 +121,29 @@ def quantize_params(params, skip: tuple = ("embed", "router")) -> Any:
     return walk(nn.unbox(params))
 
 
-def quantized_bytes(params) -> int:
-    """HBM bytes one decode step streams with the quantized tree."""
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(params):
-        total += leaf.size * leaf.dtype.itemsize
-    return total
+def quantized_bytes(params, exclude: tuple = ("embed",)) -> int:
+    """HBM bytes one decode step STREAMS with the quantized tree.
+
+    Subtrees named in `exclude` are not counted: the embedding table is a
+    per-token row lookup (B rows/step), not a full weight stream, so
+    counting it would understate the roofline ceiling and flatter the
+    achieved fraction (round-4 advisor finding — ~4% at 7B scale).  The
+    untied LM head DOES stream (it is a full [embed, vocab] matmul) and
+    lives outside the "embed" subtree, so it counts.  For tied-embedding
+    configs pass exclude=() — the table then is the head matmul weight.
+    Pass exclude=() as well to get total-resident bytes for capacity
+    math."""
+    from collections.abc import Mapping
+
+    def walk(node, name=""):
+        if isinstance(node, Mapping):
+            if name in exclude:
+                return 0
+            return sum(walk(v, k) for k, v in node.items())
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(nn.unbox(node)))
+
+    return walk(params)
 
 
 # ---------------------------------------------------------------------------
@@ -261,16 +278,27 @@ def _quantize_kernel_int4(kernel: jax.Array, n_contract: int = 1) -> dict:
                                         ).astype(jnp.bfloat16)}
 
 
-def quantize_params_int4(params,
-                         skip: tuple = ("embed", "router", "experts")):
+def quantize_params_int4(params, skip: tuple = ("embed", "router")):
     """Trained params -> the Int4DenseGeneral tree (see quantize_params
-    for the walk/skips).  A stacked scan_layers=True training tree is
+    for the walk/skips).  MoE trees are REJECTED outright (ValueError
+    below) rather than skipped — the int4 Transformer would build
+    Int4DenseGeneral for expert kernels and fail on the missing
+    kernel_q4 params.  A stacked scan_layers=True training tree is
     unrolled first (decode always unrolls; the layer count comes from the
     stacked leading dim).  The attention out projection
     ([heads, head_dim, embed]) is the model family's one
     multi-dim-contract kernel; everything else contracts a single
     leading dim."""
     params = nn.unbox(params)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    if any(any(getattr(k, "key", None) == "experts" for k in path)
+           for path, _ in flat):
+        raise ValueError(
+            "quantize_params_int4 cannot quantize MoE expert kernels: the "
+            "flat nibble-packed layout does not survive nn.vmap expert "
+            "stacking, and the int4 Transformer would look for kernel_q4 "
+            "params it skips.  Use quantize_params (int8) for MoE serving."
+        )
     if isinstance(params, dict) and "layers" in params:
         from .generate import unroll_params
 

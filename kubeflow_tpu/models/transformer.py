@@ -275,6 +275,17 @@ class Transformer(nn.Module):
 
     def setup(self):
         cfg = self.cfg
+        if cfg.moe_experts > 0 and cfg.weight_dtype == "int4":
+            # quantize_params_int4 skips expert kernels (its flat packed
+            # layout does not survive nn.vmap stacking), but _ExpertFFN
+            # would still build Int4DenseGeneral for them — apply would
+            # fail deep inside flax with a missing-kernel_q4 error.  Fail
+            # loudly here instead; int8 covers MoE serving (moe.py).
+            raise ValueError(
+                "weight_dtype='int4' does not support MoE configs "
+                "(moe_experts > 0): int4 packing covers dense kernels "
+                "only.  Use weight_dtype='int8' for quantized MoE serving."
+            )
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         self.embed = nn.Embed(
             cfg.vocab_size,

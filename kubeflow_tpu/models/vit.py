@@ -45,10 +45,12 @@ def vit_flops_per_image(cfg: ViTConfig) -> float:
     d = cfg.embed_dim
     per_layer = 4 * d * d + 2 * d * cfg.mlp_dim
     matmul_params = (cfg.num_layers * per_layer
-                     + cfg.patch_size * cfg.patch_size * 3 * d
-                     + d * cfg.num_classes)
+                     + cfg.patch_size * cfg.patch_size * 3 * d)
     attn = 12 * cfg.num_layers * tokens * d
-    return (6.0 * matmul_params + attn) * tokens
+    # The classifier head runs ONCE per image (after global average pooling,
+    # ViT.__call__ below) — it must not be multiplied by the token count.
+    head = 6.0 * d * cfg.num_classes
+    return (6.0 * matmul_params + attn) * tokens + head
 
 
 class ViTBlock(nn.Module):

@@ -176,3 +176,52 @@ class TestInt4:
                        quantize_params_int4(params), prompt,
                        max_new_tokens=4)
         assert out.shape == (2, 9)
+
+
+class TestAdvisorGuards:
+    """Round-4 advisor findings: int4+MoE fails loudly, streamed-bytes
+    roofline excludes the embedding lookup."""
+
+    def test_int4_moe_config_raises(self):
+        cfg = TINY.with_(moe_experts=2, weight_dtype="int4")
+        try:
+            Transformer(cfg).init(jax.random.PRNGKey(0),
+                                  jnp.ones((1, 8), jnp.int32))
+            raise AssertionError("expected ValueError for int4 MoE")
+        except ValueError as e:
+            assert "int4" in str(e) and "MoE" in str(e)
+
+    def test_quantize_params_int4_rejects_expert_tree(self):
+        from kubeflow_tpu.models.quant import quantize_params_int4
+
+        cfg = TINY.with_(moe_experts=2, scan_layers=False)
+        params = _params(cfg)
+        try:
+            quantize_params_int4(params)
+            raise AssertionError("expected ValueError for expert kernels")
+        except ValueError as e:
+            assert "expert" in str(e)
+
+    def test_quantized_bytes_excludes_embedding(self):
+        params = _params(TINY)
+        q = quantize_params(params)
+        streamed = quantized_bytes(q)
+        resident = quantized_bytes(q, exclude=())
+        embed = TINY.vocab_size * TINY.embed_dim
+        # the embed table stays unquantized (fp32 here), so the delta is
+        # exactly its bytes
+        assert resident - streamed == embed * 4
+
+    def test_vit_head_flops_counted_once_per_image(self):
+        from kubeflow_tpu.models.vit import VIT_TINY, vit_flops_per_image
+
+        tokens = (VIT_TINY.image_size // VIT_TINY.patch_size) ** 2
+        base = vit_flops_per_image(VIT_TINY)
+        import dataclasses
+
+        doubled = dataclasses.replace(
+            VIT_TINY, num_classes=2 * VIT_TINY.num_classes)
+        # doubling the head adds 6*d*num_classes ONCE, not once per token
+        delta = vit_flops_per_image(doubled) - base
+        assert delta == 6.0 * VIT_TINY.embed_dim * VIT_TINY.num_classes, (
+            delta, tokens)
