@@ -238,6 +238,40 @@ def check_served_versions(c: Client) -> None:
     print("PASS served-versions conversion round-trip")
 
 
+def check_istio_routing(c: Client) -> None:
+    """USE_ISTIO contract (reference notebook_controller.go:558-699): a
+    Notebook yields a VirtualService `notebook-{ns}-{name}` whose single
+    http route prefix-matches /notebook/{ns}/{name}/, rewrites to the
+    same prefix by default, targets the Service on port 80 through the
+    configured gateway, and is removed with the Notebook."""
+    name = "conf-istio"
+    vs_path = (f"/apis/networking.istio.io/v1alpha3/namespaces/{c.ns}"
+               f"/virtualservices/notebook-{c.ns}-{name}")
+    status, _ = c.req("POST", c.nb_path(), {
+        "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+        "metadata": {"name": name},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "workbench:latest"}]}}},
+    })
+    assert status == 201, f"create returned {status}"
+    vs = wait(lambda: c.req("GET", vs_path)[1]
+              if c.req("GET", vs_path)[0] == 200 else None,
+              what="VirtualService rendered")
+    spec = vs["spec"]
+    assert spec.get("gateways"), spec
+    (route,) = spec["http"]
+    prefix = f"/notebook/{c.ns}/{name}/"
+    assert route["match"] == [{"uri": {"prefix": prefix}}], route["match"]
+    assert route["rewrite"] == {"uri": prefix}, route["rewrite"]
+    (dest,) = route["route"]
+    assert dest["destination"]["host"].startswith(f"{name}.{c.ns}.svc."), dest
+    assert dest["destination"]["port"] == {"number": 80}, dest
+    c.req("DELETE", c.nb_path(name))
+    wait(lambda: c.req("GET", vs_path)[0] == 404,
+         what="VirtualService cleanup")
+    print("PASS istio VirtualService routing contract")
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--server", required=True)
@@ -250,6 +284,9 @@ def main() -> int:
                         help="cluster has a real scheduler + TPU-capacity "
                              "nodes (fake device plugin): assert the gang "
                              "actually binds and worker env order is right")
+    parser.add_argument("--istio", action="store_true",
+                        help="controller runs with USE_ISTIO: assert the "
+                             "VirtualService routing contract")
     args = parser.parse_args()
     c = Client(args.server, args.namespace)
     check_cpu_lifecycle(c)
@@ -257,6 +294,8 @@ def main() -> int:
         check_served_versions(c)
     if not args.skip_tpu:
         check_tpu_topology(c, expect_scheduled=args.expect_scheduled)
+    if args.istio:
+        check_istio_routing(c)
     print("behavioral conformance: PASS")
     return 0
 

@@ -26,7 +26,10 @@ OUT=$(mktemp)
 # --fake-tpu-nodes 4: the in-memory analog of the kind lane's fake device
 # plugin — the TPU gang actually schedules, so the behavioral runner can
 # assert node binding (--expect-scheduled) here too
-python -m kubeflow_tpu.main --serve-api 0 --metrics-addr 0 --fake-tpu-nodes 4 >"$OUT" 2>&1 &
+# USE_ISTIO=true (exact string, reference parity notebook_controller.go:238):
+# the istio profile only ADDS a VirtualService per notebook, so the same
+# manager serves the base contract and the --istio leg
+USE_ISTIO=true python -m kubeflow_tpu.main --serve-api 0 --metrics-addr 0 --fake-tpu-nodes 4 >"$OUT" 2>&1 &
 MGR=$!
 trap 'kill $MGR 2>/dev/null || true; rm -f "$OUT"' EXIT
 URL=""
@@ -41,6 +44,6 @@ echo "== 2/3 apiserver wire-protocol fixtures ($URL) =="
 python -m kubeflow_tpu.kube.fixtures --server "$URL"
 
 echo "== 3/3 black-box behavioral contract =="
-python conformance/behavior.py --server "$URL" --expect-scheduled
+python conformance/behavior.py --server "$URL" --expect-scheduled --istio
 
 echo "notebook conformance: PASS"

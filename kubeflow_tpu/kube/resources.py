@@ -65,7 +65,9 @@ _BUILTIN: list[ResourceInfo] = [
     ResourceInfo("Gateway", "gateway.networking.k8s.io", "v1", "gateways"),
     ResourceInfo("ReferenceGrant", "gateway.networking.k8s.io", "v1beta1",
                  "referencegrants"),
-    ResourceInfo("VirtualService", "networking.istio.io", "v1beta1",
+    # v1alpha3 matches what the controller renders (workload.py
+    # generate_virtual_service; reference notebook_controller.go:581)
+    ResourceInfo("VirtualService", "networking.istio.io", "v1alpha3",
                  "virtualservices"),
     ResourceInfo("ImageStream", "image.openshift.io", "v1", "imagestreams"),
     ResourceInfo("Route", "route.openshift.io", "v1", "routes"),

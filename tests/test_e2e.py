@@ -297,3 +297,57 @@ class TestE2ENotebookLifecycle:
             ],
             f"{ctx.name}: owned StatefulSets garbage-collected",
         )
+
+
+@pytest.fixture(scope="module")
+def istio_stack():
+    """A second threaded stack with USE_ISTIO on — istio is a deploy-time
+    profile (reference: USE_ISTIO env read at manager start), so it gets
+    its own manager rather than a per-notebook context."""
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    mgr = Manager(api)
+    setup_core_controllers(mgr, CoreConfig(use_istio=True))
+    mgr.start()
+    yield api, cluster, mgr
+    mgr.stop()
+
+
+class TestE2EIstio:
+    """USE_ISTIO lifecycle against the live threaded manager — the e2e
+    analog of the reference's istio test lane
+    (install_istio.sh + notebook_controller.go:558-699)."""
+
+    NS, NAME = "e2e-istio", "istio-nb"
+    VS = "notebook-e2e-istio-istio-nb"
+
+    def test_phase_create(self, istio_stack):
+        api, _, _ = istio_stack
+        api.create(Notebook.new(self.NAME, self.NS).obj)
+        vs = wait_for(
+            lambda: api.try_get("VirtualService", self.NS, self.VS),
+            "VirtualService rendered")
+        (route,) = vs.body["spec"]["http"]
+        assert route["match"] == [
+            {"uri": {"prefix": f"/notebook/{self.NS}/{self.NAME}/"}}]
+        assert route["route"][0]["destination"]["host"] == \
+            f"{self.NAME}.{self.NS}.svc.cluster.local"
+
+    def test_phase_drift_repair(self, istio_stack):
+        api, _, mgr = istio_stack
+        vs = api.get("VirtualService", self.NS, self.VS)
+        vs.body["spec"]["gateways"] = ["intruder/gw"]
+        api.update(vs)
+        mgr.enqueue_all("notebook")
+        wait_for(
+            lambda: api.get("VirtualService", self.NS, self.VS)
+            .body["spec"]["gateways"] == ["kubeflow/kubeflow-gateway"],
+            "VirtualService drift reverted")
+
+    def test_phase_delete(self, istio_stack):
+        api, _, _ = istio_stack
+        api.delete("Notebook", self.NS, self.NAME)
+        wait_for(
+            lambda: api.try_get("VirtualService", self.NS, self.VS) is None,
+            "VirtualService garbage-collected with the Notebook")

@@ -1,0 +1,166 @@
+"""Istio VirtualService path under USE_ISTIO — the analog of the
+reference's istio integration surface
+(notebook_controller.go:558-699: generateVirtualService +
+reconcileVirtualService with CopyVirtualService drift repair).
+
+Covers: rendering (prefix match, rewrite default + annotation override,
+destination host/port, gateway/host config, headers annotation incl. the
+malformed-JSON tolerance), reconcile wiring (created only when
+use_istio, owner reference, whole-spec drift copy), and the env surface
+(USE_ISTIO / ISTIO_GATEWAY / ISTIO_HOST / CLUSTER_DOMAIN)."""
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.core.workload import generate_virtual_service
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+
+class TestRendering:
+    def _nb(self, annotations=None):
+        return Notebook.new("my-nb", "user1", annotations=annotations)
+
+    def test_shape_matches_reference(self):
+        vs = generate_virtual_service(self._nb(), CoreConfig())
+        assert vs.api_version == "networking.istio.io/v1alpha3"
+        assert vs.kind == "VirtualService"
+        # virtualServiceName(name, ns) = notebook-{ns}-{name}
+        # (notebook_controller.go:555)
+        assert vs.name == "notebook-user1-my-nb"
+        assert vs.namespace == "user1"
+        spec = vs.body["spec"]
+        assert spec["hosts"] == ["*"]
+        assert spec["gateways"] == ["kubeflow/kubeflow-gateway"]
+        (route,) = spec["http"]
+        assert route["match"] == [
+            {"uri": {"prefix": "/notebook/user1/my-nb/"}}]
+        # default rewrite falls back to the prefix itself
+        assert route["rewrite"] == {"uri": "/notebook/user1/my-nb/"}
+        (dest,) = route["route"]
+        assert dest["destination"]["host"] == \
+            "my-nb.user1.svc.cluster.local"
+        assert dest["destination"]["port"] == {"number": 80}
+
+    def test_config_overrides(self):
+        cfg = CoreConfig(istio_gateway="ns/gw", istio_host="nb.example.com",
+                         cluster_domain="corp.local")
+        spec = generate_virtual_service(self._nb(), cfg).body["spec"]
+        assert spec["hosts"] == ["nb.example.com"]
+        assert spec["gateways"] == ["ns/gw"]
+        assert spec["http"][0]["route"][0]["destination"]["host"] == \
+            "my-nb.user1.svc.corp.local"
+
+    def test_env_surface(self, monkeypatch):
+        monkeypatch.setenv("USE_ISTIO", "true")
+        monkeypatch.setenv("ISTIO_GATEWAY", "g/w")
+        monkeypatch.setenv("ISTIO_HOST", "h.example.com")
+        monkeypatch.setenv("CLUSTER_DOMAIN", "env.local")
+        cfg = CoreConfig.from_env()
+        assert cfg.use_istio and cfg.istio_gateway == "g/w"
+        spec = generate_virtual_service(self._nb(), cfg).body["spec"]
+        assert spec["hosts"] == ["h.example.com"]
+        assert spec["http"][0]["route"][0]["destination"]["host"].endswith(
+            "svc.env.local")
+
+    def test_rewrite_annotation_override(self):
+        nb = self._nb({C.ANNOTATION_REWRITE_URI: "/custom/path/"})
+        route = generate_virtual_service(nb, CoreConfig()).body["spec"]["http"][0]
+        assert route["rewrite"] == {"uri": "/custom/path/"}
+        # empty/whitespace annotation falls back to the prefix
+        # (reference: len check, notebook_controller.go:572-574)
+        nb = self._nb({C.ANNOTATION_REWRITE_URI: "  "})
+        route = generate_virtual_service(nb, CoreConfig()).body["spec"]["http"][0]
+        assert route["rewrite"] == {"uri": "/notebook/user1/my-nb/"}
+
+    def test_headers_annotation(self):
+        nb = self._nb({C.ANNOTATION_HEADERS_REQUEST_SET:
+                       '{"X-Forwarded-Prefix": "/notebook/user1/my-nb"}'})
+        route = generate_virtual_service(nb, CoreConfig()).body["spec"]["http"][0]
+        assert route["headers"] == {
+            "request": {"set": {"X-Forwarded-Prefix": "/notebook/user1/my-nb"}}}
+
+    def test_malformed_headers_annotation_tolerated(self):
+        # reference decodes into an empty map on bad JSON
+        # (notebook_controller.go:609-613); here the headers section is
+        # simply omitted — the same no-op VirtualService semantics
+        nb = self._nb({C.ANNOTATION_HEADERS_REQUEST_SET: "{not json"})
+        route = generate_virtual_service(nb, CoreConfig()).body["spec"]["http"][0]
+        assert "headers" not in route
+
+
+@pytest.fixture()
+def istio_env():
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    mgr = Manager(api, clock=FakeClock())
+    setup_core_controllers(mgr, CoreConfig(use_istio=True),
+                           NotebookMetrics(api))
+    return api, cluster, mgr
+
+
+class TestReconcile:
+    def _create(self, api, mgr, name="test-nb", ns="user1", annotations=None):
+        nb = Notebook.new(name, ns, annotations=annotations)
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        return nb
+
+    def test_created_with_owner_reference(self, istio_env):
+        api, _, mgr = istio_env
+        self._create(api, mgr)
+        vs = api.get("VirtualService", "user1", "notebook-user1-test-nb")
+        (owner,) = vs.metadata.owner_references
+        assert owner.kind == "Notebook" and owner.name == "test-nb"
+        assert owner.controller is True
+
+    def test_not_created_without_flag(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n", allocatable={"cpu": "64", "memory": "256Gi"})
+        mgr = Manager(api, clock=FakeClock())
+        setup_core_controllers(mgr, CoreConfig(use_istio=False),
+                               NotebookMetrics(api))
+        api.create(Notebook.new("test-nb", "user1").obj)
+        mgr.run_until_idle()
+        assert api.try_get("VirtualService", "user1",
+                           "notebook-user1-test-nb") is None
+
+    def test_drift_reverted_whole_spec(self, istio_env):
+        # CopyVirtualService copies the whole desired spec over the found
+        # one (util.go:199-219 via reconcilehelper.copy_spec)
+        api, _, mgr = istio_env
+        self._create(api, mgr)
+        vs = api.get("VirtualService", "user1", "notebook-user1-test-nb")
+        vs.body["spec"]["gateways"] = ["intruder/gateway"]
+        vs.body["spec"]["http"][0]["timeout"] = "1s"
+        api.update(vs)
+        mgr.run_until_idle()
+        spec = api.get("VirtualService", "user1",
+                       "notebook-user1-test-nb").body["spec"]
+        assert spec["gateways"] == ["kubeflow/kubeflow-gateway"]
+        assert spec["http"][0]["timeout"] == "300s"
+
+    def test_annotation_change_propagates(self, istio_env):
+        api, _, mgr = istio_env
+        self._create(api, mgr)
+        nb = api.get("Notebook", "user1", "test-nb")
+        nb.metadata.annotations[C.ANNOTATION_REWRITE_URI] = "/new/"
+        api.update(nb)
+        mgr.run_until_idle()
+        route = api.get("VirtualService", "user1",
+                        "notebook-user1-test-nb").body["spec"]["http"][0]
+        assert route["rewrite"] == {"uri": "/new/"}
+
+    def test_deleted_with_notebook(self, istio_env):
+        api, _, mgr = istio_env
+        self._create(api, mgr)
+        api.delete("Notebook", "user1", "test-nb")
+        mgr.run_until_idle()
+        assert api.try_get("VirtualService", "user1",
+                           "notebook-user1-test-nb") is None
