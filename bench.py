@@ -228,7 +228,10 @@ def main(long_context: bool = False, moe: bool = False) -> None:
     accel = accelerator_from_device_kind(devices[0].device_kind)
 
     config = BENCH_CHIP
-    batch, seq = 48, 2048
+    batch, seq = 40, 2048  # round-5 sweep (ci/sweep_r5_results.jsonl):
+    # batch 48 OOMs at 256x512/512x512 tiles (512x256 fits but measures
+    # ~0.34); batch 40 with the 1024x512 tiles sustains 34.0k tok/s =
+    # 0.475 MFU across 5 agreeing windows
     if moe:
         # MoE config (configs.BENCH_MOE): 4 experts, top-2, ~0.76B total /
         # ~0.48B activated.  batch 16 is the largest 16-GiB fit (the

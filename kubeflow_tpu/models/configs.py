@@ -47,9 +47,11 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_mlp_dim: int = 0             # per-expert hidden; 0 = mlp_dim
     moe_aux_weight: float = 0.01     # load-balance loss weight
-    moe_dispatch: str = "einsum"     # einsum (GShard one-hot) | sort
-                                     # (argsort scatter/gather — skips the
-                                     # O(E*C*D) dispatch FLOPs)
+    moe_dispatch: str = "einsum"     # einsum (GShard one-hot) | hybrid
+                                     # (einsum dispatch + gather combine —
+                                     # halves the O(E*C*D) overhead) | sort
+                                     # (argsort scatter/gather — skips it
+                                     # entirely, loses on TPU at small E)
 
     def with_(self, **kw) -> "TransformerConfig":
         return replace(self, **kw)
@@ -142,8 +144,15 @@ BENCH_CHIP = TransformerConfig(
     max_seq_len=2048,
     attention_impl="flash",
     loss_chunks=32,
-    flash_block_q=256,
-    flash_block_k=256,
+    # round-5 re-sweep (ci/mfu_sweep_r5.py, ci/sweep_r5_results.jsonl):
+    # batch 40 with 1024x512 flash tiles sustains 0.475 MFU / 34.0k tok/s
+    # (5 agreeing bench windows) vs the round-3 batch-48/256x256 config's
+    # 0.391 best-of-windows — the bigger kv tile is what the 4k config
+    # already proved out (flash efficiency, not batch, was the 2k
+    # bottleneck); at batch 48 the 256x512/512x512 pairs OOM and 512x256
+    # measures ~0.34
+    flash_block_q=1024,
+    flash_block_k=512,
 )
 
 # single-chip MoE bench config: BENCH_CHIP's trunk with the dense MLP
@@ -160,6 +169,11 @@ BENCH_MOE = BENCH_CHIP.with_(
     # capacity 1.0 measured ~8% faster than 1.25 (ci/moe sweep, round 4):
     # the dispatch/combine einsums and expert buffers scale with C
     moe_capacity_factor=1.0,
+    # tiles pinned: the round-5 1024x512 dense tiles are NOT inherited
+    # blindly — the MoE batch-16 fit and numbers were established under
+    # 256x256 (round 4); the round-5 MoE sweep re-decides these
+    flash_block_q=256,
+    flash_block_k=256,
 )
 
 # CI/test config: tiny but structurally identical (GQA, scan, remat)
