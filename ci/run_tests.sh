@@ -22,4 +22,25 @@ if python -c "import mypy" 2>/dev/null; then
   echo "== mypy =="
   python -m mypy kubeflow_tpu
 fi
-python -m pytest tests/ -q "$@"
+# Lanes (tests/conftest.py markers): --lane controlplane is the fast
+# developer loop (~2 min, no XLA compiles of model graphs); --lane compute
+# is the XLA-heavy rest; default runs everything.  --lane is accepted at
+# any position; everything else passes through to pytest.
+LANE=""
+ARGS=()
+while [[ $# -gt 0 ]]; do
+  if [[ "$1" == "--lane" ]]; then
+    LANE="${2:?--lane requires a value (controlplane|compute)}"; shift 2
+  else
+    ARGS+=("$1"); shift
+  fi
+done
+if [[ -n "$LANE" ]]; then
+  case "$LANE" in
+    controlplane|compute) ;;
+    *) echo "unknown lane '$LANE' (want controlplane|compute)" >&2; exit 2 ;;
+  esac
+  python -m pytest tests/ -q -m "$LANE" ${ARGS+"${ARGS[@]}"}
+else
+  python -m pytest tests/ -q ${ARGS+"${ARGS[@]}"}
+fi

@@ -22,3 +22,51 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- suite lanes ------------------------------------------------------------
+# The suite splits into two lanes so CI can run them as separate jobs and
+# developers get a fast control-plane loop (the compute lane is dominated
+# by XLA compiles):
+#   pytest -m controlplane   (~2 min: kube substrate, controllers, odh)
+#   pytest -m compute        (models/ops/parallel/runtime; XLA-heavy)
+_COMPUTE_MODULES = {
+    "test_compute", "test_data", "test_generate", "test_moe",
+    "test_pipeline", "test_quant", "test_runtime", "test_speculative",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "compute: XLA-compile-heavy compute-plane tests")
+    config.addinivalue_line(
+        "markers", "controlplane: in-memory control-plane tests (fast lane)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import re
+
+    import pytest
+
+    # fail-open guard: a module is XLA-heavy iff it imports the compute
+    # plane — a new model-test module missing from _COMPUTE_MODULES must
+    # fail collection loudly, not silently join the fast lane
+    compute_import = re.compile(
+        r"kubeflow_tpu\.(models|ops|parallel|runtime)\b")
+    jax_import = re.compile(r"^\s*(?:import|from)\s+jax\b", re.M)
+    seen_modules = {}
+    for item in items:
+        module = item.module.__name__.rsplit(".", 1)[-1]
+        if module not in seen_modules:
+            src = open(item.module.__file__).read()
+            heavy = bool(compute_import.search(src) or jax_import.search(src))
+            if heavy != (module in _COMPUTE_MODULES):
+                raise pytest.UsageError(
+                    f"{module} {'imports' if heavy else 'does not import'} "
+                    "the compute plane but is "
+                    f"{'missing from' if heavy else 'listed in'} "
+                    "_COMPUTE_MODULES (tests/conftest.py) — keep the lane "
+                    "split honest")
+            seen_modules[module] = heavy
+        lane = "compute" if seen_modules[module] else "controlplane"
+        item.add_marker(getattr(pytest.mark, lane))
