@@ -14,6 +14,13 @@ protocol:
   - a real cluster (`python -m kubeflow_tpu.kube.fixtures --server URL`),
     which is how the transcripts themselves are validated.
 
+Known divergences are fixtures too: a fixture with an `expected_divergence`
+marker pins BOTH behaviors — each diverging step carries `expect` (this
+implementation, asserted by default so regressions in the documented
+behavior are caught) and `expect_real` (what a genuine kube-apiserver
+answers, asserted under `--real` so the divergence is adjudicated the day
+the replay runs against a real cluster).
+
 Fixture format — a JSON object:
   {"name": ..., "kube_semantics": "<what real k8s does, with source>",
    "steps": [{"op": "POST|GET|PUT|PATCH|DELETE|WATCH",
@@ -83,11 +90,14 @@ class FixtureRunner:
 
     def __init__(self, server: str, token: str = "",
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 timeout_s: float = 10.0) -> None:
+                 timeout_s: float = 10.0, real: bool = False) -> None:
         self.server = server.rstrip("/")
         self.token = token
         self.ctx = ssl_context
         self.timeout_s = timeout_s
+        # real=True: the target is a genuine apiserver — steps with an
+        # `expect_real` block assert it instead of `expect`
+        self.real = real
 
     # -- transport ------------------------------------------------------------
     def _request(self, method: str, path: str, body: Any = None,
@@ -178,6 +188,8 @@ class FixtureRunner:
         body = substitute(step.get("body"), variables) \
             if "body" in step else None
         expect = step.get("expect", {})
+        if self.real and "expect_real" in step:
+            expect = step["expect_real"]
         if op == "WATCH":
             max_events = len(expect.get("events", [])) or 1
             status, payload = self._watch(
@@ -272,18 +284,21 @@ def main(argv: Optional[list] = None) -> int:
                              "aging needs the in-memory window)")
     args = parser.parse_args(argv)
     ctx = ssl._create_unverified_context() if args.insecure else None
-    runner = FixtureRunner(args.server, token=args.token, ssl_context=ctx)
+    runner = FixtureRunner(args.server, token=args.token, ssl_context=ctx,
+                           real=args.real)
     failures = 0
     for fixture in load_fixtures(Path(args.fixtures)):
         if args.real and fixture.get("skip_on_real"):
             print(f"SKIP {fixture['name']} (skip_on_real)")
             continue
+        tag = " (expected_divergence: asserting real-apiserver side)" \
+            if args.real and fixture.get("expected_divergence") else ""
         try:
             runner.run(fixture)
-            print(f"PASS {fixture['name']}")
+            print(f"PASS {fixture['name']}{tag}")
         except FixtureFailure as err:
             failures += 1
-            print(f"FAIL {fixture['name']}: {err}")
+            print(f"FAIL {fixture['name']}{tag}: {err}")
     return 1 if failures else 0
 
 
