@@ -114,6 +114,13 @@ class MoEMLP(nn.Module):
             aux = load_balance_loss(probs.reshape(-1, num_experts),
                                     top1.reshape(-1, num_experts))
             return out, aux
+        if cfg.moe_dispatch == "hybrid":
+            out = self._hybrid_dispatch(x, gate_vals, gate_idx)
+            top1 = jax.nn.one_hot(gate_idx[..., 0], num_experts,
+                                  dtype=jnp.float32)
+            aux = load_balance_loss(probs.reshape(-1, num_experts),
+                                    top1.reshape(-1, num_experts))
+            return out, aux
         if cfg.moe_dispatch != "einsum":
             raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
 
@@ -162,6 +169,76 @@ class MoEMLP(nn.Module):
         aux = load_balance_loss(probs.reshape(-1, num_experts),
                                 top1.reshape(-1, num_experts))
         return out, aux
+
+    def _hybrid_dispatch(self, x, gate_vals, gate_idx):
+        """Einsum dispatch + GATHER combine — the round-5 overhead fix.
+
+        The GShard combine einsum "bsec,ebcd->bsd" is a disguised gather:
+        each token reads exactly top_k rows of the expert buffers, yet the
+        einsum contracts over all E*C slots — at BENCH_MOE scale that is
+        ~26 GFLOP per layer per batch row, and its two backward transposes
+        triple the bill (~20% of the whole step, BASELINE.md).  This path
+        keeps the MXU-friendly dispatch einsum (scatters are what lose on
+        TPU — the sort path measured it) but combines by indexing the
+        chosen (expert, slot) row per (token, choice): pure HBM row reads,
+        B*S*k*D bytes instead of E*C*D MACs, with the gate weights
+        multiplied outside so the router still gets exact gradients.  The
+        [B,S,k,E,C] slot one-hot the einsum path materializes (0.5 GiB
+        fp32 at bench shape) is also gone: the dispatch one-hot contracts
+        the per-choice slot one-hot [B,S,k,C] against the choice mask
+        [B,S,k,E] — k is tiny, so the intermediate never exceeds
+        [B,S,E,C].  Routing semantics (capacity, drops, gradients)
+        are IDENTICAL to the einsum path (tests/test_moe.py pins
+        allclose on outputs and router grads).
+
+        SCOPE: single-chip / expert-unsharded meshes.  The combine gather
+        indexes data-dependently across the expert-sharded leading axis
+        of expert_out — under expert parallelism the SPMD partitioner
+        lowers that to an all-gather of the whole [E,B,C,D] buffer, NOT
+        the GShard all-to-all the combine einsum gets, so "einsum" stays
+        the default and the expert-parallel path."""
+        cfg = self.cfg
+        num_experts, top_k = cfg.moe_experts, cfg.moe_top_k
+        batch, seq, dim = x.shape
+        capacity = max(1, int(cfg.moe_capacity_factor * seq * top_k
+                              / num_experts))
+
+        choice = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.float32)
+        flat = choice.reshape(batch, seq * top_k, num_experts)
+        position = jnp.cumsum(flat, axis=1) - flat
+        within = (position < capacity).astype(jnp.float32) * flat
+        position = position.reshape(batch, seq, top_k, num_experts)
+        within = within.reshape(batch, seq, top_k, num_experts)
+
+        # per-choice scalars: buffer slot + kept flag of the CHOSEN expert
+        pos_k = jnp.sum(position * choice, axis=-1).astype(jnp.int32)
+        keep_k = jnp.sum(within, axis=-1)                    # [B, S, k]
+
+        # dispatch one-hot via the small per-choice slot one-hot — the
+        # [B,S,k,E,C] monster never exists
+        slot_k = jax.nn.one_hot(pos_k, capacity, dtype=x.dtype)
+        dispatch = jnp.einsum("bske,bskc->bsec",
+                              within.astype(x.dtype), slot_k)
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", "batch", None, "embed"))
+
+        expert_out = nn.vmap(
+            _ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(cfg, name="experts")(expert_in)          # [E, B, C, D]
+        expert_out = nn.with_logical_constraint(
+            expert_out, ("expert", "batch", None, "embed"))
+
+        # combine by gather: row (e, b, c) for each (b, s, k)
+        b_idx = jnp.arange(batch)[:, None, None]             # [B, 1, 1]
+        rows = expert_out[gate_idx, b_idx, pos_k]            # [B, S, k, D]
+        weight = (gate_vals * keep_k).astype(rows.dtype)
+        out = jnp.sum(rows * weight[..., None], axis=2)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
 
     def _sort_dispatch(self, x, gate_vals, gate_idx):
         """Sort-based dispatch: argsort (token, choice) pairs by expert,
