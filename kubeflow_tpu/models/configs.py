@@ -47,6 +47,14 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_mlp_dim: int = 0             # per-expert hidden; 0 = mlp_dim
     moe_aux_weight: float = 0.01     # load-balance loss weight
+    fused_projections: bool = False  # decode-path op-count fusion: one
+                                     # qkv matmul + one gate_up matmul per
+                                     # layer instead of five (decode is
+                                     # launch-overhead-bound at small
+                                     # batch; ci/kv_cache_probe.py).  The
+                                     # param tree changes (qkv/gate_up
+                                     # kernels) — models.generate fuses a
+                                     # training tree on the way in
     moe_dispatch: str = "einsum"     # einsum (GShard one-hot) | hybrid
                                      # (einsum dispatch + gather combine —
                                      # halves the O(E*C*D) overhead) | sort
@@ -92,11 +100,32 @@ class TransformerConfig:
             expert_mlp = 3 * self.embed_dim * (self.moe_mlp_dim or self.mlp_dim)
             inactive = self.moe_experts - min(self.moe_top_k, self.moe_experts)
             matmul_params -= self.num_layers * inactive * expert_mlp
+            if self.moe_capacity_factor < 1.0:
+                # capacity < 1 structurally DROPS routed tokens: the
+                # hardware executes at most cf * top_k expert passes per
+                # token, so counting the nominal top_k would inflate MFU
+                # by 1/cf on the expert share — scale the numerator to
+                # what can actually run
+                active_mlp = min(self.moe_top_k, self.moe_experts) * expert_mlp
+                matmul_params -= self.num_layers * active_mlp * (
+                    1.0 - self.moe_capacity_factor)
         attn = 12 * self.num_layers * seq_len * self.num_heads * self.head_dim / 2
         return 6.0 * matmul_params + attn
 
 
 LLAMA2_7B = TransformerConfig()  # the MaxText v5e-16 headline config
+
+# 13B-class: the int4 single-chip capacity demo (ci/llama13b_decode.py) —
+# bf16 weights are 26 GiB (two chips' worth); int4 packs them into ~6.8
+# GiB, KV-cache room included on one 16-GiB v5e
+LLAMA2_13B = TransformerConfig(
+    num_layers=40,
+    embed_dim=5120,
+    num_heads=40,
+    num_kv_heads=40,
+    head_dim=128,
+    mlp_dim=13_824,
+)
 
 GEMMA_7B = TransformerConfig(
     vocab_size=256_128,
@@ -166,14 +195,18 @@ BENCH_MOE = BENCH_CHIP.with_(
     moe_experts=4,
     moe_top_k=2,
     moe_mlp_dim=3072,
-    # capacity 1.0 measured ~8% faster than 1.25 (ci/moe sweep, round 4):
-    # the dispatch/combine einsums and expert buffers scale with C
+    # capacity 1.0 measured ~8% faster than 1.25 (round 4) and honest:
+    # cf < 1 reads higher raw (0.75 probed +10% in round 5) but executes
+    # proportionally fewer expert FLOPs than the numerator counts —
+    # flops_per_token scales the expert share by cf when cf < 1, under
+    # which 0.75 LOSES (0.227 effective vs 0.255)
     moe_capacity_factor=1.0,
-    # tiles pinned: the round-5 1024x512 dense tiles are NOT inherited
-    # blindly — the MoE batch-16 fit and numbers were established under
-    # 256x256 (round 4); the round-5 MoE sweep re-decides these
-    flash_block_q=256,
-    flash_block_k=256,
+    # round-5 MoE tile x dispatch matrix (ci/sweep_r5 probes): 512x512
+    # beats 256x256 (+12%) and 1024x512 at batch 16; hybrid gather-
+    # combine beats einsum +8-15% at these tiles
+    flash_block_q=512,
+    flash_block_k=512,
+    moe_dispatch="hybrid",
 )
 
 # CI/test config: tiny but structurally identical (GQA, scan, remat)
@@ -192,6 +225,7 @@ TINY = TransformerConfig(
 
 PRESETS = {
     "llama2-7b": LLAMA2_7B,
+    "llama2-13b": LLAMA2_13B,
     "gemma-7b": GEMMA_7B,
     "llama2-350m": LLAMA2_350M,
     "bench-chip": BENCH_CHIP,
