@@ -1,0 +1,78 @@
+"""Decode overhead attribution: time structured ablations of the 470M
+decode config and compare measured step-time deltas against the HBM
+traffic each ablation removes.  A delta far above its traffic says the
+removed component carries hidden cost (extra copies, serialization);
+a delta at parity says it's already roofline-clean.
+
+Usage: python ci/decode_ablate.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.configs import BENCH_CHIP
+from kubeflow_tpu.models.generate import decode_config, generate
+from kubeflow_tpu.models.transformer import Transformer
+
+BATCH, PROMPT, NEW = 16, 128, 256
+
+
+def streamed_bytes(cfg, batch):
+    w = (cfg.num_params - cfg.vocab_size * cfg.embed_dim) * 2
+    kv = (2 * batch * cfg.max_seq_len * cfg.num_kv_heads * cfg.head_dim
+          * 2 * cfg.num_layers)
+    return w, kv
+
+
+def time_cfg(name, cfg, windows=3):
+    model = Transformer(cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (BATCH, PROMPT), 0, cfg.vocab_size)
+    params = jax.jit(model.init)(rng, prompt)["params"]
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    run = jax.jit(lambda p, t: generate(cfg, p, t, NEW))
+    np.asarray(run(params, prompt))
+    best = 0.0
+    for i in range(windows):
+        p = jax.random.randint(jax.random.PRNGKey(1000 + i),
+                               (BATCH, PROMPT), 0, cfg.vocab_size)
+        np.asarray(p)
+        t0 = time.perf_counter()
+        np.asarray(run(params, p))
+        best = max(best, BATCH * NEW / (time.perf_counter() - t0))
+    w, kv = streamed_bytes(cfg, BATCH)
+    step_ms = BATCH / best * 1e3
+    ideal_ms = (w + kv) / 819e9 * 1e3
+    print(f"{name:34s} {best:8,.0f} tok/s  step={step_ms:6.3f}ms  "
+          f"ideal={ideal_ms:6.3f}ms  gap={step_ms - ideal_ms:6.3f}ms  "
+          f"(w={w / 1e6:.0f}MB kv={kv / 1e6:.0f}MB)")
+    return step_ms
+
+
+def main():
+    base = decode_config(BENCH_CHIP).with_(max_seq_len=PROMPT + NEW)
+    time_cfg("baseline 10L kv12 v32k", base)
+    # halve KV traffic via GQA (weights shrink a little too — the ideal
+    # column accounts for it)
+    time_cfg("kv-heads 6 (KV/2)", base.with_(num_kv_heads=6))
+    # halve the LM head + embedding
+    time_cfg("vocab 16k (head/2)", base.with_(vocab_size=16_000))
+    # half the layer stack: halves weights, KV, AND per-layer op count
+    time_cfg("layers 5", base.with_(num_layers=5))
+    # double batch: same weights, 2x KV, amortizes per-step fixed cost
+    global BATCH
+    BATCH = 32
+    time_cfg("batch 32", base)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""KV-cache mechanics probe: is the in-loop dynamic_update_slice in
+place, and how fast does the decode einsum actually read the cache?
+
+Three scan bodies over the 470M decode cache shapes (10 layers x K,V of
+[16, 12, 384, 128] bf16 = 377 MB total), each 255 iterations inside ONE
+jit (relay round-trip amortized):
+
+  update-only   DUS a one-token slab into every buffer.  In place =>
+                ~nothing; a copy => read+write 755 MB/iter.
+  read-only     the decode attention einsum over every buffer (no DUS):
+                the pure read path vs the 819 GB/s spec.
+  read+update   both — the real decode step's cache mechanics.
+
+Usage: python ci/kv_cache_probe.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LAYERS, B, KVH, S, D = 10, 16, 12, 384, 128
+ITERS = 255
+BYTES = LAYERS * 2 * B * KVH * S * D * 2  # all caches, bf16
+
+
+def run(name, body):
+    caches = [jnp.zeros((B, KVH, S, D), jnp.bfloat16)
+              for _ in range(LAYERS * 2)]
+
+    @jax.jit
+    def loop(caches):
+        def step(carry, i):
+            caches, acc = carry
+            caches, out = body(caches, i)
+            return (caches, acc + out), None
+
+        (caches, acc), _ = jax.lax.scan(
+            step, (caches, jnp.float32(0.0)), jnp.arange(ITERS))
+        return acc
+
+    np.asarray(loop(caches))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(loop(caches))
+        best = min(best, time.perf_counter() - t0)
+    per_iter = best / ITERS
+    gbps = BYTES / per_iter / 1e9
+    print(f"{name:14s} {per_iter * 1e3:7.3f} ms/iter  "
+          f"(cache bytes once = {gbps:5.0f} GB/s equivalent)")
+    return per_iter
+
+
+def main():
+    slab = jnp.ones((B, KVH, 1, D), jnp.bfloat16)
+    q = jnp.ones((B, 1, KVH, 1, D), jnp.bfloat16)  # grouped, G=1 here
+
+    def update_only(caches, i):
+        pos = jnp.minimum(i, S - 1)
+        caches = [jax.lax.dynamic_update_slice(c, slab, (0, 0, pos, 0))
+                  for c in caches]
+        return caches, jnp.float32(0.0)
+
+    def read_only(caches, i):
+        acc = jnp.float32(0.0)
+        for c in caches:
+            scores = jnp.einsum("bqkgd,bksd->bkgqs",
+                                q, c, preferred_element_type=jnp.float32)
+            acc += jnp.sum(scores)
+        return caches, acc
+
+    def read_update(caches, i):
+        caches, _ = update_only(caches, i)
+        return read_only(caches, i)
+
+    run("update-only", update_only)
+    run("read-only", read_only)
+    run("read+update", read_update)
+    ideal = BYTES / 819e9
+    print(f"ideal read-once: {ideal * 1e3:.3f} ms/iter @ 819 GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,16 @@ def decode_config(cfg: TransformerConfig,
     profiler's A/B baseline).  Params from a scan_layers=True training run
     are converted by `generate` (see `unroll_params`).
     """
+    # fused projections (one qkv + one gate_up matmul per layer) are the
+    # decode default — but only when CONVERTING a training config: a cfg
+    # that is already decode-shaped keeps its explicit setting, so callers
+    # can request the unfused layout (A/B profiling, old quantized trees)
+    # without this function silently overriding them
+    already_decode = not cfg.remat and cfg.attention_impl == "xla"
+    fused = cfg.fused_projections if already_decode else True
     return cfg.with_(remat=False, attention_impl="xla",
-                     scan_layers=not unroll_layers)
+                     scan_layers=not unroll_layers,
+                     fused_projections=fused)
 
 
 def unroll_params(params, num_layers: int):
@@ -60,6 +68,65 @@ def unroll_params(params, num_layers: int):
     for i in range(num_layers):
         rest[f"layer_{i}"] = jax.tree.map(lambda a: a[i], stacked)
     return rest
+
+
+def fuse_decode_params(params, cfg: TransformerConfig):
+    """Training-layout layer params (separate q/k/v and gate/up kernels)
+    -> the fused_projections layout (one qkv kernel [D, H+2kvH, Dh], one
+    gate_up kernel [D, 2, M]).  Pure concatenation along the heads /
+    fused axis, so it MUST run before quantization — int8/int4 scale
+    tensors cannot be concatenated after the fact (per-last-dim scales
+    are shared across exactly the axis the fusion concatenates).
+    quantize_params / quantize_params_int4 walk the fused tree fine (the
+    qkv/gate_up nodes carry ordinary `kernel` leaves).  No-op when the
+    tree is already fused."""
+    import flax.linen as nn
+
+    def fuse_layer(layer):
+        layer = dict(layer)
+        attn = layer.get("attn")
+        if attn is not None and "q" in attn:
+            attn = dict(attn)
+            qkv = jnp.concatenate(
+                [nn.unbox(attn.pop(n)["kernel"]) for n in ("q", "k", "v")],
+                axis=1)
+            attn["qkv"] = {"kernel": qkv}
+            layer["attn"] = attn
+        mlp = layer.get("mlp")
+        if mlp is not None and "gate" in mlp:
+            mlp = dict(mlp)
+            gate_up = jnp.stack(
+                [nn.unbox(mlp.pop(n)["kernel"]) for n in ("gate", "up")],
+                axis=1)
+            mlp["gate_up"] = {"kernel": gate_up}
+            layer["mlp"] = mlp
+        return layer
+
+    return {k: (fuse_layer(v) if k.startswith("layer_") else v)
+            for k, v in nn.unbox(params).items()}
+
+
+def prepare_decode(cfg: TransformerConfig, params,
+                   unroll_layers: bool = True):
+    """(training cfg, training-or-quantized params) -> (decode cfg,
+    decode-layout params).  Unrolls a stacked tree, then fuses q/k/v and
+    gate/up kernels into the fused_projections layout when the tree still
+    carries raw `kernel` leaves.  An already-QUANTIZED unfused tree
+    cannot be fused (scales don't concatenate) — the decode config falls
+    back to fused_projections=False so old pipelines keep working;
+    quantized flows that want the fusion win quantize AFTER this
+    (bench.py, ci/llama*_decode.py)."""
+    cfg = decode_config(cfg, unroll_layers=unroll_layers)
+    if cfg.scan_layers:
+        # scanned stack keeps the training layout
+        return cfg.with_(fused_projections=False), params
+    params = unroll_params(params, cfg.num_layers)
+    attn0 = params.get("layer_0", {}).get("attn", {})
+    if not cfg.fused_projections or "qkv" in attn0:
+        return cfg, params
+    if "kernel" in attn0.get("q", {}):
+        return cfg, fuse_decode_params(params, cfg)
+    return cfg.with_(fused_projections=False), params
 
 
 def sample_token(
@@ -97,9 +164,7 @@ def generate(
     subtree is converted to the decode layout on the fly (a trace-time
     reshuffle, free after jit).
     """
-    cfg = decode_config(cfg, unroll_layers=unroll_layers)
-    if not cfg.scan_layers:
-        params = unroll_params(params, cfg.num_layers)
+    cfg, params = prepare_decode(cfg, params, unroll_layers=unroll_layers)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
@@ -150,4 +215,5 @@ def generate(
     return jnp.concatenate([prompt, generated], axis=1)
 
 
-__all__ = ["generate", "decode_config", "sample_token", "unroll_params"]
+__all__ = ["generate", "decode_config", "sample_token", "unroll_params",
+           "fuse_decode_params", "prepare_decode"]

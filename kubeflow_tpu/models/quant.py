@@ -53,12 +53,16 @@ class Int8DenseGeneral(nn.Module):
         axis = tuple(a % x.ndim for a in axis)
         contract_shape = tuple(x.shape[a] for a in axis)
         kernel_shape = contract_shape + tuple(features)
-        # per-LAST-dim scales (see _quantize_kernel): broadcast over every
-        # other kernel dim
-        scale_shape = (1,) * (len(kernel_shape) - 1) + (kernel_shape[-1],)
+        # per-OUTPUT-CHANNEL scales (see _quantize_kernel): one scale per
+        # feature coordinate, broadcast over the contract dims only — a
+        # fused qkv kernel [D, H+2kvH, Dh] gets independent scales per
+        # projection and head instead of one shared [Dh] row (round-5
+        # review finding)
+        scale_shape = (1,) * len(contract_shape) + tuple(features)
 
         k_axes = self.logical_axes or (None,) * len(kernel_shape)
-        s_axes = (None,) * (len(scale_shape) - 1) + (k_axes[-1],)
+        s_axes = ((None,) * len(contract_shape)
+                  + tuple(k_axes[len(contract_shape):]))
         kq = self.param("kernel_q",
                         nn.with_logical_partitioning(
                             nn.initializers.zeros_init(), tuple(k_axes)),
@@ -75,19 +79,19 @@ class Int8DenseGeneral(nn.Module):
         )
 
 
-def _quantize_kernel(kernel: jax.Array, lead: int = 0) -> dict:
-    """Symmetric per-LAST-dim absmax int8: one scale per slot of the
-    kernel's final dimension, shared across every other dim.  Exact
-    per-output-channel for rank-2 kernels ([in, out]); coarser for
-    multi-dim features ([in, heads, head_dim] shares a scale across
-    heads) — the tree transform cannot know how many trailing dims are
-    features, and the last dim is always an output dim in this model's
-    layouts.  `lead` keeps that many leading STACK axes per-slice
-    (scan layers: [L, ..., out] -> scales [L, 1, ..., out]; vmapped
-    experts add another: [L, E, ..., out] -> [L, E, 1, ..., out]) —
-    what nn.scan/nn.vmap variable_axes slicing expects."""
+def _quantize_kernel(kernel: jax.Array, lead: int = 0,
+                     n_contract: int = 1) -> dict:
+    """Symmetric per-OUTPUT-CHANNEL absmax int8: one scale per feature
+    coordinate, reduced over the contract dims only — [in, heads, dh]
+    gets [1, heads, dh] scales (each head its own), and a fused qkv
+    kernel never shares scales across projections.  `lead` keeps that
+    many leading STACK axes per-slice (scan layers: [L, ...] -> scales
+    [L, ...]; vmapped experts add another) — what nn.scan/nn.vmap
+    variable_axes slicing expects.  `n_contract` is the number of
+    contracted dims after the stack axes (2 for the attention out
+    projection [heads, dh, embed]; 1 everywhere else in this family)."""
     k32 = kernel.astype(jnp.float32)
-    axes = tuple(range(lead, k32.ndim - 1))
+    axes = tuple(range(lead, lead + n_contract))
     absmax = jnp.max(jnp.abs(k32), axis=axes, keepdims=True)
     scale = jnp.maximum(absmax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(k32 / scale), -127, 127).astype(jnp.int8)
@@ -109,10 +113,15 @@ def quantize_params(params, skip: tuple = ("embed", "router")) -> Any:
             if name in skip:
                 return node
             if "kernel" in node and not isinstance(node["kernel"], dict):
+                kernel = nn.unbox(node["kernel"])
+                # the attention out projection ([heads, dh, embed]) is
+                # the family's one multi-dim-contract kernel
+                n_contract = 2 if (name == "out"
+                                   and kernel.ndim - lead == 3) else 1
                 rest = {k: v for k, v in node.items() if k != "kernel"}
                 return {**rest,
-                        **_quantize_kernel(nn.unbox(node["kernel"]),
-                                           lead=lead)}
+                        **_quantize_kernel(kernel, lead=lead,
+                                           n_contract=n_contract)}
             return {k: walk(v, k,
                             lead + (1 if k in ("layers", "experts") else 0))
                     for k, v in node.items()}

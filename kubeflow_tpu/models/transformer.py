@@ -130,18 +130,37 @@ class Attention(nn.Module):
     def __call__(self, x, positions, decode: bool = False):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
-        q = _dense(
-            (cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q",
-            dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
-        )(x)
-        k = _dense(
-            (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"), "k",
-            dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
-        )(x)
-        v = _dense(
-            (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"), "v",
-            dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
-        )(x)
+        if cfg.fused_projections:
+            # decode fusion: one matmul for q|k|v along the heads axis —
+            # small-batch decode pays ~10-15us of launch overhead PER
+            # KERNEL (ci/kv_cache_probe.py), so 3 projections -> 1 is a
+            # direct step-time cut.  models.generate.fuse_decode_params
+            # concatenates a training tree's q/k/v kernels into this
+            # layout before quantization.
+            fused_heads = cfg.num_heads + 2 * cfg.num_kv_heads
+            qkv = _dense(
+                (fused_heads, cfg.head_dim), ("embed", "heads", "kv"),
+                "qkv", dtype, _dtype(cfg.param_dtype),
+                weight_dtype=cfg.weight_dtype,
+            )(x)
+            q = qkv[..., :cfg.num_heads, :]
+            k = qkv[..., cfg.num_heads:cfg.num_heads + cfg.num_kv_heads, :]
+            v = qkv[..., cfg.num_heads + cfg.num_kv_heads:, :]
+        else:
+            q = _dense(
+                (cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q",
+                dtype, _dtype(cfg.param_dtype), weight_dtype=cfg.weight_dtype,
+            )(x)
+            k = _dense(
+                (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"),
+                "k", dtype, _dtype(cfg.param_dtype),
+                weight_dtype=cfg.weight_dtype,
+            )(x)
+            v = _dense(
+                (cfg.num_kv_heads, cfg.head_dim), ("embed", "heads", "kv"),
+                "v", dtype, _dtype(cfg.param_dtype),
+                weight_dtype=cfg.weight_dtype,
+            )(x)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
@@ -149,32 +168,38 @@ class Attention(nn.Module):
         k = rope(k, positions, cfg.rope_theta)
 
         if decode:
-            # KV cache (flax "cache" collection): static [B, max_seq] ring
-            # written with dynamic_update_slice — XLA-friendly in-place
-            # updates, no growing shapes.  rope was applied with GLOBAL
-            # positions above, so cached keys need no re-rotation.
+            # KV cache (flax "cache" collection): static ring written with
+            # dynamic_update_slice — XLA-friendly in-place updates, no
+            # growing shapes.  Layout is [B, kvH, S, D], what the decode
+            # dots consume directly (ops/attention.decode_attention): the
+            # [B, S, kvH, D] activation layout would cost a full-cache
+            # transpose copy per step; here only the new token's slab is
+            # transposed.  rope was applied with GLOBAL positions above,
+            # so cached keys need no re-rotation.
+            from ..ops.attention import decode_attention
+
             batch = x.shape[0]
             cached_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim),
+                (batch, cfg.num_kv_heads, cfg.max_seq_len, cfg.head_dim),
                 k.dtype)
             cached_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim),
+                (batch, cfg.num_kv_heads, cfg.max_seq_len, cfg.head_dim),
                 v.dtype)
             index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
             cur = index.value
             cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k, (0, cur, 0, 0))
+                cached_k.value, k.transpose(0, 2, 1, 3), (0, 0, cur, 0))
             cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v, (0, cur, 0, 0))
+                cached_v.value, v.transpose(0, 2, 1, 3), (0, 0, cur, 0))
             index.value = cur + x.shape[1]
-            # causal mask with q at global offset `cur` covers both the
-            # unwritten tail (kv_pos > q_pos) and ordinary causality
-            out = attention(q, cached_k.value, cached_v.value, causal=True,
-                            impl="xla", q_offset=cur)
+            # the visibility mask with q at global offset `cur` covers
+            # both the unwritten tail (kv_pos > q_pos) and causality
+            out = decode_attention(q, cached_k.value, cached_v.value,
+                                   q_offset=cur)
             out = nn.with_logical_constraint(
                 out, ("batch", "seq", "heads", "kv"))
             return _dense(
@@ -221,10 +246,16 @@ class MLP(nn.Module):
         cfg = self.cfg
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         wd = cfg.weight_dtype
-        gate = _dense(cfg.mlp_dim, ("embed", "mlp"), "gate", dtype, pdtype,
-                      weight_dtype=wd)(x)
-        up = _dense(cfg.mlp_dim, ("embed", "mlp"), "up", dtype, pdtype,
-                    weight_dtype=wd)(x)
+        if cfg.fused_projections:
+            # decode fusion twin of Attention's qkv (launch-overhead cut)
+            gu = _dense((2, cfg.mlp_dim), ("embed", None, "mlp"),
+                        "gate_up", dtype, pdtype, weight_dtype=wd)(x)
+            gate, up = gu[..., 0, :], gu[..., 1, :]
+        else:
+            gate = _dense(cfg.mlp_dim, ("embed", "mlp"), "gate", dtype,
+                          pdtype, weight_dtype=wd)(x)
+            up = _dense(cfg.mlp_dim, ("embed", "mlp"), "up", dtype, pdtype,
+                        weight_dtype=wd)(x)
         hidden = nn.silu(gate) * up
         hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "mlp"))
         return _dense(cfg.embed_dim, ("mlp", "embed"), "down", dtype, pdtype,

@@ -58,6 +58,45 @@ def xla_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    q_offset,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """KV-cache attention with the cache in [B, kvH, S, D] layout.
+
+    Decode is HBM-bound: every step reads the full static cache, so the
+    cache layout must be what the dots consume DIRECTLY.  The [B,S,H,D]
+    activation layout xla_attention takes needs a [B,H,S,D] transpose of
+    both K and V per step — XLA materializes that as a copy, roughly
+    1.5x-ing the KV traffic the roofline counts once (measured on the
+    470M decode bench: 60% -> see BASELINE.md round-5 row).  Here the
+    caches arrive pre-transposed (the per-step write transposes only the
+    NEW token's [B,1,kvH,D] slab) and grouped-query heads fold into the
+    q reshape instead of a materialized _repeat_kv.
+
+    q: [B, Q, H, D] (Q = 1, or gamma+1 in speculative verify);
+    k_cache/v_cache: [B, kvH, S, D]; q_offset: global position of q[0]
+    (traced scalar) — masks unwritten/future cache slots."""
+    batch, q_len, num_heads, head_dim = q.shape
+    kv_heads, kv_len = k_cache.shape[1], k_cache.shape[2]
+    groups = num_heads // kv_heads
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    qg = q.reshape(batch, q_len, kv_heads, groups, head_dim)
+    scores = jnp.einsum(
+        "bqkgd,bksd->bkgqs", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    visible = jnp.arange(kv_len)[None, :] <= q_pos        # [Q, S]
+    scores = jnp.where(visible[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgqs,bksd->bqkgd", probs, v_cache)
+    return out.reshape(batch, q_len, num_heads, head_dim)
+
+
 @functools.cache
 def _pallas_flash():
     from jax.experimental.pallas.ops.tpu.flash_attention import (
