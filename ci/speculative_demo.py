@@ -9,6 +9,10 @@ learned structure, the honest mechanism, not from rigging the draft.
 
 Prints one JSON line: plain tok/s, speculative tok/s, speedup, rounds.
 Usage: python ci/speculative_demo.py [train_steps]
+       python ci/speculative_demo.py --sample [train_steps]
+--sample measures the temperature>0 rejection-sampling mode
+(models/speculative.py speculative_sample) instead: plain sampled decode
+vs speculative, with the measured acceptance rate per gamma.
 """
 
 from __future__ import annotations
@@ -67,17 +71,93 @@ def train(cfg, steps: int, batch: int = 16, seed: int = 0):
     return state.params, loss
 
 
-def main() -> None:
-    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 150
+def train_pair(steps: int):
+    """The shared target/draft pair both demo modes measure."""
     target_cfg = BENCH_CHIP.with_(vocab_size=VOCAB, max_seq_len=2048,
                                   loss_chunks=16)
     draft_cfg = target_cfg.with_(num_layers=2)
-
     t_params, t_loss = train(target_cfg, steps)
     d_params, d_loss = train(draft_cfg, steps, seed=1)
     print(f"trained: target loss {t_loss:.3f}, draft loss {d_loss:.3f}",
           file=sys.stderr)
+    return target_cfg, t_params, t_loss, draft_cfg, d_params, d_loss
 
+
+def best_of(fn, batch, prompt_len, n_new, n=3, with_key=False):
+    """Best-of-n timing with a fresh prompt per window (the relay serves
+    identical inputs from a result cache; see bench.py)."""
+    best = 1e9
+    for i in range(n):
+        p = stream_batch(jax.random.PRNGKey(100 + i),
+                         batch)["inputs"][:, :prompt_len]
+        np.asarray(p)
+        t0 = time.perf_counter()
+        r = fn(p, jax.random.PRNGKey(i)) if with_key else fn(p)
+        jax.tree.map(np.asarray, r)
+        best = min(best, time.perf_counter() - t0)
+    return batch * n_new / best
+
+
+def main_sample(steps: int) -> None:
+    """Temperature-sampling speculative decode on the trained pair:
+    speedup AND acceptance rate vs gamma (the speed model is
+    (accepted+1)/round; acceptance falls as gamma grows)."""
+    from kubeflow_tpu.models.speculative import speculative_sample
+
+    target_cfg, t_params, t_loss, draft_cfg, d_params, d_loss = \
+        train_pair(steps)
+    batch, prompt_len, n_new, temperature = 4, 64, 256, 0.8
+    plain = jax.jit(lambda p, t, k: generate(
+        target_cfg, p, t, max_new_tokens=n_new, temperature=temperature,
+        rng=k))
+
+    warm = stream_batch(jax.random.PRNGKey(42), batch)["inputs"][:, :prompt_len]
+    np.asarray(plain(t_params, warm, jax.random.PRNGKey(0)))
+    plain_tps = best_of(lambda p, k: plain(t_params, p, k),
+                        batch, prompt_len, n_new, with_key=True)
+
+    per_gamma = {}
+    best_tps, best_gamma = 0.0, 0
+    for gamma in (2, 4, 6):
+        spec = jax.jit(lambda tp, dp, t, k, g=gamma: speculative_sample(
+            target_cfg, tp, draft_cfg, dp, t, n_new, gamma=g,
+            temperature=temperature, rng=k))
+        _, rounds, rate = jax.tree.map(
+            np.asarray, spec(t_params, d_params, warm, jax.random.PRNGKey(0)))
+        tps = best_of(lambda p, k: spec(t_params, d_params, p, k),
+                      batch, prompt_len, n_new, with_key=True)
+        per_gamma[gamma] = {
+            "tok_s": round(float(tps), 1),
+            "accept_rate": round(float(rate), 3),
+            "rounds_for_256": int(rounds),
+        }
+        if tps > best_tps:
+            best_tps, best_gamma = tps, gamma
+    print(json.dumps({
+        "metric": "speculative_sampling_speedup_v5e",
+        "value": round(best_tps / plain_tps, 3),
+        "unit": "x",
+        "vs_baseline": round(best_tps / plain_tps, 3),
+        "detail": {
+            "plain_sampled_tok_s": round(plain_tps, 1),
+            "temperature": temperature,
+            "best_gamma": best_gamma,
+            "per_gamma": per_gamma,
+            "train_steps": steps,
+            "target_loss": round(t_loss, 3),
+            "draft_loss": round(d_loss, 3),
+        },
+    }))
+
+
+def main() -> None:
+    if "--sample" in sys.argv:
+        sys.argv.remove("--sample")
+        main_sample(int(sys.argv[1]) if len(sys.argv) > 1 else 150)
+        return
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 150
+    target_cfg, t_params, t_loss, draft_cfg, d_params, d_loss = \
+        train_pair(steps)
     batch, prompt_len, n_new, gamma = 4, 64, 256, 4
     key = jax.random.PRNGKey(42)
     prompt = stream_batch(key, batch)["inputs"][:, :prompt_len]
@@ -92,20 +172,10 @@ def main() -> None:
     out = np.asarray(out)
     assert (out == ref).all(), "speculative output diverged from greedy"
 
-    def best_of(fn, n=3):
-        best = 1e9
-        for i in range(n):
-            p = stream_batch(jax.random.PRNGKey(100 + i),
-                             batch)["inputs"][:, :prompt_len]
-            np.asarray(p)
-            t0 = time.perf_counter()
-            r = fn(p)
-            jax.tree.map(np.asarray, r)
-            best = min(best, time.perf_counter() - t0)
-        return batch * n_new / best
-
-    plain_tps = best_of(lambda p: plain(t_params, p))
-    spec_tps = best_of(lambda p: spec(t_params, d_params, p))
+    plain_tps = best_of(lambda p: plain(t_params, p),
+                        batch, prompt_len, n_new)
+    spec_tps = best_of(lambda p: spec(t_params, d_params, p),
+                       batch, prompt_len, n_new)
     print(json.dumps({
         "metric": "speculative_speedup_v5e",
         "value": round(spec_tps / plain_tps, 3),

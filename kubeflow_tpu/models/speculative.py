@@ -1,4 +1,17 @@
-"""Greedy speculative decoding: draft gamma tokens, verify in one pass.
+"""Speculative decoding: draft gamma tokens, verify in one target pass.
+
+Two modes:
+- `speculative_generate` — greedy (temperature 0): the longest agreeing
+  prefix is accepted; EXACT by construction (token-identical to the
+  target's own greedy decode).
+- `speculative_sample` — temperature > 0 serving via the standard
+  rejection-sampling rule (Leviathan et al. 2023): accept draft token x_i
+  with probability min(1, p_i(x_i)/q_i(x_i)); at the first rejection,
+  resample from the normalized residual max(0, p_i - q_i).  The emitted
+  tokens are distributed EXACTLY as target-only sampling — the draft
+  changes speed, never the distribution (tests/test_speculative.py pins
+  this with a chi-square gate against enumerated target marginals).
+
 
 Serving accelerator for the in-notebook compute plane: a small DRAFT
 model proposes `gamma` greedy tokens autoregressively; the TARGET model
@@ -32,7 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import TransformerConfig
-from .generate import decode_config, unroll_params
+from .generate import prepare_decode
 from .transformer import Transformer
 
 
@@ -65,10 +78,8 @@ def speculative_generate(
     ceil((N-1)/gamma) rounds at full acceptance, N-1 at zero."""
     if gamma < 2:
         raise ValueError("gamma must be >= 2 (acceptance caps at gamma-1)")
-    t_cfg = decode_config(target_cfg)
-    d_cfg = decode_config(draft_cfg)
-    target_params = unroll_params(target_params, t_cfg.num_layers)
-    draft_params = unroll_params(draft_params, d_cfg.num_layers)
+    t_cfg, target_params = prepare_decode(target_cfg, target_params)
+    d_cfg, draft_params = prepare_decode(draft_cfg, draft_params)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     # the verify pass appends up to gamma+1 positions past the last
@@ -167,4 +178,151 @@ def speculative_generate(
     return tokens[:, :total], steps
 
 
-__all__ = ["speculative_generate"]
+def speculative_sample(
+    target_cfg: TransformerConfig,
+    target_params,
+    draft_cfg: TransformerConfig,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    gamma: int = 4,
+    temperature: float = 1.0,
+    rng: jax.Array | None = None,
+):
+    """Temperature-sampling speculative decode.
+
+    prompt [B, P] -> ([B, P + max_new_tokens] tokens, outer_steps,
+    accept_rate).  Emitted tokens are distributed exactly as the target's
+    own temperature sampling; `accept_rate` is the fraction of drafted
+    tokens accepted (the speed diagnostic: speedup ~ (m+1)/round).
+
+    Batch semantics: the round advances by m = min over rows of the
+    per-row accepted-prefix length (capped at gamma-1, same draft-cache
+    argument as the greedy path).  At position n+m a row that REJECTED
+    there emits the residual resample; a row that accepted x_m emits x_m
+    itself.  Rows that had accepted beyond m simply regenerate those
+    positions with fresh randomness next round — conditioned on the
+    prefix the regenerated tokens have the same law, so per-row
+    exactness survives the shared frontier."""
+    if gamma < 2:
+        raise ValueError("gamma must be >= 2 (acceptance caps at gamma-1)")
+    if temperature <= 0.0:
+        raise ValueError("temperature must be > 0; use "
+                         "speculative_generate for greedy")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    t_cfg, target_params = prepare_decode(target_cfg, target_params)
+    d_cfg, draft_params = prepare_decode(draft_cfg, draft_params)
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    t_cfg = t_cfg.with_(max_seq_len=total + gamma + 1)
+    d_cfg = d_cfg.with_(max_seq_len=total + gamma + 1)
+    target = Transformer(t_cfg)
+    draft = Transformer(d_cfg)
+    inv_t = 1.0 / temperature
+
+    (t_logits, _), t_cache = target.apply(
+        {"params": target_params}, prompt, return_aux=True, decode=True,
+        mutable=["cache"])
+    (_, _), d_cache = draft.apply(
+        {"params": draft_params}, prompt, return_aux=True, decode=True,
+        mutable=["cache"])
+    rng, k_first = jax.random.split(rng)
+    first = jax.random.categorical(
+        k_first, t_logits[:, -1, :].astype(jnp.float32) * inv_t, axis=-1)
+
+    tokens = jnp.zeros((batch, total + gamma + 1), jnp.int32)
+    tokens = tokens.at[:, :prompt_len].set(prompt)
+    tokens = tokens.at[:, prompt_len].set(first)
+
+    def position(n):
+        return jnp.broadcast_to(n, (batch, 1))
+
+    def draft_one(cache, tok, pos, key):
+        (logits, _), new_cache = draft.apply(
+            {"params": draft_params, **cache}, tok[:, None],
+            return_aux=True, decode=True, positions=position(pos),
+            mutable=["cache"])
+        row = logits[:, -1, :].astype(jnp.float32) * inv_t
+        q = jax.nn.softmax(row, axis=-1)
+        nxt = jax.random.categorical(key, row, axis=-1)
+        return new_cache, nxt, q
+
+    def body(carry):
+        tokens, t_cache, d_cache, n, steps, accepted, rng = carry
+        rng, k_draft, k_accept, k_res = jax.random.split(rng, 4)
+
+        def scan_step(c, inp):
+            cache, tok = c
+            i, key = inp
+            cache, nxt, q = draft_one(cache, tok, n - 1 + i, key)
+            return (cache, nxt), (nxt, q)
+
+        last = tokens[jnp.arange(batch), n - 1]
+        (d_cache2, _), (proposals, qs) = jax.lax.scan(
+            scan_step, (d_cache, last),
+            (jnp.arange(gamma), jax.random.split(k_draft, gamma)))
+        proposals = jnp.moveaxis(proposals, 0, 1)        # [B, gamma]
+        qs = jnp.moveaxis(qs, 0, 1)                      # [B, gamma, V]
+
+        block = jnp.concatenate([last[:, None], proposals], axis=1)
+        positions = n - 1 + jnp.broadcast_to(
+            jnp.arange(gamma + 1), (batch, gamma + 1))
+        (logits, _), t_cache2 = target.apply(
+            {"params": target_params, **t_cache}, block, return_aux=True,
+            decode=True, positions=positions, mutable=["cache"])
+        p = jax.nn.softmax(logits.astype(jnp.float32) * inv_t, axis=-1)
+
+        # accept x_i w.p. min(1, p_i(x_i)/q_i(x_i))
+        p_prop = jnp.take_along_axis(
+            p[:, :gamma], proposals[..., None], axis=-1)[..., 0]
+        q_prop = jnp.take_along_axis(
+            qs, proposals[..., None], axis=-1)[..., 0]
+        u = jax.random.uniform(k_accept, (batch, gamma))
+        accept = u * q_prop < p_prop                      # [B, gamma]
+        acc_count = jnp.sum(
+            jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)  # [B]
+        m = jnp.minimum(jnp.min(acc_count), gamma - 1)
+
+        # residual resample at position m for rows that rejected there
+        p_m = jax.lax.dynamic_index_in_dim(p, m, axis=1, keepdims=False)
+        q_m = jax.lax.dynamic_index_in_dim(qs, m, axis=1, keepdims=False)
+        residual = jnp.maximum(p_m - q_m, 0.0)
+        res_sum = jnp.sum(residual, axis=-1, keepdims=True)
+        # p == q makes the residual empty; rejection then has probability
+        # 0, but guard the log anyway by falling back to p
+        residual = jnp.where(res_sum > 0.0, residual / res_sum, p_m)
+        x_res = jax.random.categorical(
+            k_res, jnp.log(residual + 1e-30), axis=-1)
+        rejected_here = acc_count == m
+        prop_m = jax.lax.dynamic_index_in_dim(
+            proposals, m, axis=1, keepdims=False)
+        emit_m = jnp.where(rejected_here, x_res, prop_m)
+
+        width = tokens.shape[1]
+        col = jnp.arange(width)[None, :]
+        sel = (col >= n) & (col <= n + m)
+        src_idx = jnp.clip(col - n, 0, gamma - 1)
+        gathered = jnp.take_along_axis(
+            proposals, jnp.broadcast_to(src_idx, (batch, width)), axis=1)
+        gathered = jnp.where(col == n + m, emit_m[:, None], gathered)
+        tokens = jnp.where(sel, gathered, tokens)
+
+        t_cache2 = _rewind(t_cache2, n + m)
+        d_cache2 = _rewind(d_cache2, n + m)
+        return (tokens, t_cache2, d_cache2, n + m + 1, steps + 1,
+                accepted + m, rng)
+
+    def cond(carry):
+        _, _, _, n, *_ = carry
+        return n < total
+
+    tokens, _, _, n, steps, accepted, _ = jax.lax.while_loop(
+        cond, body, (tokens, t_cache, d_cache,
+                     jnp.int32(prompt_len + 1), jnp.int32(0),
+                     jnp.int32(0), rng))
+    accept_rate = accepted.astype(jnp.float32) / jnp.maximum(
+        steps.astype(jnp.float32) * gamma, 1.0)
+    return tokens[:, :total], steps, accept_rate
+
+
+__all__ = ["speculative_generate", "speculative_sample"]

@@ -70,3 +70,97 @@ class TestSpeculative:
             assert "gamma" in str(e)
         else:
             raise AssertionError("gamma=1 should be rejected")
+
+
+class TestSpeculativeSampling:
+    """Rejection-sampling mode: the emitted distribution must equal
+    target-only sampling — the draft may only change speed."""
+
+    def _tiny(self):
+        # vocab small enough to enumerate marginals exactly
+        return TINY.with_(vocab_size=16)
+
+    def test_smoke_and_accept_rate_range(self):
+        from kubeflow_tpu.models.speculative import speculative_sample
+
+        cfg = self._tiny()
+        params = _params(cfg)
+        draft_cfg = cfg.with_(num_layers=1, embed_dim=32, num_heads=2,
+                              num_kv_heads=1, head_dim=16, mlp_dim=64)
+        dparams = _params(draft_cfg, seed=7)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0,
+                                    cfg.vocab_size)
+        out, steps, rate = speculative_sample(
+            cfg, params, draft_cfg, dparams, prompt, 10, gamma=4,
+            temperature=0.8, rng=jax.random.PRNGKey(11))
+        assert out.shape == (2, 16)
+        assert int(steps) >= 1
+        assert 0.0 <= float(rate) <= 1.0
+        assert (np.asarray(out) >= 0).all()
+        assert (np.asarray(out) < cfg.vocab_size).all()
+
+    def test_perfect_draft_accepts_everything(self):
+        from kubeflow_tpu.models.speculative import speculative_sample
+
+        cfg = self._tiny()
+        params = _params(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0,
+                                    cfg.vocab_size)
+        _, steps, rate = speculative_sample(
+            cfg, params, cfg, params, prompt, 12, gamma=4,
+            temperature=1.0, rng=jax.random.PRNGKey(5))
+        # p == q: acceptance prob min(1, p/q) = 1 -> every round advances
+        # gamma-1 accepted + 1 emission; rate = (gamma-1)/gamma
+        assert float(rate) >= 0.74, float(rate)
+        assert int(steps) <= 4, int(steps)
+
+    def test_distribution_matches_target_sampling(self):
+        """Chi-square gate: the empirical marginal of the first TWO
+        emitted tokens over many independent runs must match the
+        target-enumerated marginal.  The draft is a DIFFERENT model, so
+        rejections + residual resampling are genuinely exercised."""
+        from kubeflow_tpu.models.speculative import speculative_sample
+
+        cfg = self._tiny()
+        params = _params(cfg)
+        draft_cfg = cfg.with_(num_layers=1, embed_dim=32, num_heads=2,
+                              num_kv_heads=1, head_dim=16, mlp_dim=64)
+        dparams = _params(draft_cfg, seed=7)
+        prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+        temperature = 1.0
+        V = cfg.vocab_size
+
+        # enumerate the target's exact marginals for positions P and P+1
+        model = Transformer(cfg)
+        logits = model.apply({"params": params}, prompt)
+        p1 = jax.nn.softmax(
+            logits[0, -1].astype(jnp.float32) / temperature)  # [V]
+        exts = jnp.concatenate(
+            [jnp.broadcast_to(prompt, (V, prompt.shape[1])),
+             jnp.arange(V, dtype=jnp.int32)[:, None]], axis=1)
+        logits2 = model.apply({"params": params}, exts)
+        p2_cond = jax.nn.softmax(
+            logits2[:, -1].astype(jnp.float32) / temperature, axis=-1)
+        p2 = p1 @ p2_cond                                     # [V]
+
+        n_trials = 1500
+        run = jax.jit(lambda key: speculative_sample(
+            cfg, params, draft_cfg, dparams, prompt, 2, gamma=2,
+            temperature=temperature, rng=key)[0][0, -2:])
+        keys = jax.random.split(jax.random.PRNGKey(42), n_trials)
+        samples = np.asarray(jax.vmap(run)(keys))             # [N, 2]
+
+        for pos, want in ((0, np.asarray(p1)), (1, np.asarray(p2))):
+            counts = np.bincount(samples[:, pos], minlength=V)
+            expected = want * n_trials
+            # chi-square over bins with expected >= 5 (standard validity
+            # rule); dof ~ bins-1, 99.9th percentile guard against flake
+            mask = expected >= 5
+            chi2 = float(np.sum(
+                (counts[mask] - expected[mask]) ** 2 / expected[mask]))
+            dof = int(mask.sum()) - 1
+            from math import sqrt
+
+            # chi2 99.9% quantile approx: dof + 3.1*sqrt(2*dof) + 9.5
+            bound = dof + 3.1 * sqrt(2 * dof) + 9.5
+            assert chi2 < bound, (pos, chi2, bound, dof)
