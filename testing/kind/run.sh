@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# One-command self-recording kind lane: every step of README.md executed
+# in order, with the evidence the README asks for captured MECHANICALLY —
+# the first docker-bearing environment that runs this produces the
+# committable artifact with zero judgment at run time:
+#   testing/kind/RUN_<date>.log      full transcript (fixtures --real,
+#                                    deploy, behavioral runner PASS lines)
+#   testing/kind/RUN_<date>.nodes.json   per-node allocatable (google.com/tpu)
+# A failure anywhere still leaves the partial log for diagnosis (the trap
+# records the exit code as the last line).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+STAMP=$(date +%Y-%m-%d_%H%M%S)
+LOG="testing/kind/RUN_${STAMP}.log"
+NODES="testing/kind/RUN_${STAMP}.nodes.json"
+CLUSTER="${CLUSTER:-kubeflow-tpu}"
+PROXY_PORT="${PROXY_PORT:-8001}"
+
+exec > >(tee "$LOG") 2>&1
+finish() {
+  rc=$?
+  echo "== exit code: $rc =="
+  [[ -n "${PROXY_PID:-}" ]] && kill "$PROXY_PID" 2>/dev/null || true
+  exit $rc
+}
+trap finish EXIT
+
+echo "== kind lane run ${STAMP} =="
+command -v docker >/dev/null || { echo "no docker in this environment"; exit 2; }
+bash testing/kind/install_kind.sh
+kind get clusters | grep -qx "$CLUSTER" || \
+  kind create cluster --name "$CLUSTER" --wait 120s \
+    --config testing/kind/cluster.yaml
+
+kubectl proxy --port "$PROXY_PORT" &
+PROXY_PID=$!
+sleep 2
+
+echo "== 1/3 apiserver fixtures against the REAL apiserver =="
+# CRD without the conversion clause first: fixtures run pre-controller
+python - <<'PY' | kubectl apply -f -
+import yaml
+from kubeflow_tpu.deploy.manifests import notebook_crd
+print(yaml.safe_dump(notebook_crd(conversion_webhook=False)))
+PY
+python -m kubeflow_tpu.kube.fixtures \
+  --server "http://127.0.0.1:${PROXY_PORT}" --real
+
+echo "== 2/3 webhook-enabled deploy + fake TPU device plugin =="
+bash testing/kind/deploy.sh
+
+echo "== capturing node allocatable -> ${NODES} =="
+kubectl get nodes -o json | python -c '
+import json, sys
+items = json.load(sys.stdin)["items"]
+out = [{"name": n["metadata"]["name"],
+        "allocatable": n["status"]["allocatable"]} for n in items]
+print(json.dumps(out, indent=2))
+' > "$NODES"
+cat "$NODES"
+
+echo "== 3/3 black-box behavioral contract (gang must BIND) =="
+python conformance/behavior.py \
+  --server "http://127.0.0.1:${PROXY_PORT}" --expect-scheduled
+
+echo "kind lane: PASS (evidence: ${LOG}, ${NODES})"
