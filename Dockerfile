@@ -10,7 +10,16 @@ FROM python:3.12-slim
 WORKDIR /opt/app
 COPY pyproject.toml README.md ./
 COPY kubeflow_tpu ./kubeflow_tpu
-RUN pip install --no-cache-dir pyyaml cryptography && \
+COPY ci ./ci
+# The static gates RUN AT BUILD TIME — a type error fails the image
+# build, so "the typecheck gate ran" is a property of every built image
+# (ruff+mypy pinned; ci/lint.py adds the stdlib call-signature checker).
+# The tools stay installed for ci/run_tests.sh --typecheck at runtime.
+RUN pip install --no-cache-dir pyyaml cryptography \
+        ruff==0.8.4 mypy==1.14.1 && \
+    python ci/lint.py && \
+    ruff check kubeflow_tpu && \
+    mypy kubeflow_tpu && \
     pip install --no-cache-dir --no-deps .
 
 # run as non-root (restricted PodSecurity), like the reference manager images

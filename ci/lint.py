@@ -11,6 +11,11 @@ that needs no third-party tooling so the gate also runs in hermetic images:
   - `except Exception: pass` silent swallows (comment-free)
   - tabs in indentation / trailing whitespace
   - f-strings with no placeholders
+  - intra-repo call signatures: calls to kubeflow_tpu module-level
+    functions are checked against the definition's arity and keyword
+    names (conservative: undecorated plain functions without *args /
+    **kwargs only) — the locally-runnable slice of what mypy's
+    call-checking provides
 """
 
 from __future__ import annotations
@@ -28,7 +33,9 @@ def iter_files():
         p = ROOT / t
         if p.is_file():
             yield p
-        else:
+        elif p.is_dir():
+            # some targets are absent in reduced contexts (the Dockerfile
+            # build runs this with only kubeflow_tpu + ci copied in)
             yield from sorted(p.rglob("*.py"))
 
 
@@ -91,13 +98,14 @@ class Visitor(ast.NodeVisitor):
                     self.visit(part)
 
 
-def check(path: Path) -> list[str]:
+def check(path: Path, tree: "ast.AST | None" = None) -> list[str]:
     src = path.read_text()
     rel = path.relative_to(ROOT)
-    try:
-        tree = ast.parse(src, filename=str(rel))
-    except SyntaxError as err:
-        return [f"{rel}:{err.lineno}: syntax error: {err.msg}"]
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=str(rel))
+        except SyntaxError as err:
+            return [f"{rel}:{err.lineno}: syntax error: {err.msg}"]
     v = Visitor(src)
     v.visit(tree)
     out = [f"{rel}:{line}: {msg}" for line, msg in v.problems]
@@ -138,12 +146,136 @@ def check(path: Path) -> list[str]:
     return out
 
 
+def _collect_signatures() -> dict:
+    """module path ('kubeflow_tpu.models.generate') -> {fn_name: spec}
+    for CHECKABLE module-level functions: no decorators, no *args /
+    **kwargs, not nested.  spec = (min_pos, max_pos, kwonly_required,
+    all_kw_names)."""
+    sigs: dict[str, dict] = {}
+    pkg = ROOT / "kubeflow_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(ROOT).with_suffix("")
+        module = ".".join(rel.parts)
+        if module.endswith(".__init__"):
+            module = module[: -len(".__init__")]
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue
+        table = {}
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef) or node.decorator_list:
+                continue
+            a = node.args
+            if a.vararg or a.kwarg:
+                continue
+            pos = [p.arg for p in a.posonlyargs + a.args]
+            n_default = len(a.defaults)
+            kwonly = [p.arg for p in a.kwonlyargs]
+            kwonly_required = {
+                p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                if d is None}
+            table[node.name] = (len(pos) - n_default, len(pos),
+                                kwonly_required, set(pos) | set(kwonly),
+                                pos)
+        if table:
+            sigs[module] = table
+    return sigs
+
+
+class CallChecker(ast.NodeVisitor):
+    """Check direct calls to imported kubeflow_tpu module functions."""
+
+    def __init__(self, sigs: dict, tree: ast.AST):
+        self.problems: list[tuple[int, str]] = []
+        self.targets: dict[str, tuple] = {}   # local name -> spec
+        # module-level imports only: function-local imports and any name
+        # rebound at module scope (def/class/assign) must not be checked
+        # against the package signature
+        for node in tree.body:
+            if not isinstance(node, ast.ImportFrom) or not node.module:
+                continue
+            table = sigs.get(node.module)
+            # relative imports inside the package: resolve best-effort by
+            # suffix match (unique or nothing)
+            if table is None and node.level:
+                cands = [m for m in sigs
+                         if m.endswith("." + node.module)]
+                table = sigs[cands[0]] if len(cands) == 1 else None
+            if not table:
+                continue
+            for alias in node.names:
+                if alias.name in table:
+                    self.targets[alias.asname or alias.name] = (
+                        alias.name, table[alias.name])
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self.targets.pop(node.name, None)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.targets.pop(t.id, None)
+
+    def visit_Call(self, node):  # noqa: N802
+        self.generic_visit(node)
+        if not isinstance(node.func, ast.Name):
+            return
+        spec = self.targets.get(node.func.id)
+        if spec is None:
+            return
+        name, (min_pos, max_pos, kwonly_required, all_kw, pos_names) = spec
+        if any(isinstance(a, ast.Starred) for a in node.args) or \
+                any(k.arg is None for k in node.keywords):
+            return  # *args / **kwargs at the call site: not checkable
+        n_pos = len(node.args)
+        kw_names = {k.arg for k in node.keywords}
+        if n_pos > max_pos:
+            self.problems.append(
+                (node.lineno,
+                 f"call to {name}(): {n_pos} positional args, "
+                 f"definition takes at most {max_pos}"))
+        if n_pos + len(kw_names & set(pos_names)) < min_pos:
+            self.problems.append(
+                (node.lineno,
+                 f"call to {name}(): too few arguments "
+                 f"(needs {min_pos} required positional)"))
+        unknown = kw_names - all_kw
+        if unknown:
+            self.problems.append(
+                (node.lineno,
+                 f"call to {name}(): unknown keyword(s) "
+                 f"{sorted(unknown)}"))
+        missing = kwonly_required - kw_names
+        if missing:
+            self.problems.append(
+                (node.lineno,
+                 f"call to {name}(): missing required keyword-only "
+                 f"arg(s) {sorted(missing)}"))
+
+
+def check_calls(path: Path, sigs: dict, tree: ast.AST) -> list[str]:
+    rel = path.relative_to(ROOT)
+    checker = CallChecker(sigs, tree)
+    checker.visit(tree)
+    return [f"{rel}:{line}: {msg}" for line, msg in checker.problems]
+
+
 def main() -> int:
     failures = []
     count = 0
+    sigs = _collect_signatures()
     for path in iter_files():
         count += 1
-        failures.extend(check(path))
+        try:
+            tree = ast.parse(path.read_text(),
+                             filename=str(path.relative_to(ROOT)))
+        except SyntaxError as err:
+            failures.append(f"{path.relative_to(ROOT)}:{err.lineno}: "
+                            f"syntax error: {err.msg}")
+            continue
+        failures.extend(check(path, tree))
+        failures.extend(check_calls(path, sigs, tree))
     for f in failures:
         print(f)
     print(f"lint: {count} files, {len(failures)} problems")

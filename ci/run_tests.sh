@@ -6,6 +6,18 @@
 # config), so the lint/typecheck workflows enforce outside GitHub too.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# --typecheck: the ruff+mypy gate is REQUIRED — absence fails instead of
+# silently skipping (a gate that never runs is not coverage; the tools
+# are vendored into the Dockerfile image).  Without the flag they still
+# run opportunistically when installed.
+REQUIRE_TYPECHECK=0
+FILTERED=()
+for a in "$@"; do
+  if [[ "$a" == "--typecheck" ]]; then REQUIRE_TYPECHECK=1; else FILTERED+=("$a"); fi
+done
+set -- ${FILTERED+"${FILTERED[@]}"}
+
 python ci/lint.py
 if command -v ruff >/dev/null 2>&1; then
   RUFF="ruff"
@@ -17,10 +29,16 @@ fi
 if [[ -n "$RUFF" ]]; then
   echo "== ruff =="
   $RUFF check kubeflow_tpu tests ci
+elif [[ "$REQUIRE_TYPECHECK" == 1 ]]; then
+  echo "--typecheck: ruff not installed (use the Dockerfile image)" >&2
+  exit 3
 fi
 if python -c "import mypy" 2>/dev/null; then
   echo "== mypy =="
   python -m mypy kubeflow_tpu
+elif [[ "$REQUIRE_TYPECHECK" == 1 ]]; then
+  echo "--typecheck: mypy not installed (use the Dockerfile image)" >&2
+  exit 3
 fi
 # Lanes (tests/conftest.py markers): --lane controlplane is the fast
 # developer loop (~2 min, no XLA compiles of model graphs); --lane compute
