@@ -19,6 +19,7 @@ import logging
 import queue
 import ssl
 import threading
+from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
@@ -194,9 +195,28 @@ class _WireHandler(BaseHTTPRequestHandler):
     _snapshot_seq = [0]
     _MAX_SNAPSHOTS = 32
 
+    # request-audit trail (envtest's apiserver audit-log analog,
+    # odh suite_test.go:126-156): one JSON line per request when wired
+    _audit_fh = None
+    _audit_lock: Optional[threading.Lock] = None
+
     # -- plumbing -------------------------------------------------------------
     def log_message(self, *args):  # route through logging, not stderr
         logger.debug("%s", args)
+
+    def log_request(self, code="-", size="-"):  # noqa: A002
+        if self._audit_fh is None:
+            return
+        line = json.dumps({
+            "ts": datetime.now(timezone.utc).isoformat(),
+            "verb": self.command,
+            "path": self.path,
+            "code": int(code) if str(code).isdigit() else str(code),
+            "userAgent": self.headers.get("User-Agent", ""),
+        })
+        with self._audit_lock:
+            self._audit_fh.write(line + "\n")
+            self._audit_fh.flush()
 
     def _authorized(self) -> bool:
         if not self.token:
@@ -888,11 +908,16 @@ class KubeApiWireServer:
                  host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None,
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 converter=None) -> None:
+                 converter=None, audit_log: Optional[str] = None) -> None:
         self.api = api
+        # audit_log: path for a JSONL request trail (ts/verb/path/code) —
+        # the debugging knob envtest exposes via the apiserver audit log
+        self._audit_fh = open(audit_log, "a") if audit_log else None
         handler = type("Handler", (_WireHandler,), {
             "api": api, "scheme": scheme or DEFAULT_SCHEME, "token": token,
             "converter": staticmethod(converter) if converter else None,
+            "_audit_fh": self._audit_fh,
+            "_audit_lock": threading.Lock() if audit_log else None,
             # per-server pagination snapshots (a class attr on the subclass,
             # NOT the shared base — two servers must not see each other's
             # continue tokens)
@@ -925,6 +950,8 @@ class KubeApiWireServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._audit_fh is not None:
+            self._audit_fh.close()
 
 
 __all__ = ["KubeApiWireServer", "parse_label_selector",

@@ -231,6 +231,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                              "TPU workloads actually schedule — the "
                              "in-memory analog of the kind lane's fake "
                              "device plugin (tpu/device_plugin.py)")
+    parser.add_argument("--audit-log", default="", metavar="PATH",
+                        help="with --serve-api: append a JSONL request "
+                             "trail (ts/verb/path/code) — the analog of "
+                             "envtest's apiserver audit-log debug knob")
     parser.add_argument("--debug-log", action="store_true")
     args = parser.parse_args(argv)
 
@@ -269,7 +273,8 @@ def main(argv: Optional[list[str]] = None) -> int:
 
         wire_server = KubeApiWireServer(
             api, host="127.0.0.1", port=args.serve_api,
-            converter=convert_notebook_dict).start()
+            converter=convert_notebook_dict,
+            audit_log=args.audit_log or None).start()
         logging.info("wire apiserver on %s", wire_server.url)
         print(f"WIRE_API={wire_server.url}", flush=True)
 

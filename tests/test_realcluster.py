@@ -977,3 +977,36 @@ class TestRateLimiter:
         for _ in range(1000):
             rl.acquire()
         assert time.monotonic() - t0 < 0.1
+
+
+class TestAuditLog:
+    """The wire server's request-audit trail (envtest audit-log analog,
+    odh suite_test.go:126-156): one JSONL line per request."""
+
+    def test_requests_recorded(self, tmp_path):
+        import json as _json
+
+        from kubeflow_tpu.kube import ApiServer
+        from kubeflow_tpu.kube.wire import KubeApiWireServer
+
+        audit = tmp_path / "audit.jsonl"
+        srv = KubeApiWireServer(ApiServer(), audit_log=str(audit)).start()
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(srv.url + "/api/v1") as resp:
+                assert resp.status == 200
+            try:
+                urllib.request.urlopen(
+                    srv.url + "/api/v1/namespaces/default/configmaps/nope")
+            except urllib.error.HTTPError as err:
+                assert err.code == 404
+        finally:
+            srv.stop()
+        lines = [_json.loads(ln) for ln in
+                 audit.read_text().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["verb"] == "GET" and lines[0]["code"] == 200
+        assert lines[1]["path"].endswith("/configmaps/nope")
+        assert lines[1]["code"] == 404
+        assert all("ts" in ln for ln in lines)
