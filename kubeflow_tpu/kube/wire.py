@@ -407,6 +407,7 @@ class _WireHandler(BaseHTTPRequestHandler):
                 "additionalProperties": dict(node_ref),
             }
         }
+        crd_schemas = self._crd_field_schemas()
         for i in self._served_infos():
             group = i.group or "core"
             name = f"{group}.{i.version}.{i.kind}"
@@ -424,7 +425,35 @@ class _WireHandler(BaseHTTPRequestHandler):
                 },
                 "additionalProperties": dict(node_ref),
             }
+            # per-field models come from the CRD object itself, exactly
+            # like a real apiserver: a stored CustomResourceDefinition's
+            # openAPIV3Schema overrides the generic spec/status nodes for
+            # its kind+version (main.py --serve-api seeds the Notebook
+            # CRD so the standalone profile serves its field models)
+            crd = crd_schemas.get((i.group, i.version, i.kind))
+            if crd:
+                for field in ("spec", "status"):
+                    if field in crd.get("properties", {}):
+                        schemas[name]["properties"][field] = \
+                            crd["properties"][field]
         return schemas
+
+    def _crd_field_schemas(self) -> dict:
+        """(group, version, kind) -> openAPIV3Schema from stored CRDs."""
+        out: dict = {}
+        try:
+            crds = self.api.list("CustomResourceDefinition")
+        except Exception:
+            return out
+        for crd in crds:
+            spec = crd.body.get("spec", {})
+            group = spec.get("group", "")
+            kind = spec.get("names", {}).get("kind", "")
+            for v in spec.get("versions", []):
+                schema = (v.get("schema") or {}).get("openAPIV3Schema")
+                if schema and group and kind:
+                    out[(group, v.get("name", ""), kind)] = schema
+        return out
 
     def _serve_openapi(self) -> bool:
         """/openapi/v2 (swagger 2.0) and /openapi/v3 (discovery root +

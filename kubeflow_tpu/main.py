@@ -271,6 +271,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         from .api.types import convert_notebook_dict
         from .kube.wire import KubeApiWireServer
 
+        # seed the Notebook CRD object so /openapi serves its per-field
+        # models (the wire server reads field schemas off stored CRDs,
+        # exactly like a real apiserver)
+        from .deploy.manifests import notebook_crd
+        from .kube.meta import KubeObject
+
+        if api.try_get("CustomResourceDefinition", "",
+                       "notebooks.kubeflow.org") is None:
+            api.create(KubeObject.from_dict(
+                notebook_crd(conversion_webhook=False)))
+
         wire_server = KubeApiWireServer(
             api, host="127.0.0.1", port=args.serve_api,
             converter=convert_notebook_dict,

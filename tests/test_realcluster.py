@@ -1010,3 +1010,41 @@ class TestAuditLog:
         assert lines[1]["path"].endswith("/configmaps/nope")
         assert lines[1]["code"] == 404
         assert all("ts" in ln for ln in lines)
+
+
+class TestOpenApiCrdFieldModels:
+    """Per-field type models come from stored CRD objects — a created
+    CustomResourceDefinition's openAPIV3Schema replaces the generic
+    spec/status nodes for its kind, as on a real apiserver."""
+
+    def test_notebook_spec_fields_served_from_crd(self):
+        from kubeflow_tpu.deploy.manifests import notebook_crd
+        from kubeflow_tpu.kube.meta import KubeObject
+
+        api = ApiServer()
+        api.create(KubeObject.from_dict(notebook_crd(
+            conversion_webhook=False)))
+        srv = KubeApiWireServer(api).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/openapi/v2",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+        finally:
+            srv.stop()
+        nb = doc["definitions"]["kubeflow.org.v1.Notebook"]
+        spec_props = nb["properties"]["spec"]["properties"]
+        # the CRD's per-field models, not the generic merge node
+        assert "tpu" in spec_props
+        tpu = spec_props["tpu"]["properties"]
+        assert {"accelerator", "topology", "slices"} <= set(tpu)
+
+    def test_without_crd_generic_node_stays(self):
+        srv = KubeApiWireServer(ApiServer()).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/openapi/v2",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+        finally:
+            srv.stop()
+        nb = doc["definitions"]["kubeflow.org.v1.Notebook"]
+        assert "$ref" in nb["properties"]["spec"]
