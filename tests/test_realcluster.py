@@ -390,7 +390,12 @@ class TestControllersOverWire:
             lambda: client.try_get("StatefulSet", "default", "real-nb"),
             msg="StatefulSet created over the wire")
         assert sts.spec["replicas"] == 1
-        svc = client.get("Service", "default", "real-nb")
+        # the reconciler creates the Service AFTER the StatefulSet — under
+        # host load the gap is observable, so poll (was a load-dependent
+        # flake: NotFoundError when compile-heavy suites share the box)
+        svc = wait_for(
+            lambda: client.try_get("Service", "default", "real-nb"),
+            msg="Service created over the wire")
         ports = svc.spec["ports"]
         assert ports[0]["port"] == 80 and ports[0]["targetPort"] == 8888
         nb = wait_for(
