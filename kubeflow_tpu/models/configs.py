@@ -47,6 +47,16 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_mlp_dim: int = 0             # per-expert hidden; 0 = mlp_dim
     moe_aux_weight: float = 0.01     # load-balance loss weight
+    staged_kv: bool = False          # decode-path KV write staging: single
+                                     # -token cache writes land in a small
+                                     # [B,kvH,8,D] stage and flush to the
+                                     # main cache as ALIGNED 8-row tiles —
+                                     # the per-step dynamic_update_slice
+                                     # otherwise read-modify-writes a full
+                                     # (8,128) tile row per buffer
+                                     # (ci/kv_cache_probe.py).  Requires
+                                     # prefill-from-empty; the speculative
+                                     # rewind path keeps this off
     fused_projections: bool = False  # decode-path op-count fusion: one
                                      # qkv matmul + one gate_up matmul per
                                      # layer instead of five (decode is

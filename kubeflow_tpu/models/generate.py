@@ -41,16 +41,26 @@ def decode_config(cfg: TransformerConfig,
     profiler's A/B baseline).  Params from a scan_layers=True training run
     are converted by `generate` (see `unroll_params`).
     """
-    # fused projections (one qkv + one gate_up matmul per layer) are the
-    # decode default — but only when CONVERTING a training config: a cfg
-    # that is already decode-shaped keeps its explicit setting, so callers
-    # can request the unfused layout (A/B profiling, old quantized trees)
-    # without this function silently overriding them
+    # fused projections (one qkv + one gate_up matmul per layer) and
+    # staged KV writes are the decode defaults — but only when CONVERTING
+    # a training config: a cfg that is already decode-shaped keeps its
+    # explicit settings, so callers can request the unfused layout or
+    # unstaged writes (A/B profiling, old quantized trees, the
+    # speculative rewind path) without this function overriding them
     already_decode = not cfg.remat and cfg.attention_impl == "xla"
     fused = cfg.fused_projections if already_decode else True
+    staged = cfg.staged_kv if already_decode else True
+    if not unroll_layers:
+        if already_decode and cfg.staged_kv:
+            raise ValueError(
+                "staged_kv is not supported under scanned layers "
+                "(stage buffers would become scanned variables — the "
+                "re-stacking cost staging exists to avoid)")
+        staged = False
     return cfg.with_(remat=False, attention_impl="xla",
                      scan_layers=not unroll_layers,
-                     fused_projections=fused)
+                     fused_projections=fused,
+                     staged_kv=staged)
 
 
 def unroll_params(params, num_layers: int):

@@ -80,6 +80,10 @@ def speculative_generate(
         raise ValueError("gamma must be >= 2 (acceptance caps at gamma-1)")
     t_cfg, target_params = prepare_decode(target_cfg, target_params)
     d_cfg, draft_params = prepare_decode(draft_cfg, draft_params)
+    # staged KV writes assume a forward-only fill; the rewind would have
+    # to re-seed the stage from the main cache — keep the simple path
+    t_cfg = t_cfg.with_(staged_kv=False)
+    d_cfg = d_cfg.with_(staged_kv=False)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     # the verify pass appends up to gamma+1 positions past the last
@@ -212,6 +216,8 @@ def speculative_sample(
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     t_cfg, target_params = prepare_decode(target_cfg, target_params)
     d_cfg, draft_params = prepare_decode(draft_cfg, draft_params)
+    t_cfg = t_cfg.with_(staged_kv=False)
+    d_cfg = d_cfg.with_(staged_kv=False)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     t_cfg = t_cfg.with_(max_seq_len=total + gamma + 1)

@@ -176,7 +176,10 @@ class Attention(nn.Module):
             # transpose copy per step; here only the new token's slab is
             # transposed.  rope was applied with GLOBAL positions above,
             # so cached keys need no re-rotation.
-            from ..ops.attention import decode_attention
+            from ..ops.attention import (
+                decode_attention,
+                decode_attention_staged,
+            )
 
             batch = x.shape[0]
             cached_k = self.variable(
@@ -191,15 +194,79 @@ class Attention(nn.Module):
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
             cur = index.value
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.transpose(0, 2, 1, 3), (0, 0, cur, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.transpose(0, 2, 1, 3), (0, 0, cur, 0))
-            index.value = cur + x.shape[1]
-            # the visibility mask with q at global offset `cur` covers
-            # both the unwritten tail (kv_pos > q_pos) and causality
-            out = decode_attention(q, cached_k.value, cached_v.value,
-                                   q_offset=cur)
+            kt = k.transpose(0, 2, 1, 3)
+            vt = v.transpose(0, 2, 1, 3)
+            q_len = x.shape[1]
+            staged = cfg.staged_kv and q_len == 1
+            if cfg.staged_kv:
+                # 8-row staging (ci/kv_cache_probe.py: a 1-row DUS
+                # read-modify-writes a whole (8,128) tile row per buffer;
+                # staging flushes aligned full tiles instead).  Invariant:
+                # main cache = rows [0, flushed), flushed 8-aligned;
+                # stage slots [0, fill-flushed) = rows [flushed, fill).
+                stage_k = self.variable(
+                    "cache", "stage_key", jnp.zeros,
+                    (batch, cfg.num_kv_heads, 8, cfg.head_dim), k.dtype)
+                stage_v = self.variable(
+                    "cache", "stage_value", jnp.zeros,
+                    (batch, cfg.num_kv_heads, 8, cfg.head_dim), v.dtype)
+            if staged:
+                slot = jnp.mod(cur, 8)
+                stage_k.value = jax.lax.dynamic_update_slice(
+                    stage_k.value, kt, (0, 0, slot, 0))
+                stage_v.value = jax.lax.dynamic_update_slice(
+                    stage_v.value, vt, (0, 0, slot, 0))
+                fill = cur + 1
+                flushed = fill - jnp.mod(fill, 8)
+
+                def flush(main, stage):
+                    return jax.lax.dynamic_update_slice(
+                        main, stage, (0, 0, cur - 7, 0))
+
+                do_flush = slot == 7
+                cached_k.value = jax.lax.cond(
+                    do_flush, flush, lambda m, _s: m,
+                    cached_k.value, stage_k.value)
+                cached_v.value = jax.lax.cond(
+                    do_flush, flush, lambda m, _s: m,
+                    cached_v.value, stage_v.value)
+                index.value = fill
+                out = decode_attention_staged(
+                    q, cached_k.value, cached_v.value,
+                    stage_k.value, stage_v.value, flushed, fill)
+            else:
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, kt, (0, 0, cur, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, vt, (0, 0, cur, 0))
+                if cfg.staged_kv:
+                    # multi-token PREFILL-FROM-EMPTY only (cur == 0): the
+                    # main cache takes all rows; the unaligned tail is
+                    # COPIED into stage slots [0, tail) so later single-
+                    # token steps continue the invariant.  The tail/slot
+                    # math is wrong for cur > 0 (chunked prefill /
+                    # verify passes) — the cond guard skips the copy
+                    # there so at least the stage is never corrupted;
+                    # such callers must run staged_kv=False (the
+                    # speculative path does, speculative.py).
+                    tail = q_len % 8
+                    if tail:
+                        def copy_tail(stage, new):
+                            return jax.lax.dynamic_update_slice(
+                                stage, new[:, :, q_len - tail:, :],
+                                (0, 0, 0, 0))
+
+                        stage_k.value = jax.lax.cond(
+                            cur == 0, copy_tail, lambda s, _n: s,
+                            stage_k.value, kt)
+                        stage_v.value = jax.lax.cond(
+                            cur == 0, copy_tail, lambda s, _n: s,
+                            stage_v.value, vt)
+                index.value = cur + q_len
+                # the visibility mask with q at global offset `cur` covers
+                # both the unwritten tail (kv_pos > q_pos) and causality
+                out = decode_attention(q, cached_k.value, cached_v.value,
+                                       q_offset=cur)
             out = nn.with_logical_constraint(
                 out, ("batch", "seq", "heads", "kv"))
             return _dense(

@@ -97,6 +97,51 @@ def decode_attention(
     return out.reshape(batch, q_len, num_heads, head_dim)
 
 
+def decode_attention_staged(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    k_stage: jax.Array,
+    v_stage: jax.Array,
+    flushed,
+    fill,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """decode_attention over a main cache + an 8-row staging buffer.
+
+    Invariant (transformer.py staged_kv path): the main cache holds
+    global rows [0, flushed) with `flushed` 8-aligned; the stage holds
+    rows [flushed, fill) at slots [0, fill-flushed).  One softmax spans
+    both (concatenated score axis), so the result is exactly
+    decode_attention over the logically-merged cache.  Single-token
+    queries only (q_len == 1 — multi-token prefill writes the main cache
+    directly and uses decode_attention)."""
+    batch, q_len, num_heads, head_dim = q.shape
+    if q_len != 1:
+        raise ValueError("staged decode attention is single-token only")
+    kv_heads, kv_len = k_cache.shape[1], k_cache.shape[2]
+    stage_len = k_stage.shape[2]
+    groups = num_heads // kv_heads
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    qg = q.reshape(batch, q_len, kv_heads, groups, head_dim)
+    s_main = jnp.einsum(
+        "bqkgd,bksd->bkgqs", qg, k_cache,
+        preferred_element_type=jnp.float32) * scale
+    s_stage = jnp.einsum(
+        "bqkgd,bksd->bkgqs", qg, k_stage,
+        preferred_element_type=jnp.float32) * scale
+    vis_main = jnp.arange(kv_len) < flushed                 # [S]
+    vis_stage = (flushed + jnp.arange(stage_len)) < fill    # [8]
+    s_main = jnp.where(vis_main[None, None, None, None], s_main, -1e30)
+    s_stage = jnp.where(vis_stage[None, None, None, None], s_stage, -1e30)
+    scores = jnp.concatenate([s_main, s_stage], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    p_main, p_stage = probs[..., :kv_len], probs[..., kv_len:]
+    out = (jnp.einsum("bkgqs,bksd->bqkgd", p_main, v_cache)
+           + jnp.einsum("bkgqs,bksd->bqkgd", p_stage, v_stage))
+    return out.reshape(batch, q_len, num_heads, head_dim)
+
+
 @functools.cache
 def _pallas_flash():
     from jax.experimental.pallas.ops.tpu.flash_attention import (
