@@ -1,0 +1,101 @@
+"""13B-class int4 decode on ONE v5e chip — the capacity demo, end to end.
+
+BASELINE.md's int4 row used to claim "13B-class fits one 16-GiB chip" with
+no number behind it; this script earns the row the way ci/llama7b_decode.py
+did for int8: materialize the Llama-2-13B architecture host-side leaf by
+leaf (random weights — decode throughput does not depend on values),
+int4-quantize each leaf before device_put (models/quant.py
+quantize_params_int4: nibble-packed int8 storage + per-64-group scales,
+~6.8 GiB vs 26 GiB bf16), serve it through the Pallas dequant-matmul
+kernel (ops/int4_matmul.py), and report measured tok/s against the honest
+int4+KV HBM roofline.
+
+Batch is 16: the Pallas kernel needs M >= 16 rows (int4_matmul.supported);
+below that the XLA even/odd fallback path serves, measured ~2x slower on
+the 470M bench (BASELINE.md int4 row).
+
+Usage: python ci/llama13b_decode.py [batch] [new_tokens]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.models.configs import LLAMA2_13B  # noqa: E402
+from kubeflow_tpu.models.generate import decode_config, generate  # noqa: E402
+from kubeflow_tpu.models.quant import quantize_params_int4  # noqa: E402
+from kubeflow_tpu.models.transformer import Transformer  # noqa: E402
+from kubeflow_tpu.tpu.topology import ACCELERATORS  # noqa: E402
+
+from llama7b_decode import host_random_params  # noqa: E402
+
+
+def main() -> None:
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    new_tokens = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    prompt_len = 128
+    cfg = decode_config(LLAMA2_13B).with_(
+        max_seq_len=prompt_len + new_tokens, weight_dtype="int4")
+
+    model_f = Transformer(decode_config(LLAMA2_13B).with_(
+        max_seq_len=prompt_len + new_tokens))
+    sample = jnp.ones((1, 8), jnp.int32)
+    # host-side init + int4-quantize per leaf: the bf16 tree lives on
+    # HOST, only the packed int4 tree touches HBM
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = host_random_params(model_f, sample)
+        qparams = quantize_params_int4(params)
+        del params
+    qparams = jax.device_put(qparams, jax.devices()[0])
+
+    from kubeflow_tpu.models.quant import quantized_bytes
+
+    w_bytes = quantized_bytes(qparams)  # streamed (embed lookup excluded)
+    resident_bytes = quantized_bytes(qparams, exclude=())
+    kv_bytes = (2 * batch * cfg.max_seq_len * cfg.num_kv_heads
+                * cfg.head_dim * 2 * cfg.num_layers)
+    print(f"int4 weights: {resident_bytes / 2**30:.2f} GiB resident "
+          f"(bf16 would be {LLAMA2_13B.num_params * 2 / 2**30:.1f} GiB); "
+          f"kv cache: {kv_bytes / 2**30:.2f} GiB", file=sys.stderr)
+
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (batch, prompt_len),
+                                0, cfg.vocab_size)
+    run = jax.jit(lambda p, t: generate(cfg, p, t, new_tokens))
+    np.asarray(run(qparams, prompt))  # compile + warmup (value transfer)
+    best = 0.0
+    for i in range(3):
+        p = jax.random.randint(jax.random.PRNGKey(100 + i),
+                               (batch, prompt_len), 0, cfg.vocab_size)
+        np.asarray(p)
+        t0 = time.perf_counter()
+        np.asarray(run(qparams, p))
+        best = max(best, batch * new_tokens / (time.perf_counter() - t0))
+
+    roofline = ACCELERATORS["v5e"].hbm_gbps * 1e9 / (w_bytes + kv_bytes) * batch
+    print(json.dumps({
+        "metric": "decode_tok_s_v5e_llama13b_int4",
+        "value": round(best, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(best / roofline, 4),
+        "detail": {
+            "model": "llama2-13b-arch", "batch": batch,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "weight_gb": round(resident_bytes / 2**30, 2),
+            "streamed_weight_gb": round(w_bytes / 2**30, 2),
+            "bf16_equiv_gb": round(LLAMA2_13B.num_params * 2 / 2**30, 1),
+            "hbm_roofline_tok_s": round(roofline, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
