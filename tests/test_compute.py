@@ -254,3 +254,65 @@ class TestGraftEntry:
         fn, (params, tokens) = __graft_entry__.entry()
         logits = jax.jit(fn)(params, tokens)
         assert logits.shape[0] == tokens.shape[0]
+
+
+class TestDecodeAttention:
+    """Unit tests for the layout-native decode attention ops: both must
+    equal the reference xla_attention over the logically-identical cache,
+    across GQA groupings, fills, and the staged main/stage split."""
+
+    def _ref(self, q, k_bshd, v_bshd, q_offset):
+        from kubeflow_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k_bshd, v_bshd, causal=True,
+                             q_offset=q_offset)
+
+    @pytest.mark.parametrize("kv_heads", [4, 2, 1])
+    def test_matches_reference_layouts(self, kv_heads):
+        from kubeflow_tpu.ops.attention import decode_attention
+
+        B, S, H, D = 2, 24, 4, 8
+        rng = jax.random.PRNGKey(0)
+        q = jax.random.normal(rng, (B, 1, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, kv_heads, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, kv_heads, D))
+        for offset in (0, 5, S - 1):
+            got = decode_attention(
+                q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+                q_offset=jnp.int32(offset))
+            want = self._ref(q, k, v, jnp.int32(offset))
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("fill", [9, 16, 23])
+    def test_staged_matches_merged(self, fill):
+        from kubeflow_tpu.ops.attention import (
+            decode_attention,
+            decode_attention_staged,
+        )
+
+        B, S, KVH, D = 2, 24, 2, 8
+        H = 4
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, 1, H, D))
+        full_k = jax.random.normal(jax.random.PRNGKey(1), (B, KVH, S, D))
+        full_v = jax.random.normal(jax.random.PRNGKey(2), (B, KVH, S, D))
+        flushed = fill - fill % 8
+        # main holds [0, flushed); stage slots [0, fill-flushed) hold the
+        # tail; everything else garbage that masking must hide
+        main_k = full_k.at[:, :, flushed:, :].set(99.0)
+        main_v = full_v.at[:, :, flushed:, :].set(99.0)
+        stage_k = jnp.full((B, KVH, 8, D), -77.0)
+        stage_v = jnp.full((B, KVH, 8, D), -77.0)
+        n_tail = fill - flushed
+        if n_tail:
+            stage_k = stage_k.at[:, :, :n_tail, :].set(
+                full_k[:, :, flushed:fill, :])
+            stage_v = stage_v.at[:, :, :n_tail, :].set(
+                full_v[:, :, flushed:fill, :])
+        got = decode_attention_staged(
+            q, main_k, main_v, stage_k, stage_v,
+            jnp.int32(flushed), jnp.int32(fill))
+        want = decode_attention(q, full_k, full_v,
+                                q_offset=jnp.int32(fill - 1))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
