@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Seeded chaos soak (tests/test_chaos.py::TestChaosSoak): N rounds of
+# random fault plans (kube/faults.py) against a TPU+auth notebook, driven
+# entirely on the FakeClock so wall time stays in seconds regardless of how
+# much backoff the injected faults provoke.
+#
+# The seed is printed up front and on failure — reproduce any run with
+#   CHAOS_SOAK_SEED=<seed> CHAOS_SOAK_ROUNDS=<n> ci/chaos_soak.sh
+# The default seed is date-stable (not time-derived) so CI is
+# deterministic; pass CHAOS_SOAK_SEED=random for an exploratory roll.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
+SEED="${CHAOS_SOAK_SEED:-20260804}"
+if [[ "$SEED" == "random" ]]; then
+  SEED=$((RANDOM * 32768 + RANDOM))
+fi
+
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} =="
+if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
+    python -m pytest tests/test_chaos.py::TestChaosSoak -q "$@"; then
+  echo "chaos soak FAILED — reproduce with:" >&2
+  echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} ci/chaos_soak.sh" >&2
+  exit 1
+fi
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS})"

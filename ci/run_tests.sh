@@ -62,3 +62,9 @@ if [[ -n "$LANE" ]]; then
 else
   python -m pytest tests/ -q ${ARGS+"${ARGS[@]}"}
 fi
+# seeded chaos soak at the CI round count (the in-suite run above already
+# did the default 20 rounds; this prints a reproducible seed line and runs
+# a deeper sweep — all FakeClock-driven, seconds of wall time)
+if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
+  bash ci/chaos_soak.sh
+fi

@@ -89,10 +89,18 @@ def main() -> int:
     print(f"int8 decode: token agreement vs bf16 = {agree:.2f}")
     assert agree > 0.8, agree
 
+    # speculative runs its decode with staged_kv=False (rewind path), so
+    # the bitwise-exactness reference must be the SAME numerics: an
+    # unstaged generate run.  Comparing against the staged default can
+    # flip near-tie argmaxes (softmax reassociation — the staged-vs-
+    # unstaged gate in tests/test_generate.py is >=0.95 agreement, not
+    # equality).
+    ref_unstaged = generate(decode_config(cfg).with_(staged_kv=False),
+                            params, prompt, max_new_tokens=12)
     spec_out, rounds = speculative_generate(
         cfg, params, cfg, params, prompt, 12, gamma=4)
-    assert (np.asarray(spec_out) == np.asarray(out)).all(), \
-        "speculative output must equal plain greedy"
+    assert (np.asarray(spec_out) == np.asarray(ref_unstaged)).all(), \
+        "speculative output must equal unstaged plain greedy"
     print(f"speculative (self-draft): exact in {int(rounds)} rounds")
 
     samp, steps, rate = speculative_sample(

@@ -13,9 +13,11 @@ from . import constants as C
 
 
 class NotebookMetrics:
-    def __init__(self, api: ApiServer, registry: Optional[Registry] = None):
+    def __init__(self, api: ApiServer, registry: Optional[Registry] = None,
+                 manager=None):
         self.api = api
         self.registry = registry or Registry()
+        self.manager = manager  # kube.Manager: workqueue gauges source
         self.running = self.registry.gauge(
             "notebook_running",
             "Current running notebooks in the cluster",
@@ -52,6 +54,37 @@ class NotebookMetrics:
             "Latency from Notebook creation to all workers Ready",
             labels=("namespace", "name"),
         )
+        # workqueue / retry observability (controller-runtime exports the
+        # same family: workqueue_depth, workqueue_retries_total) — scraped
+        # from Manager.queue_stats() when a manager is attached
+        self.workqueue_depth = self.registry.gauge(
+            "workqueue_depth",
+            "Current reconcile requests queued per controller",
+            labels=("controller",),
+        )
+        self.workqueue_backoff_pending = self.registry.gauge(
+            "workqueue_backoff_pending",
+            "Reconcile requests waiting out a retry backoff",
+            labels=("controller",),
+        )
+        self.workqueue_retries_total = self.registry.gauge(
+            "workqueue_retries_total",
+            "Total rate-limited requeues scheduled per controller",
+            labels=("controller",),
+        )
+        self.workqueue_last_backoff_seconds = self.registry.gauge(
+            "workqueue_last_backoff_seconds",
+            "Most recent backoff delay handed out per controller",
+            labels=("controller",),
+        )
+        self.reconcile_errors_total = self.registry.gauge(
+            "reconcile_errors_total",
+            "Reconcile requests dropped after exhausting their retry budget",
+            labels=("controller",),
+        )
+
+    def attach_manager(self, manager) -> None:
+        self.manager = manager
 
     def scrape(self) -> str:
         """List-based scrape (metrics.go:82-99): recompute gauges from the
@@ -85,4 +118,17 @@ class NotebookMetrics:
             self.running.labels(ns).set(len(names))
         for ns, n in per_ns_chips.items():
             self.tpu_chips_requested.labels(ns).set(n)
+        if self.manager is not None:
+            stats = self.manager.queue_stats()
+            for name in stats["controllers"]:
+                self.workqueue_depth.labels(name).set(
+                    stats["depth"].get(name, 0))
+                self.workqueue_backoff_pending.labels(name).set(
+                    stats["backoff_pending"].get(name, 0))
+                self.workqueue_retries_total.labels(name).set(
+                    stats["retries_total"].get(name, 0))
+                self.workqueue_last_backoff_seconds.labels(name).set(
+                    stats["last_backoff_s"].get(name, 0.0))
+                self.reconcile_errors_total.labels(name).set(
+                    stats["errors_total"].get(name, 0))
         return self.registry.render()

@@ -89,6 +89,13 @@ class NotebookReconciler:
         existing_by_slice = {slice_of(s): s for s in existing if slice_of(s)}
         live_names: list[str] = []  # ordered: slice 0 first
         matched_live: set[str] = set()
+        # Slice-atomic under partial failure: every slice STS is ATTEMPTED
+        # each pass even when an earlier one fails (a transient 500 on slice
+        # 0 must not leave slices 1..N un-reconciled — that is how a cull or
+        # scale-down strands a half-stopped TPU slice).  Errors aggregate
+        # and re-raise so the manager's backoff retries the whole set; the
+        # per-slice writes themselves are idempotent.
+        errors: list[Exception] = []
         for idx, desired in enumerate(desired_sets):
             set_controller_reference(obj, desired)
             if desired.name:
@@ -98,24 +105,51 @@ class NotebookReconciler:
                 found = existing_by_slice.get(s)
             else:
                 found = existing[0] if existing else None
-            if found is None:
-                self.metrics.creation.labels(req.namespace).inc()
-                try:
-                    live = self.api.create(desired)
-                except Exception:
-                    self.metrics.fail_creation.labels(req.namespace).inc()
-                    raise
-            else:
-                if rh.copy_statefulset_fields(desired, found):
-                    found = self.api.update(found)
-                live = found
+            try:
+                if found is None:
+                    self.metrics.creation.labels(req.namespace).inc()
+                    try:
+                        live = self.api.create(desired)
+                    except Exception:
+                        self.metrics.fail_creation.labels(req.namespace).inc()
+                        raise
+                else:
+                    if rh.copy_statefulset_fields(desired, found):
+                        found = self.api.update(found)
+                    live = found
+            except Exception as err:  # noqa: BLE001 — aggregated below
+                errors.append(err)
+                continue
             live_names.append(live.name)
             matched_live.add(live.name)
 
-        # prune slices beyond spec.tpu.slices (scale-in of multi-slice)
-        for s in existing:
-            if s.name not in matched_live:
-                self.api.delete("StatefulSet", req.namespace, s.name)
+        # prune slices beyond spec.tpu.slices (scale-in of multi-slice);
+        # same aggregation — one failed delete must not strand the rest.
+        # Skipped entirely when a create/update above failed: an STS whose
+        # update errored never joined matched_live, and "failed to match"
+        # must not be mistaken for "extra slice to delete".
+        if not errors:
+            for s in existing:
+                if s.name not in matched_live:
+                    try:
+                        self.api.delete("StatefulSet", req.namespace, s.name)
+                    except NotFoundError:
+                        pass
+                    except Exception as err:  # noqa: BLE001
+                        errors.append(err)
+
+        if errors:
+            # best-effort truthful status over EVERY existing STS, matched
+            # or not (a half-stopped slice must read Stopping/Degraded,
+            # never Stopped/Healthy), then fail the reconcile so the
+            # manager's rate-limited backoff retries it
+            names = live_names + [
+                s.name for s in existing if s.name not in matched_live]
+            try:
+                self._update_status(nb, names)
+            except Exception:  # noqa: BLE001 — the slice error wins
+                pass
+            raise errors[0]
 
         # Services
         svc = generate_service(nb)
@@ -241,7 +275,11 @@ class NotebookReconciler:
         if tpu is not None:
             stopped = C.STOP_ANNOTATION in nb.metadata.annotations
             if stopped:
-                slice_health = "Stopped"
+                # "Stopped" only once every worker is actually gone — a
+                # partially failed cull (some slice STS still scaled up)
+                # reads "Stopping", so nothing downstream treats a
+                # half-culled slice as safely parked
+                slice_health = "Stopped" if ready == 0 else "Stopping"
             elif ready == expected_hosts:
                 slice_health = "Healthy"
             elif ready == 0:
@@ -341,9 +379,21 @@ def setup_core_controllers(
     cfg = cfg or CoreConfig.from_env()
     api = mgr.api
     from ..api.validation import install_notebook_schema
+    from ..kube import default_rate_limiter
 
     install_notebook_schema(api)
-    metrics = metrics or NotebookMetrics(api)
+    # workqueue rate limiting from config (WORKQUEUE_* env vars): per-item
+    # exponential backoff + overall token bucket on the manager's clock
+    mgr.set_rate_limiter(default_rate_limiter(
+        mgr.clock,
+        base_s=cfg.workqueue_base_delay_s,
+        cap_s=cfg.workqueue_max_delay_s,
+        qps=cfg.workqueue_qps,
+        burst=cfg.workqueue_burst,
+    ))
+    metrics = metrics or NotebookMetrics(api, manager=mgr)
+    if metrics.manager is None:
+        metrics.attach_manager(mgr)
     recorder = EventRecorder(api, "notebook-controller")
     rec = NotebookReconciler(api, cfg, metrics, recorder, clock=mgr.clock)
 

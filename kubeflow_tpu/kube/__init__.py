@@ -2,7 +2,18 @@
 runtime + fake data plane) that the TPU notebook controllers run against."""
 
 from .cluster import FakeCluster, parse_quantity
-from .controller import Manager, Reconciler, Request, Result, WatchSpec
+from .controller import (
+    BucketRateLimiter,
+    ItemExponentialBackoff,
+    Manager,
+    MaxOfRateLimiter,
+    Reconciler,
+    Request,
+    Result,
+    WatchSpec,
+    default_rate_limiter,
+)
+from .faults import FaultPlan, FaultRecord, FaultRule, random_fault_plan
 from .errors import (
     AlreadyExistsError,
     ApiError,
@@ -34,16 +45,22 @@ __all__ = [
     "AlreadyExistsError",
     "ApiError",
     "ApiServer",
+    "BucketRateLimiter",
     "ConflictError",
     "EventRecorder",
     "EventType",
     "FakeCluster",
+    "FaultPlan",
+    "FaultRecord",
+    "FaultRule",
     "ForbiddenError",
     "GoneError",
     "InvalidError",
+    "ItemExponentialBackoff",
     "KubeObject",
     "LeaderElector",
     "Manager",
+    "MaxOfRateLimiter",
     "NotFoundError",
     "ServerError",
     "ObjectMeta",
@@ -53,6 +70,8 @@ __all__ = [
     "Result",
     "WatchEvent",
     "WatchSpec",
+    "default_rate_limiter",
+    "random_fault_plan",
     "is_already_exists",
     "is_conflict",
     "is_not_found",

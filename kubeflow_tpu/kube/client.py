@@ -483,6 +483,19 @@ class KubeClient:
                     except (TypeError, ValueError):
                         pass
                     continue
+                if ev["type"] == "ERROR":
+                    # mid-stream Status event: the apiserver compacted our
+                    # resourceVersion away (410 Gone / Expired).  The resume
+                    # RV is dead — raise GoneError so the informer loop
+                    # RELISTS instead of resuming from it (client-go
+                    # reflector does exactly this on watch.Error + Expired)
+                    status = ev.get("object") or {}
+                    if status.get("code") == 410 or \
+                            status.get("reason") == "Expired":
+                        raise GoneError(
+                            status.get("message", "watch expired"))
+                    raise ServerError(
+                        status.get("message", "watch stream error"))
                 etype = EventType(ev["type"])
                 obj = KubeObject.from_dict(ev["object"])
                 try:

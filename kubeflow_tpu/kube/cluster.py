@@ -37,7 +37,13 @@ def parse_quantity(q) -> float:
 
 
 class FakeCluster:
-    """Subscribes to the ApiServer and plays kubelet/scheduler/cloud."""
+    """Subscribes to the ApiServer and plays kubelet/scheduler/cloud.
+
+    Fault-exempt by construction: an installed kube.faults.FaultPlan models
+    client<->apiserver failures, and the data plane (kubelet, scheduler,
+    the SA secret controller) lives on the cluster side of that boundary —
+    its API calls run inside `api.fault_exempt()` so injected chaos breaks
+    the controllers under test, never the cluster's own machinery."""
 
     def __init__(self, api: ApiServer, auto_ready: bool = True) -> None:
         self.api = api
@@ -64,7 +70,8 @@ class FakeCluster:
                 }
             },
         )
-        return self.api.create(node)
+        with self.api.fault_exempt():
+            return self.api.create(node)
 
     def add_tpu_slice_nodes(
         self,
@@ -98,6 +105,10 @@ class FakeCluster:
     def fail_pod(self, namespace: str, name: str, reason: str = "TPUUnhealthy") -> None:
         """Chaos hook: mark a pod failed (analog of the operator-chaos harness,
         chaos/knowledge/workbenches.yaml)."""
+        with self.api.fault_exempt():
+            self._fail_pod(namespace, name, reason)
+
+    def _fail_pod(self, namespace: str, name: str, reason: str) -> None:
         pod = self.api.get("Pod", namespace, name)
         pod.status = {
             "phase": "Failed",
@@ -118,6 +129,10 @@ class FakeCluster:
 
     # -- event loop ------------------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
+        with self.api.fault_exempt():
+            self._handle_event(ev)
+
+    def _handle_event(self, ev: WatchEvent) -> None:
         kind = ev.obj.kind
         if kind == "StatefulSet":
             if ev.type in (EventType.ADDED, EventType.MODIFIED):

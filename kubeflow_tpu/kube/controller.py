@@ -14,12 +14,14 @@ mode serves standalone operation.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
 from ..utils.clock import Clock
+from .errors import GoneError
 from .meta import KubeObject
 from .store import ApiServer, WatchEvent
 
@@ -68,6 +70,172 @@ class _Delayed:
     due: float
     reg_name: str = field(compare=False)
     request: Request = field(compare=False)
+    # True for rate-limited retries (error backoff / Result.requeue): these
+    # are part of "draining the queue" and run_until_idle may advance a fake
+    # clock over them; requeue_after waits are scheduled work and are NOT
+    # auto-advanced (tests drive those with advance())
+    retry: bool = field(default=False, compare=False)
+
+
+# -- workqueue rate limiting ---------------------------------------------------
+# The controller-runtime default workqueue limiter:
+# MaxOfRateLimiter(ItemExponentialFailureRateLimiter(5ms, 1000s),
+#                  BucketRateLimiter(10 qps, 100 burst)).  `when(item)`
+# charges the item and returns the delay before it may run again;
+# `forget(item)` resets its failure history on success.
+
+
+class ItemExponentialBackoff:
+    """Per-item exponential backoff with bounded jitter.
+
+    delay = min(base * 2^failures, cap), then scaled by a seeded jitter in
+    [1, 1+jitter) — deterministic for a given seed, so tests can assert
+    exact bounds; jitter decorrelates retry herds in threaded mode."""
+
+    def __init__(self, base_s: float = 0.005, cap_s: float = 1000.0,
+                 jitter: float = 0.1, seed: int = 0) -> None:
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._failures: dict[object, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        delay = min(self.base_s * (2 ** n), self.cap_s)
+        if self.jitter > 0:
+            delay *= 1.0 + self.jitter * self._rng.random()
+        return delay
+
+    def forget(self, item) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_failures(self, item) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class BucketRateLimiter:
+    """Token-bucket overall limiter on an injectable clock
+    (client-go flowcontrol; reservations may drive tokens negative, so a
+    burst of retries spreads out at 1/qps)."""
+
+    def __init__(self, qps: float = 10.0, burst: int = 100,
+                 clock: Optional[Clock] = None) -> None:
+        self.qps = qps
+        self.burst = max(burst, 1)
+        self.clock = clock or Clock()
+        self._tokens = float(self.burst)
+        self._last = self.clock.now()
+        self._lock = threading.Lock()
+
+    def when(self, item) -> float:
+        if self.qps <= 0:
+            return 0.0
+        with self._lock:
+            now = self.clock.now()
+            self._tokens = min(float(self.burst),
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0  # reserve (may go negative)
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.qps
+
+    def forget(self, item) -> None:
+        pass
+
+    def num_failures(self, item) -> int:
+        return 0
+
+
+class MaxOfRateLimiter:
+    """The worst (longest) answer of its children — controller-runtime's
+    DefaultControllerRateLimiter composition."""
+
+    def __init__(self, *limiters) -> None:
+        self.limiters = limiters
+
+    def when(self, item) -> float:
+        return max(rl.when(item) for rl in self.limiters)
+
+    def forget(self, item) -> None:
+        for rl in self.limiters:
+            rl.forget(item)
+
+    def num_failures(self, item) -> int:
+        return max(rl.num_failures(item) for rl in self.limiters)
+
+
+def default_rate_limiter(
+    clock: Optional[Clock] = None,
+    base_s: float = 0.005,
+    cap_s: float = 1000.0,
+    qps: float = 10.0,
+    burst: int = 100,
+    jitter: float = 0.1,
+    seed: int = 0,
+) -> MaxOfRateLimiter:
+    """The workqueue limiter Manager installs by default; knobs map to
+    CoreConfig.workqueue_* (utils.config) so deployments can tune them."""
+    return MaxOfRateLimiter(
+        ItemExponentialBackoff(base_s=base_s, cap_s=cap_s, jitter=jitter,
+                               seed=seed),
+        BucketRateLimiter(qps=qps, burst=burst, clock=clock),
+    )
+
+
+class _WatchSession:
+    """The manager's droppable watch connection.  Tracks the newest
+    resourceVersion delivered so a fault-injected stream drop
+    (ApiServer.drop_watch_connections) can resume exactly where it left
+    off via subscribe(since_rv); if the history window was compacted away
+    (410 Gone) it reconnects live-only and RELISTS — enqueueing every
+    primary object, the client-go reflector's relist in controller terms."""
+
+    def __init__(self, mgr: "Manager") -> None:
+        self.mgr = mgr
+        self.last_rv = 0
+        self.connected = True
+        self.drops = 0
+        self.relists = 0
+
+    def __call__(self, ev: WatchEvent) -> None:
+        rv = ev.obj.metadata.resource_version
+        if isinstance(rv, int) and rv > self.last_rv:
+            self.last_rv = rv
+        self.mgr._on_event(ev)
+
+    def on_watch_dropped(self) -> None:
+        # noticed lazily, as a real client notices a dead stream: the
+        # manager reconnects at its next processing step, so events landing
+        # in between form a genuine gap the resume protocol must cover
+        self.drops += 1
+        self.connected = False
+
+    def reconnect(self) -> None:
+        api = self.mgr.api
+        try:
+            api.subscribe(self, since_rv=self.last_rv)
+        except GoneError:
+            # resume window compacted away (410): reconnect live and
+            # relist so no state transition is missed (level-triggered
+            # reconcilers re-derive everything from current state).  The
+            # relist itself is recovery machinery, not client traffic —
+            # exempt from an active fault plan
+            api.subscribe(self)
+            self.relists += 1
+            exempt = getattr(api, "fault_exempt", None)
+            if exempt is not None:
+                with exempt():
+                    self.mgr.enqueue_all()
+            else:
+                self.mgr.enqueue_all()
+        self.connected = True
 
 
 class Manager:
@@ -78,9 +246,11 @@ class Manager:
     standalone mode uses `start()` which spins a worker thread.
     """
 
-    def __init__(self, api: ApiServer, clock: Optional[Clock] = None) -> None:
+    def __init__(self, api: ApiServer, clock: Optional[Clock] = None,
+                 rate_limiter=None) -> None:
         self.api = api
         self.clock = clock or Clock()
+        self._limiter = rate_limiter or default_rate_limiter(self.clock)
         self._registrations: list[_Registration] = []
         self._lock = threading.Lock()
         self._queue: list[tuple[str, Request]] = []
@@ -88,9 +258,26 @@ class Manager:
         self._delayed: list[_Delayed] = []
         self._retries: dict[tuple[str, Request], int] = {}
         self._errors: list[tuple[str, Request, BaseException]] = []
+        # per-controller observability (scraped by core.metrics):
+        # retries scheduled, last backoff delay, errors dropped
+        self._retry_totals: dict[str, int] = {}
+        self._last_backoff: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        api.watch(self._on_event)
+        if hasattr(api, "subscribe"):
+            # in-memory ApiServer: a resumable session that survives
+            # injected watch-stream drops (kube.faults)
+            self._watch_session: Optional[_WatchSession] = _WatchSession(self)
+            api.watch(self._watch_session)
+        else:
+            # KubeClient: its reflector informers own drop/relist recovery
+            self._watch_session = None
+            api.watch(self._on_event)
+
+    def set_rate_limiter(self, rate_limiter) -> None:
+        """Swap the workqueue rate limiter (see default_rate_limiter);
+        in-flight failure history is dropped with the old limiter."""
+        self._limiter = rate_limiter
 
     # -- registration ---------------------------------------------------------
     def register(
@@ -123,10 +310,14 @@ class Manager:
             self._queue = [k for k in self._queue if k[0] != name]
             self._queued = {k for k in self._queued if k[0] != name}
             self._delayed = [d for d in self._delayed if d.reg_name != name]
-            # retry budgets die with the controller — a later registration
-            # under the same name starts fresh, not mid-backoff
+            # retry budgets AND rate-limiter history die with the controller
+            # — a later registration under the same name starts fresh, not
+            # mid-backoff
+            dropped = [k for k in self._retries if k[0] == name]
             self._retries = {k: v for k, v in self._retries.items()
                              if k[0] != name}
+        for k in dropped:
+            self._limiter.forget(k)
 
     # -- event -> requests ----------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
@@ -200,6 +391,9 @@ class Manager:
             self._enqueue(d.reg_name, d.request)
 
     def _process_one(self) -> bool:
+        if self._watch_session is not None and \
+                not self._watch_session.connected:
+            self._watch_session.reconnect()
         self._promote_delayed()
         item = self._pop()
         if item is None:
@@ -223,23 +417,30 @@ class Manager:
             if not alive():
                 return True
             if result.requeue_after > 0:
+                # explicit schedule: Forget (controller-runtime does on
+                # RequeueAfter) and wait out the caller's delay
+                self._limiter.forget(item)
                 with self._lock:
                     self._delayed.append(
                         _Delayed(self.clock.now() + result.requeue_after, reg_name, req)
                     )
             elif result.requeue:
-                self._enqueue(reg_name, req)
+                # AddRateLimited without Forget: repeated requeue=True backs
+                # off like a failure would
+                self._requeue_rate_limited(item)
+            else:
+                self._limiter.forget(item)
         except Exception as err:  # controller-runtime: requeue with backoff
             if not alive():
                 return True
             count = self._retries.get(item, 0) + 1
             self._retries[item] = count
             if count <= reg.max_retries:
+                delay = self._requeue_rate_limited(item)
                 logger.warning(
-                    "reconcile %s %s failed (attempt %d): %s",
-                    reg_name, req, count, err,
+                    "reconcile %s %s failed (attempt %d, retry in %.3fs): %s",
+                    reg_name, req, count, delay, err,
                 )
-                self._enqueue(reg_name, req)
             else:
                 logger.error(
                     "reconcile %s %s dropped after %d attempts:\n%s",
@@ -247,17 +448,55 @@ class Manager:
                 )
                 self._errors.append((reg_name, req, err))
                 self._retries.pop(item, None)  # fresh budget for future events
+                self._limiter.forget(item)
         return True
 
-    def run_until_idle(self, max_iterations: int = 10_000) -> int:
+    def _requeue_rate_limited(self, item: tuple[str, Request]) -> float:
+        """Re-enqueue through the workqueue rate limiter: per-item
+        exponential backoff with jitter, bounded overall by the token
+        bucket.  Zero delay (bucket not empty, first failure with a tiny
+        base) still round-trips the delayed queue so a hot-looping
+        reconciler cannot starve the rest of the queue."""
+        reg_name, req = item
+        delay = max(self._limiter.when(item), 0.0)
+        with self._lock:
+            self._retry_totals[reg_name] = \
+                self._retry_totals.get(reg_name, 0) + 1
+            self._last_backoff[reg_name] = delay
+            self._delayed.append(
+                _Delayed(self.clock.now() + delay, reg_name, req, retry=True))
+        return delay
+
+    def run_until_idle(self, max_iterations: int = 10_000,
+                       advance_clock: bool = True) -> int:
         """Drain the workqueue; returns number of reconciles executed.
-        Does NOT wait for delayed (requeue_after) items — use
-        `advance(seconds)` to move the fake clock and re-drain."""
+
+        Retry backoffs (error retries, rate-limited requeues) are part of
+        draining: with `advance_clock=True` (default) and an injected
+        FakeClock, the clock advances to each retry's due time so backoff
+        stays real AND deterministic.  requeue_after schedules are NOT
+        auto-advanced — use `advance(seconds)` to move the clock and
+        re-drain those, or pass advance_clock=False to observe pending
+        backoff state."""
         n = 0
-        while self._process_one():
-            n += 1
-            if n >= max_iterations:
-                raise RuntimeError("run_until_idle: reconcile loop did not settle")
+        adv = getattr(self.clock, "advance", None)
+        while True:
+            if self._process_one():
+                n += 1
+                if n >= max_iterations:
+                    raise RuntimeError(
+                        "run_until_idle: reconcile loop did not settle")
+                continue
+            if not advance_clock or adv is None:
+                break
+            with self._lock:
+                retry_due = [d.due for d in self._delayed if d.retry]
+            if not retry_due:
+                break
+            delta = min(retry_due) - self.clock.now()
+            if delta > 0:
+                adv(delta)
+            # loop: _process_one promotes the now-due retries
         return n
 
     def advance(self, seconds: float) -> int:
@@ -268,9 +507,61 @@ class Manager:
         adv(seconds)
         return self.run_until_idle()
 
+    def settle(self, max_seconds: float = 3600.0,
+               max_iterations: int = 100_000) -> int:
+        """Drain EVERYTHING a FakeClock can reach within `max_seconds` of
+        fake time: retries (run_until_idle) plus requeue_after schedules
+        falling due inside the budget.  The chaos soak uses this to reach
+        steady state after a fault plan drains; tests asserting on timing
+        should keep using run_until_idle/advance."""
+        adv = getattr(self.clock, "advance", None)
+        if adv is None:
+            raise TypeError("settle() requires a FakeClock")
+        deadline = self.clock.now() + max_seconds
+        total = 0
+        while True:
+            total += self.run_until_idle(max_iterations=max_iterations)
+            with self._lock:
+                due = [d.due for d in self._delayed]
+            if not due:
+                break
+            nxt = min(due)
+            if nxt > deadline:
+                break
+            delta = nxt - self.clock.now()
+            if delta > 0:
+                adv(delta)
+        return total
+
     def pending_delayed(self) -> list[tuple[str, Request, float]]:
         with self._lock:
             return [(d.reg_name, d.request, d.due) for d in self._delayed]
+
+    def queue_stats(self) -> dict:
+        """Workqueue observability snapshot (scraped into Prometheus gauges
+        by core.metrics.NotebookMetrics): per-controller queue depth,
+        pending backoff count, scheduled-retry totals, last backoff delay,
+        and dropped-error counts."""
+        with self._lock:
+            depth: dict[str, int] = {}
+            for reg_name, _ in self._queue:
+                depth[reg_name] = depth.get(reg_name, 0) + 1
+            backoff_pending: dict[str, int] = {}
+            for d in self._delayed:
+                if d.retry:
+                    backoff_pending[d.reg_name] = \
+                        backoff_pending.get(d.reg_name, 0) + 1
+            errors: dict[str, int] = {}
+            for reg_name, _, _ in self._errors:
+                errors[reg_name] = errors.get(reg_name, 0) + 1
+            return {
+                "depth": depth,
+                "backoff_pending": backoff_pending,
+                "retries_total": dict(self._retry_totals),
+                "last_backoff_s": dict(self._last_backoff),
+                "errors_total": errors,
+                "controllers": [r.name for r in self._registrations],
+            }
 
     @property
     def dropped_errors(self) -> list[tuple[str, Request, BaseException]]:

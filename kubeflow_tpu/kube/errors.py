@@ -7,8 +7,9 @@ components/notebook-controller/controllers/culling_controller.go:107,125,144.
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
 
 
 class ApiError(Exception):
@@ -70,23 +71,32 @@ T = TypeVar("T")
 def retry_on_conflict(
     fn: Callable[[], T],
     steps: int = 5,
-    initial_backoff_s: float = 0.0,
+    initial_backoff_s: float = 0.01,
     factor: float = 2.0,
+    max_backoff_s: float = 0.25,
+    jitter: float = 0.1,
+    sleep_fn: Optional[Callable[[float], None]] = None,
 ) -> T:
-    """Equivalent of retry.RetryOnConflict(retry.DefaultRetry, fn).
-
-    The in-memory API server is synchronous so the default backoff is zero;
-    steps mirror client-go's DefaultRetry (5 attempts).
-    """
+    """Equivalent of retry.RetryOnConflict(retry.DefaultRetry, fn), with
+    client-go's wait.Backoff semantics: capped exponential backoff plus
+    jitter between attempts, so a conflict storm (optimistic-concurrency
+    herd, injected 409s from a chaos plan) spreads out instead of
+    hot-looping.  Steps mirror DefaultRetry (5 attempts); the cap keeps the
+    worst case bounded (~0.6s total at the defaults).  `sleep_fn` is
+    injectable for deterministic tests (defaults to time.sleep)."""
     backoff = initial_backoff_s
+    sleep = sleep_fn if sleep_fn is not None else time.sleep
     last: Exception | None = None
-    for _ in range(steps):
+    for attempt in range(steps):
         try:
             return fn()
         except ConflictError as err:
             last = err
-            if backoff:
-                time.sleep(backoff)
+            if backoff > 0 and attempt < steps - 1:
+                delay = min(backoff, max_backoff_s)
+                if jitter > 0:
+                    delay *= 1.0 + jitter * random.random()
+                sleep(delay)
                 backoff *= factor
     assert last is not None
     raise last

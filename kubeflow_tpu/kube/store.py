@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import copy
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from enum import Enum
 from typing import Callable, Optional
@@ -90,6 +91,94 @@ class ApiServer:
         # (the apiserver's etcd watch cache; too-old rv -> 410 Gone and the
         # client relists, exactly client-go reflector behavior)
         self._history: deque[WatchEvent] = deque(maxlen=2048)
+        # resourceVersions <= the floor have been evicted from the history:
+        # a resume from below it cannot prove nothing was missed -> 410
+        self._history_floor = 0
+        # fault injection (kube.faults): a plan gates top-level verb entry;
+        # re-entrant internals and watch-driven components run at depth > 0
+        # and are exempt (thread-local so threaded managers stay correct)
+        self._fault_plan = None
+        self._fault_ctx = threading.local()
+
+    # -- fault injection ------------------------------------------------------
+    def install_fault_plan(self, plan) -> None:
+        """Install a kube.faults.FaultPlan on the API surface.  Replaces any
+        existing plan; None (or clear_fault_plan) removes it."""
+        self._fault_plan = plan
+
+    def clear_fault_plan(self) -> None:
+        self._fault_plan = None
+
+    @property
+    def fault_plan(self):
+        return self._fault_plan
+
+    @contextmanager
+    def fault_exempt(self):
+        """Run a block immune to the installed fault plan — for test-harness
+        setup/assertion calls and cluster-internal components (the faults
+        model client<->apiserver failures, not the store's own integrity)."""
+        depth = getattr(self._fault_ctx, "depth", 0)
+        self._fault_ctx.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._fault_ctx.depth = depth
+
+    @contextmanager
+    def _fault_scope(self, verb: str, kind: str, namespace: str = "",
+                     name: str = ""):
+        """Top-level verb gate: consult the fault plan once per outermost
+        call (nested ApiServer re-entry — GC, patch retry loops, admission,
+        watch fan-out — runs at depth > 0 and is exempt).  Yields optional
+        directives for the verb body (e.g. {"stale": True})."""
+        depth = getattr(self._fault_ctx, "depth", 0)
+        self._fault_ctx.depth = depth + 1
+        try:
+            directives = None
+            if depth == 0 and self._fault_plan is not None:
+                # plan actions (watch drops -> resubscribe -> relist) run
+                # inside this scope, so they cannot recursively re-fault
+                directives = self._fault_plan.intercept(
+                    self, verb, kind, namespace, name)
+            yield directives
+        finally:
+            self._fault_ctx.depth = depth
+
+    def drop_watch_connections(self) -> int:
+        """Disconnect every RESUMABLE watcher (one with an
+        `on_watch_dropped` method) — the analog of the apiserver closing
+        client watch streams.  Plain callback watchers (the FakeCluster
+        data plane, test listeners) stay connected: a stream drop models
+        the client side of the watch, and a consumer with no resume
+        protocol would just silently go deaf.  Returns how many dropped."""
+        with self._lock:
+            dropped = [w for w in self._watchers
+                       if hasattr(w, "on_watch_dropped")]
+            self._watchers = [w for w in self._watchers
+                              if not hasattr(w, "on_watch_dropped")]
+        for w in dropped:
+            w.on_watch_dropped()
+        return len(dropped)
+
+    def reset_watch_history(self) -> None:
+        """Evict the whole watch-resume window (etcd compaction): any
+        subsequent resume from a pre-reset resourceVersion gets 410 Gone
+        and must relist."""
+        with self._lock:
+            self._history.clear()
+            self._history_floor = self._rv_counter
+
+    def _stale_of(self, kind: str, namespace: str,
+                  name: str) -> Optional[KubeObject]:
+        """The most recent PREVIOUS version of an object still in the watch
+        history — what a lagging apiserver cache would serve."""
+        for ev in reversed(self._history):
+            o = ev.obj
+            if (o.kind, o.namespace, o.name) == (kind, namespace, name) \
+                    and ev.prev is not None:
+                return ev.prev.deepcopy()
+        return None
 
     # -- watch / admission registration --------------------------------------
     def watch(self, fn: Callable[[WatchEvent], None]) -> None:
@@ -109,14 +198,13 @@ class ApiServer:
         predates the retained window."""
         with self._lock:
             if since_rv is not None:
-                oldest_live = self._history[0].obj.metadata.resource_version \
-                    if self._history else self._rv_counter + 1
-                # since_rv older than both the window start and at least one
-                # evicted event means we cannot prove nothing was missed
-                if since_rv < oldest_live - 1 and len(self._history) == self._history.maxlen:
+                # a resume below the eviction floor cannot prove nothing was
+                # missed (events <= floor left the window — sliding eviction
+                # or a reset_watch_history compaction)
+                if since_rv < self._history_floor:
                     raise GoneError(
                         f"resourceVersion {since_rv} is too old "
-                        f"(history starts at {oldest_live})"
+                        f"(history starts at {self._history_floor + 1})"
                     )
                 for ev in self._history:
                     if ev.obj.metadata.resource_version > since_rv:
@@ -141,6 +229,12 @@ class ApiServer:
         # replay-then-register is atomic with live delivery; callbacks must
         # only enqueue or re-enter this ApiServer (same thread, RLock-safe)
         with self._lock:
+            if len(self._history) == self._history.maxlen and self._history:
+                # about to evict the oldest event: resumes at or below its
+                # rv can no longer be proven complete
+                self._history_floor = max(
+                    self._history_floor,
+                    self._history[0].obj.metadata.resource_version)
             self._history.append(
                 WatchEvent(ev.type, ev.obj.deepcopy(), prev=ev.prev))
             watchers = list(self._watchers)
@@ -153,11 +247,16 @@ class ApiServer:
 
     # -- reads ----------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> KubeObject:
-        with self._lock:
-            obj = self._objects.get(kind, {}).get((namespace, name))
-            if obj is None:
-                raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return obj.deepcopy()
+        with self._fault_scope("get", kind, namespace, name) as faults:
+            if faults and faults.get("stale"):
+                stale = self._stale_of(kind, namespace, name)
+                if stale is not None:
+                    return stale
+            with self._lock:
+                obj = self._objects.get(kind, {}).get((namespace, name))
+                if obj is None:
+                    raise NotFoundError(f"{kind} {namespace}/{name} not found")
+                return obj.deepcopy()
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[KubeObject]:
         try:
@@ -171,15 +270,16 @@ class ApiServer:
         namespace: Optional[str] = None,
         label_selector: Optional[dict[str, str]] = None,
     ) -> list[KubeObject]:
-        with self._lock:
-            out = []
-            for (ns, _), obj in self._objects.get(kind, {}).items():
-                if namespace is not None and ns != namespace:
-                    continue
-                if not match_labels(obj.metadata.labels, label_selector):
-                    continue
-                out.append(obj.deepcopy())
-            return sorted(out, key=lambda o: (o.namespace, o.name))
+        with self._fault_scope("list", kind, namespace or ""):
+            with self._lock:
+                out = []
+                for (ns, _), obj in self._objects.get(kind, {}).items():
+                    if namespace is not None and ns != namespace:
+                        continue
+                    if not match_labels(obj.metadata.labels, label_selector):
+                        continue
+                    out.append(obj.deepcopy())
+                return sorted(out, key=lambda o: (o.namespace, o.name))
 
     def list_with_rv(
         self,
@@ -209,6 +309,11 @@ class ApiServer:
 
     # -- writes ---------------------------------------------------------------
     def create(self, obj: KubeObject) -> KubeObject:
+        with self._fault_scope("create", obj.kind, obj.metadata.namespace,
+                               obj.metadata.name):
+            return self._create(obj)
+
+    def _create(self, obj: KubeObject) -> KubeObject:
         obj = obj.deepcopy()
         with self._lock:
             if not obj.metadata.name and obj.metadata.generate_name:
@@ -290,6 +395,11 @@ class ApiServer:
         semantics): the write must replace unconditionally even under
         concurrency, so a commit-time conflict retries against fresh state
         — the analog of GuaranteedUpdate's internal retry."""
+        with self._fault_scope("update", obj.kind, obj.metadata.namespace,
+                               obj.metadata.name):
+            return self._update(obj, subresource)
+
+    def _update(self, obj: KubeObject, subresource: str = "") -> KubeObject:
         if not obj.metadata.resource_version:
             last: Exception | None = None
             for _ in range(16):
@@ -422,6 +532,15 @@ class ApiServer:
         cross-version view hooks as the other patch verbs.
         `return_created=True` returns (obj, created) so the wire layer can
         answer 201 for the create path without a racy pre-lookup."""
+        with self._fault_scope("patch", kind, namespace, name):
+            return self._apply(kind, namespace, name, applied, field_manager,
+                               force, view_out, view_in, return_created)
+
+    def _apply(
+        self, kind: str, namespace: str, name: str, applied: dict,
+        field_manager: str, force: bool = False,
+        view_out=None, view_in=None, return_created: bool = False,
+    ) -> "KubeObject | tuple[KubeObject, bool]":
         from .apply import (
             ApplyConflict,
             apply_update,
@@ -517,6 +636,14 @@ class ApiServer:
         retry the whole read-apply-write on conflict — the apiserver
         re-applies patches server-side the same way, so patch callers never
         see a ConflictError of their own making."""
+        with self._fault_scope("patch", kind, namespace, name):
+            return self._patch_with_retry_inner(
+                kind, namespace, name, apply_fn, view_out, view_in)
+
+    def _patch_with_retry_inner(
+        self, kind: str, namespace: str, name: str, apply_fn,
+        view_out=None, view_in=None,
+    ) -> KubeObject:
         last: Exception | None = None
         for _ in range(16):
             current = self.get(kind, namespace, name)
@@ -535,6 +662,10 @@ class ApiServer:
         raise last
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._fault_scope("delete", kind, namespace, name):
+            self._delete(kind, namespace, name)
+
+    def _delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             obj = self._objects.get(kind, {}).get((namespace, name))
             if obj is None:

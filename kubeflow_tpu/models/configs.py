@@ -47,6 +47,15 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_mlp_dim: int = 0             # per-expert hidden; 0 = mlp_dim
     moe_aux_weight: float = 0.01     # load-balance loss weight
+    decode: bool = False             # decode-shaped marker, set by
+                                     # models.generate.decode_config: a cfg
+                                     # carrying it keeps its explicit
+                                     # fused_projections/staged_kv choices
+                                     # through prepare_decode instead of
+                                     # being re-defaulted (a training cfg
+                                     # that merely looks decode-ish —
+                                     # remat off + xla attention — no
+                                     # longer masks the decode defaults)
     staged_kv: bool = False          # decode-path KV write staging: single
                                      # -token cache writes land in a small
                                      # [B,kvH,8,D] stage and flush to the
@@ -54,9 +63,14 @@ class TransformerConfig:
                                      # the per-step dynamic_update_slice
                                      # otherwise read-modify-writes a full
                                      # (8,128) tile row per buffer
-                                     # (ci/kv_cache_probe.py).  Requires
-                                     # prefill-from-empty; the speculative
-                                     # rewind path keeps this off
+                                     # (ci/kv_cache_probe.py).  Multi-token
+                                     # decode calls (chunked prefill,
+                                     # verify passes) flush the stage
+                                     # first, so any cur/q_len mix is
+                                     # exact; requires max_seq_len % 8 ==
+                                     # 0.  The speculative rewind path
+                                     # still keeps this off (rewinds move
+                                     # the fill index backwards)
     fused_projections: bool = False  # decode-path op-count fusion: one
                                      # qkv matmul + one gate_up matmul per
                                      # layer instead of five (decode is

@@ -46,8 +46,12 @@ def decode_config(cfg: TransformerConfig,
     # a training config: a cfg that is already decode-shaped keeps its
     # explicit settings, so callers can request the unfused layout or
     # unstaged writes (A/B profiling, old quantized trees, the
-    # speculative rewind path) without this function overriding them
-    already_decode = not cfg.remat and cfg.attention_impl == "xla"
+    # speculative rewind path) without this function overriding them.
+    # "Already decode-shaped" is the explicit `decode` marker this
+    # function stamps — NOT inferred from remat/attention_impl, so a
+    # training config that happens to run remat=False + xla attention
+    # still gets the decode defaults (ADVICE round 5)
+    already_decode = cfg.decode
     fused = cfg.fused_projections if already_decode else True
     staged = cfg.staged_kv if already_decode else True
     if not unroll_layers:
@@ -57,7 +61,7 @@ def decode_config(cfg: TransformerConfig,
                 "(stage buffers would become scanned variables — the "
                 "re-stacking cost staging exists to avoid)")
         staged = False
-    return cfg.with_(remat=False, attention_impl="xla",
+    return cfg.with_(decode=True, remat=False, attention_impl="xla",
                      scan_layers=not unroll_layers,
                      fused_projections=fused,
                      staged_kv=staged)

@@ -199,6 +199,11 @@ class Attention(nn.Module):
             q_len = x.shape[1]
             staged = cfg.staged_kv and q_len == 1
             if cfg.staged_kv:
+                if cfg.max_seq_len % 8:
+                    raise ValueError(
+                        "staged_kv requires max_seq_len % 8 == 0 (the "
+                        f"stage flushes aligned 8-row tiles); got "
+                        f"{cfg.max_seq_len}")
                 # 8-row staging (ci/kv_cache_probe.py: a 1-row DUS
                 # read-modify-writes a whole (8,128) tile row per buffer;
                 # staging flushes aligned full tiles instead).  Invariant:
@@ -235,33 +240,54 @@ class Attention(nn.Module):
                     q, cached_k.value, cached_v.value,
                     stage_k.value, stage_v.value, flushed, fill)
             else:
+                if cfg.staged_kv:
+                    # multi-token write with a possibly-live stage (cur > 0:
+                    # chunked prefill, verify-style passes): flush the stage
+                    # into the main cache FIRST so rows [flushed, cur) —
+                    # which live only in the stage — are visible to the
+                    # attention below (they used to silently read as
+                    # zeros, ADVICE round 5).  Stale stage rows past `cur`
+                    # are overwritten by the new kt/vt or sit beyond the
+                    # visibility mask.
+                    aligned = cur - jnp.mod(cur, 8)
+
+                    def flush_stage(main, stage):
+                        return jax.lax.dynamic_update_slice(
+                            main, stage, (0, 0, aligned, 0))
+
+                    has_stage = jnp.mod(cur, 8) > 0
+                    cached_k.value = jax.lax.cond(
+                        has_stage, flush_stage, lambda m, _s: m,
+                        cached_k.value, stage_k.value)
+                    cached_v.value = jax.lax.cond(
+                        has_stage, flush_stage, lambda m, _s: m,
+                        cached_v.value, stage_v.value)
                 cached_k.value = jax.lax.dynamic_update_slice(
                     cached_k.value, kt, (0, 0, cur, 0))
                 cached_v.value = jax.lax.dynamic_update_slice(
                     cached_v.value, vt, (0, 0, cur, 0))
                 if cfg.staged_kv:
-                    # multi-token PREFILL-FROM-EMPTY only (cur == 0): the
-                    # main cache takes all rows; the unaligned tail is
-                    # COPIED into stage slots [0, tail) so later single-
-                    # token steps continue the invariant.  The tail/slot
-                    # math is wrong for cur > 0 (chunked prefill /
-                    # verify passes) — the cond guard skips the copy
-                    # there so at least the stage is never corrupted;
-                    # such callers must run staged_kv=False (the
-                    # speculative path does, speculative.py).
-                    tail = q_len % 8
-                    if tail:
-                        def copy_tail(stage, new):
-                            return jax.lax.dynamic_update_slice(
-                                stage, new[:, :, q_len - tail:, :],
-                                (0, 0, 0, 0))
+                    # re-seed the stage so later single-token staged steps
+                    # continue the invariant: slots [0, fill%8) must hold
+                    # rows [fill - fill%8, fill) — all valid in the main
+                    # cache now, so slice them straight back out (max_seq
+                    # is 8-aligned, checked above, so the slice never
+                    # clamps).
+                    fill = cur + q_len
+                    new_aligned = fill - jnp.mod(fill, 8)
 
-                        stage_k.value = jax.lax.cond(
-                            cur == 0, copy_tail, lambda s, _n: s,
-                            stage_k.value, kt)
-                        stage_v.value = jax.lax.cond(
-                            cur == 0, copy_tail, lambda s, _n: s,
-                            stage_v.value, vt)
+                    def reseed(main, stage):
+                        return jax.lax.dynamic_slice(
+                            main, (0, 0, new_aligned, 0),
+                            (batch, cfg.num_kv_heads, 8, cfg.head_dim))
+
+                    needs_stage = jnp.mod(fill, 8) > 0
+                    stage_k.value = jax.lax.cond(
+                        needs_stage, reseed, lambda _m, s: s,
+                        cached_k.value, stage_k.value)
+                    stage_v.value = jax.lax.cond(
+                        needs_stage, reseed, lambda _m, s: s,
+                        cached_v.value, stage_v.value)
                 index.value = cur + q_len
                 # the visibility mask with q at global offset `cur` covers
                 # both the unwritten tail (kv_pos > q_pos) and causality

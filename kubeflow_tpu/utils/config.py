@@ -74,6 +74,13 @@ class CoreConfig:
     add_fsgroup: bool = True
     # TPU extensions
     checkpoint_before_cull: bool = False  # signal workers before slice stop
+    # workqueue rate limiting (kube.controller.default_rate_limiter):
+    # per-item exponential backoff base/cap + overall token bucket,
+    # mirroring controller-runtime's DefaultControllerRateLimiter
+    workqueue_base_delay_s: float = 0.005   # WORKQUEUE_BASE_DELAY_MS / 1000
+    workqueue_max_delay_s: float = 1000.0   # WORKQUEUE_MAX_DELAY_S
+    workqueue_qps: float = 10.0             # WORKQUEUE_QPS
+    workqueue_burst: int = 100              # WORKQUEUE_BURST
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -89,6 +96,12 @@ class CoreConfig:
             istio_host=env.get("ISTIO_HOST", "*"),
             add_fsgroup=_bool(env, "ADD_FSGROUP", True),
             checkpoint_before_cull=_bool(env, "CHECKPOINT_BEFORE_CULL", False),
+            workqueue_base_delay_s=_int(
+                env, "WORKQUEUE_BASE_DELAY_MS", 5) / 1000.0,
+            workqueue_max_delay_s=float(
+                _int(env, "WORKQUEUE_MAX_DELAY_S", 1000)),
+            workqueue_qps=float(_int(env, "WORKQUEUE_QPS", 10)),
+            workqueue_burst=_int(env, "WORKQUEUE_BURST", 100),
         )
 
 

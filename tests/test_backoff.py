@@ -1,0 +1,294 @@
+"""Workqueue rate-limiting semantics (kube/controller.py).
+
+The manager's retry path is controller-runtime's: per-item exponential
+backoff with jitter + an overall token bucket, `forget()` on success,
+retry budgets landing in Manager._errors on exhaustion — all deterministic
+under the injected FakeClock (run_until_idle auto-advances over retry
+backoffs; advance_clock=False exposes the pending delays for assertions).
+"""
+
+import pytest
+
+from kubeflow_tpu.kube import (
+    ApiServer,
+    BucketRateLimiter,
+    ItemExponentialBackoff,
+    KubeObject,
+    Manager,
+    MaxOfRateLimiter,
+    ObjectMeta,
+    Result,
+    retry_on_conflict,
+)
+from kubeflow_tpu.kube.errors import ConflictError
+from kubeflow_tpu.utils.clock import FakeClock
+
+
+def mk(kind: str, name: str, namespace: str = "default") -> KubeObject:
+    return KubeObject(api_version="v1", kind=kind,
+                      metadata=ObjectMeta(name=name, namespace=namespace))
+
+
+class Failing:
+    def __init__(self, fail_times: int = 10**9, clock=None):
+        self.calls = 0
+        self.fail_times = fail_times
+        self.clock = clock
+        self.call_times: list[float] = []
+
+    def reconcile(self, req):
+        self.calls += 1
+        if self.clock is not None:
+            self.call_times.append(self.clock.now())
+        if self.calls <= self.fail_times:
+            raise RuntimeError("boom")
+        return Result()
+
+
+class TestItemExponentialBackoff:
+    def test_growth_jitter_bounds_and_cap(self):
+        rl = ItemExponentialBackoff(base_s=0.01, cap_s=0.5, jitter=0.1,
+                                    seed=7)
+        item = ("c", "x")
+        for n in range(12):
+            delay = rl.when(item)
+            pure = min(0.01 * (2 ** n), 0.5)
+            assert pure <= delay <= pure * 1.1 + 1e-12, (n, delay)
+        assert rl.num_failures(item) == 12
+
+    def test_forget_resets(self):
+        rl = ItemExponentialBackoff(base_s=0.01, jitter=0.0)
+        item = ("c", "x")
+        assert rl.when(item) == pytest.approx(0.01)
+        assert rl.when(item) == pytest.approx(0.02)
+        rl.forget(item)
+        assert rl.when(item) == pytest.approx(0.01)
+
+    def test_items_are_independent(self):
+        rl = ItemExponentialBackoff(base_s=0.01, jitter=0.0)
+        rl.when(("c", "x"))
+        rl.when(("c", "x"))
+        assert rl.when(("c", "y")) == pytest.approx(0.01)
+
+
+class TestBucketRateLimiter:
+    def test_burst_then_paced(self):
+        clock = FakeClock()
+        rl = BucketRateLimiter(qps=10.0, burst=3, clock=clock)
+        assert [rl.when("i") for _ in range(3)] == [0.0, 0.0, 0.0]
+        # bucket empty: reservations pace out at 1/qps
+        assert rl.when("i") == pytest.approx(0.1)
+        assert rl.when("i") == pytest.approx(0.2)
+        clock.advance(0.2)  # tokens refill with (fake) time
+        assert rl.when("i") == pytest.approx(0.1)
+
+    def test_zero_qps_unlimited(self):
+        rl = BucketRateLimiter(qps=0.0, burst=1, clock=FakeClock())
+        assert all(rl.when("i") == 0.0 for _ in range(100))
+
+
+class TestManagerBackoff:
+    def _mgr(self, **kw):
+        api = ApiServer()
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        return api, clock, mgr
+
+    def test_failures_observe_monotonic_backoff_not_immediate(self):
+        """Acceptance: 5 consecutive failures see monotonically increasing
+        delays between attempts, asserted through the FakeClock."""
+        api, clock, mgr = self._mgr()
+        rec = Failing(clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+
+        delays = []
+        while True:
+            mgr.run_until_idle(advance_clock=False)
+            pending = mgr.pending_delayed()
+            if not pending:
+                break
+            assert len(pending) == 1
+            _, _, due = pending[0]
+            gap = due - clock.now()
+            assert gap > 0, "failed reconcile re-enqueued immediately"
+            delays.append(gap)
+            clock.advance(gap)
+
+        assert rec.calls == 6  # initial + 5 retries
+        assert len(delays) == 5
+        assert all(b > a for a, b in zip(delays, delays[1:])), delays
+        assert len(mgr.dropped_errors) == 1
+        # the attempt timestamps themselves spread out on the fake clock
+        gaps = [b - a for a, b in zip(rec.call_times, rec.call_times[1:])]
+        assert gaps == pytest.approx(delays)
+
+    def test_run_until_idle_auto_advances_fake_clock_over_backoff(self):
+        api, clock, mgr = self._mgr()
+        rec = Failing(fail_times=3, clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=5)
+        t0 = clock.now()
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        assert rec.calls == 4  # 3 failures + success, drained in one call
+        assert clock.now() > t0  # the backoff time actually passed
+        assert not mgr.dropped_errors
+        assert not mgr.pending_delayed()
+
+    def test_forget_on_success_resets_item_backoff(self):
+        api, clock, mgr = self._mgr()
+        rec = Failing(fail_times=2, clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+
+        # fail twice more: delays restart from the base (5ms +10% jitter),
+        # not from a carried-over failure count (which would start >= 20ms)
+        rec.fail_times = rec.calls + 2
+        obj = api.get("Notebook", "default", "nb1")
+        obj.metadata.labels["touch"] = "1"
+        api.update(obj)
+        start = len(rec.call_times)
+        mgr.run_until_idle()
+        second_round = [b - a for a, b in zip(rec.call_times[start:],
+                                              rec.call_times[start + 1:])]
+        assert len(second_round) == 2
+        assert 0.005 <= second_round[0] <= 0.0055
+        assert 0.010 <= second_round[1] <= 0.011
+
+    def test_unregister_mid_backoff_drops_delayed_retry(self):
+        api, clock, mgr = self._mgr()
+        rec = Failing(clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle(advance_clock=False)
+        assert mgr.pending_delayed()
+        mgr.unregister("nb")
+        assert not mgr.pending_delayed()
+        assert mgr.run_until_idle() == 0
+        assert rec.calls == 1
+
+    def test_exhaustion_lands_in_errors_with_budget_reset(self):
+        api, clock, mgr = self._mgr()
+        rec = Failing(clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=3)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        assert rec.calls == 4
+        assert len(mgr.dropped_errors) == 1
+        name, req, err = mgr.dropped_errors[0]
+        assert name == "nb" and req.name == "nb1"
+        assert isinstance(err, RuntimeError)
+        # a fresh event gets a fresh budget
+        rec.fail_times = 0
+        obj = api.get("Notebook", "default", "nb1")
+        obj.metadata.labels["touch"] = "1"
+        api.update(obj)
+        mgr.run_until_idle()
+        assert len(mgr.dropped_errors) == 1  # no new drop
+
+    def test_requeue_true_is_rate_limited_not_hot(self):
+        api, clock, mgr = self._mgr()
+
+        class Requeuer:
+            calls = 0
+
+            def reconcile(self, req):
+                Requeuer.calls += 1
+                return Result(requeue=Requeuer.calls < 4)
+
+        mgr.register("nb", Requeuer(), for_kind="Notebook")
+        t0 = clock.now()
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        assert Requeuer.calls == 4
+        assert clock.now() > t0  # requeues waited out backoff, not hot-loop
+
+    def test_requeue_after_not_auto_advanced(self):
+        api, clock, mgr = self._mgr()
+
+        class Scheduler:
+            calls = 0
+
+            def reconcile(self, req):
+                Scheduler.calls += 1
+                return Result(requeue_after=60.0) if Scheduler.calls == 1 \
+                    else Result()
+
+        mgr.register("nb", Scheduler(), for_kind="Notebook")
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        assert Scheduler.calls == 1  # scheduled work stays scheduled
+        assert mgr.pending_delayed()
+        mgr.advance(61)
+        assert Scheduler.calls == 2
+
+    def test_queue_stats_and_metrics_export(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+
+        api, clock, mgr = self._mgr()
+        rec = Failing(clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=2)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        stats = mgr.queue_stats()
+        assert stats["retries_total"]["nb"] == 2
+        assert stats["errors_total"]["nb"] == 1
+        assert stats["last_backoff_s"]["nb"] > 0
+        metrics = NotebookMetrics(api, manager=mgr)
+        text = metrics.scrape()
+        assert 'workqueue_retries_total{controller="nb"} 2' in text
+        assert 'reconcile_errors_total{controller="nb"} 1' in text
+
+    def test_max_of_rate_limiter_takes_worst(self):
+        clock = FakeClock()
+        rl = MaxOfRateLimiter(
+            ItemExponentialBackoff(base_s=0.5, jitter=0.0),
+            BucketRateLimiter(qps=10.0, burst=100, clock=clock),
+        )
+        assert rl.when("i") == pytest.approx(0.5)
+
+
+class TestRetryOnConflictBackoff:
+    def test_backoff_grows_capped_between_conflicts(self):
+        sleeps: list[float] = []
+        calls = [0]
+
+        def always_conflict():
+            calls[0] += 1
+            raise ConflictError("nope")
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(always_conflict, steps=5,
+                              initial_backoff_s=0.01, factor=2.0,
+                              max_backoff_s=0.03, jitter=0.0,
+                              sleep_fn=sleeps.append)
+        assert calls[0] == 5
+        # capped exponential: 10ms, 20ms, then pinned at the 30ms cap;
+        # no sleep after the final attempt
+        assert sleeps == pytest.approx([0.01, 0.02, 0.03, 0.03])
+
+    def test_jitter_bounds(self):
+        sleeps: list[float] = []
+
+        def always_conflict():
+            raise ConflictError("nope")
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(always_conflict, steps=3,
+                              initial_backoff_s=0.01, factor=2.0,
+                              max_backoff_s=1.0, jitter=0.5,
+                              sleep_fn=sleeps.append)
+        assert 0.01 <= sleeps[0] <= 0.015
+        assert 0.02 <= sleeps[1] <= 0.03
+
+    def test_success_after_conflict_returns_value(self):
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise ConflictError("racing")
+            return "ok"
+
+        assert retry_on_conflict(flaky, sleep_fn=lambda s: None) == "ok"

@@ -1,12 +1,22 @@
-"""Chaos-model validation + fault-injection drills.
+"""Chaos-model validation + fault-injection drills + the seeded soak.
 
-Two halves, mirroring the reference's shift-left chaos CI (SURVEY.md §4.6):
+Three parts, mirroring the reference's shift-left chaos CI (SURVEY.md §4.6):
 1. the knowledge model (chaos/knowledge/workbenches.yaml) must stay in sync
    with what the controllers actually create — a drift check;
 2. the declared fault injections actually hold: kill/fail a worker, delete a
-   route, and watch level-triggered reconciliation restore steady state.
+   route, and watch level-triggered reconciliation restore steady state;
+3. a seeded randomized soak (TestChaosSoak): N rounds of random FaultPlans
+   (kube/faults.py — API errors, latency, stale reads, watch drops with
+   resourceVersion resets) against a TPU+auth notebook, asserting every
+   steady-state predicate declared in workbenches.yaml is restored after
+   each round's faults drain, and that no reconciler ever exhausts its
+   retry budget.  Reproduce a failure with
+   CHAOS_SOAK_SEED=<printed seed> pytest tests/test_chaos.py -k soak
+   (ci/chaos_soak.sh wraps exactly that).
 """
 
+import os
+import random
 from pathlib import Path
 
 import pytest
@@ -14,7 +24,7 @@ import yaml
 
 from kubeflow_tpu.api.types import Notebook, TPUSpec
 from kubeflow_tpu.core.notebook_controller import setup_core_controllers
-from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager, random_fault_plan
 from kubeflow_tpu.odh import constants as OC
 from kubeflow_tpu.odh.controller import setup_odh_controllers
 from kubeflow_tpu.utils.clock import FakeClock
@@ -22,6 +32,16 @@ from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
 
 KNOWLEDGE = Path(__file__).parent.parent / "chaos" / "knowledge" / "workbenches.yaml"
 CENTRAL_NS = "opendatahub"
+
+SOAK_ROUNDS = int(os.environ.get("CHAOS_SOAK_ROUNDS", "20"))
+SOAK_SEED = int(os.environ.get("CHAOS_SOAK_SEED", "20260804"))
+
+# the kinds the workbench controllers actually traffic in — the fault
+# plans draw their per-kind targeting from this pool
+FAULT_KINDS = (
+    "Notebook", "StatefulSet", "Pod", "Service", "HTTPRoute",
+    "NetworkPolicy", "ConfigMap", "Secret", "ServiceAccount", "Event",
+)
 
 
 @pytest.fixture()
@@ -126,3 +146,119 @@ class TestFaultInjection:
         api.delete("HTTPRoute", CENTRAL_NS, route_name)
         mgr.run_until_idle()
         assert api.try_get("HTTPRoute", CENTRAL_NS, route_name) is not None
+
+
+def assert_steady_state(api, namespace: str, name: str,
+                        expected_hosts: int) -> None:
+    """Every steady-state predicate DECLARED in the knowledge model must
+    hold — driven off the yaml so a predicate added to the model without a
+    check here fails loudly instead of silently going untested."""
+    status = api.get("Notebook", namespace, name).body.get("status", {})
+    for pred in knowledge()["steady_state"]:
+        if pred["name"] == "notebook-ready":
+            assert status.get("readyReplicas") == expected_hosts, \
+                (pred["name"], status)
+        elif pred["name"] == "slice-health":
+            assert status.get("sliceHealth") in ("Healthy", "Stopped"), \
+                (pred["name"], status)
+        elif pred["name"] == "route-exists":
+            routes = api.list(
+                "HTTPRoute", namespace=CENTRAL_NS,
+                label_selector={OC.NOTEBOOK_NAME_LABEL: name,
+                                OC.NOTEBOOK_NAMESPACE_LABEL: namespace})
+            assert len(routes) == 1, (pred["name"], [r.name for r in routes])
+        else:  # a new model predicate needs a matching assertion
+            pytest.fail(f"steady-state predicate {pred['name']!r} declared "
+                        "in workbenches.yaml but not checked by the soak")
+
+
+class TestChaosSoak:
+    """Seeded randomized fault soak (ci/chaos_soak.sh runs this at higher
+    round counts).  Each round: install a random bounded FaultPlan, perturb
+    the cluster, drive reconciliation to convergence while faults fire,
+    then clear faults and assert the declared steady state is restored with
+    zero retry-budget exhaustions in Manager._errors."""
+
+    EXPECTED_HOSTS = 4  # v5e 4x4 single slice
+
+    def _perturb(self, rng, api, cluster, name):
+        """One random cluster perturbation, exempt from the fault plan (the
+        perturbation is the experiment, not the traffic under test)."""
+        kind = rng.choice(
+            ["kill_pod", "fail_pod", "delete_route", "touch", "none"])
+        with api.fault_exempt():
+            if kind == "kill_pod":
+                api.delete("Pod", "user1", f"{name}-{rng.randrange(4)}")
+            elif kind == "fail_pod":
+                cluster.fail_pod("user1", f"{name}-{rng.randrange(4)}")
+            elif kind == "delete_route":
+                api.delete("HTTPRoute", CENTRAL_NS, f"nb-user1-{name}")
+            elif kind == "touch":
+                nb = api.get("Notebook", "user1", name)
+                nb.metadata.annotations["chaos/touch"] = str(rng.random())
+                api.update(nb)
+        if kind == "fail_pod":
+            # a Failed pod needs the slice-atomic restart to recover
+            from kubeflow_tpu.core import constants as CC
+
+            with api.fault_exempt():
+                nb = api.get("Notebook", "user1", name)
+                nb.metadata.annotations[CC.ANNOTATION_NOTEBOOK_RESTART] \
+                    = "true"
+                api.update(nb)
+        return kind
+
+    def test_seeded_random_fault_soak(self, env):
+        api, cluster, mgr = env
+        nb = Notebook.new(
+            "soak", "user1", tpu=TPUSpec("v5e", "4x4"),
+            annotations={OC.ANNOTATION_INJECT_AUTH: "true"},
+        )
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        assert_steady_state(api, "user1", "soak", self.EXPECTED_HOSTS)
+
+        print(f"\nchaos soak: seed={SOAK_SEED} rounds={SOAK_ROUNDS} "
+              "(reproduce with CHAOS_SOAK_SEED/CHAOS_SOAK_ROUNDS)")
+        rng = random.Random(SOAK_SEED)
+        total_faults = 0
+        for round_i in range(SOAK_ROUNDS):
+            plan_seed = rng.randrange(2**31)
+            plan = random_fault_plan(plan_seed, kinds=FAULT_KINDS,
+                                     clock=mgr.clock)
+            api.install_fault_plan(plan)
+            perturbation = self._perturb(rng, api, cluster, "soak")
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            # converge WHILE faults fire (plans are bounded, so they drain)
+            mgr.settle(max_seconds=7200.0)
+            api.clear_fault_plan()
+            # faults cleared: one more level-triggered pass restores
+            # whatever the chaos window left behind
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+
+            total_faults += len(plan.log)
+            assert not mgr.dropped_errors, (
+                f"round {round_i} (plan_seed={plan_seed}, "
+                f"perturb={perturbation}): retry budget exhausted: "
+                f"{mgr.dropped_errors}, injected={plan.summary()}")
+            assert_steady_state(api, "user1", "soak", self.EXPECTED_HOSTS)
+
+        # the soak must actually have injected chaos to mean anything
+        assert total_faults > SOAK_ROUNDS, total_faults
+
+    def test_soak_is_reproducible_for_a_seed(self, env):
+        """The same plan seed yields the same injections — the printed seed
+        genuinely reproduces a failing round."""
+        a = random_fault_plan(1234, kinds=FAULT_KINDS)
+        b = random_fault_plan(1234, kinds=FAULT_KINDS)
+        assert [(r.verbs, r.kinds, r.error, r.latency_s, r.stale_read,
+                 r.drop_watch, r.reset_watch_history, r.probability,
+                 r.max_matches, r.after)
+                for r in a.rules] == \
+               [(r.verbs, r.kinds, r.error, r.latency_s, r.stale_read,
+                 r.drop_watch, r.reset_watch_history, r.probability,
+                 r.max_matches, r.after)
+                for r in b.rules]

@@ -1,0 +1,224 @@
+"""Fault-injection substrate (kube/faults.py + the ApiServer gate).
+
+Covers the injection surface the chaos soak is built on: per-verb/per-kind
+errors with match counts and a complete fault log, seeded determinism,
+latency against the FakeClock, stale reads, watch-stream drops with
+resume, and history resets forcing the 410 Gone -> relist path.
+"""
+
+import pytest
+
+from kubeflow_tpu.kube import (
+    ApiServer,
+    ConflictError,
+    FakeCluster,
+    FaultPlan,
+    FaultRule,
+    KubeObject,
+    Manager,
+    ObjectMeta,
+    Result,
+    ServerError,
+    random_fault_plan,
+)
+from kubeflow_tpu.utils.clock import FakeClock
+
+
+def mk(kind: str, name: str, namespace: str = "default",
+       labels=None) -> KubeObject:
+    return KubeObject(api_version="v1", kind=kind,
+                      metadata=ObjectMeta(name=name, namespace=namespace,
+                                          labels=dict(labels or {})))
+
+
+class TestFaultRules:
+    def test_error_injection_per_verb_and_kind_with_match_count(self):
+        api = ApiServer()
+        api.create(mk("ConfigMap", "cm"))
+        plan = FaultPlan([FaultRule(verbs=("get",), kinds=("ConfigMap",),
+                                    error="server", max_matches=2)])
+        api.install_fault_plan(plan)
+        for _ in range(2):
+            with pytest.raises(ServerError):
+                api.get("ConfigMap", "default", "cm")
+        # exhausted: the third call goes through
+        assert api.get("ConfigMap", "default", "cm").name == "cm"
+        assert plan.exhausted()
+        # other verbs/kinds were never gated
+        api.create(mk("Secret", "s"))
+        assert api.list("ConfigMap")
+        assert [r.action for r in plan.log] == ["error:server"] * 2
+        assert all(r.verb == "get" and r.kind == "ConfigMap"
+                   for r in plan.log)
+
+    def test_conflict_injection_is_a_409(self):
+        api = ApiServer()
+        obj = api.create(mk("ConfigMap", "cm"))
+        api.install_fault_plan(FaultPlan(
+            [FaultRule(verbs=("update",), error="conflict")]))
+        with pytest.raises(ConflictError):
+            api.update(obj)
+        assert api.update(obj).metadata.resource_version  # second try lands
+
+    def test_after_skips_first_matches(self):
+        api = ApiServer()
+        api.create(mk("ConfigMap", "cm"))
+        plan = FaultPlan([FaultRule(verbs=("get",), error="server",
+                                    after=2, max_matches=1)])
+        api.install_fault_plan(plan)
+        api.get("ConfigMap", "default", "cm")
+        api.get("ConfigMap", "default", "cm")
+        with pytest.raises(ServerError):
+            api.get("ConfigMap", "default", "cm")
+
+    def test_seeded_probability_is_deterministic(self):
+        def run(seed):
+            api = ApiServer()
+            api.create(mk("ConfigMap", "cm"))
+            plan = FaultPlan([FaultRule(verbs=("get",), error="server",
+                                        probability=0.5, max_matches=100)],
+                             seed=seed)
+            api.install_fault_plan(plan)
+            outcomes = []
+            for _ in range(20):
+                try:
+                    api.get("ConfigMap", "default", "cm")
+                    outcomes.append(0)
+                except ServerError:
+                    outcomes.append(1)
+            return outcomes
+
+        assert run(42) == run(42)
+        assert run(42) != run(43)  # different seed, different draw
+        assert 0 < sum(run(42)) < 20
+
+    def test_latency_advances_fake_clock_and_logs(self):
+        api = ApiServer()
+        clock = FakeClock()
+        api.create(mk("ConfigMap", "cm"))
+        plan = FaultPlan([FaultRule(verbs=("get",), latency_s=2.5)],
+                         clock=clock)
+        api.install_fault_plan(plan)
+        t0 = clock.now()
+        api.get("ConfigMap", "default", "cm")
+        assert clock.now() - t0 == pytest.approx(2.5)
+        assert plan.log[0].action == "latency"
+
+    def test_stale_read_serves_previous_version_once(self):
+        api = ApiServer()
+        cm = api.create(mk("ConfigMap", "cm"))
+        cm.body["data"] = {"v": "2"}
+        api.update(cm)
+        api.install_fault_plan(FaultPlan(
+            [FaultRule(verbs=("get",), stale_read=True, max_matches=1)]))
+        stale = api.get("ConfigMap", "default", "cm")
+        assert stale.body.get("data", {}).get("v") is None  # pre-update view
+        fresh = api.get("ConfigMap", "default", "cm")
+        assert fresh.body["data"]["v"] == "2"
+
+    def test_internal_reentry_and_exemption_are_not_gated(self):
+        api = ApiServer()
+        api.create(mk("ConfigMap", "cm"))
+        api.install_fault_plan(FaultPlan(
+            [FaultRule(verbs=("get", "update"), error="server",
+                       max_matches=100)]))
+        with api.fault_exempt():
+            assert api.get("ConfigMap", "default", "cm").name == "cm"
+        # merge_patch re-enters get/update internally: only the top-level
+        # "patch" verb is gated, and this plan does not target it
+        api.merge_patch("ConfigMap", "default", "cm",
+                        {"data": {"k": "v"}})
+
+    def test_unknown_error_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule(error="teapot")
+
+    def test_random_plan_reproducible_and_bounded(self):
+        kinds = ("Notebook", "StatefulSet", "Pod")
+        a = random_fault_plan(99, kinds)
+        b = random_fault_plan(99, kinds)
+        assert [(r.verbs, r.kinds, r.error, r.max_matches, r.probability)
+                for r in a.rules] == \
+               [(r.verbs, r.kinds, r.error, r.max_matches, r.probability)
+                for r in b.rules]
+        assert all(r.max_matches >= 1 for r in a.rules)
+
+
+class TestWatchDrops:
+    def _stack(self):
+        api = ApiServer()
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        seen: list[str] = []
+
+        class Rec:
+            def reconcile(self, req):
+                seen.append(req.name)
+                return Result()
+
+        mgr.register("nb", Rec(), for_kind="Notebook")
+        return api, mgr, seen
+
+    def test_drop_resumes_from_last_rv_without_loss(self):
+        api, mgr, seen = self._stack()
+        api.create(mk("Notebook", "n1"))
+        mgr.run_until_idle()
+        api.install_fault_plan(FaultPlan(
+            [FaultRule(verbs=("create",), kinds=("ConfigMap",),
+                       drop_watch=True)]))
+        # the drop fires on this create; the manager's session resumes via
+        # subscribe(since_rv) and still sees the Notebook event that lands
+        # inside the same call graph
+        api.create(mk("ConfigMap", "noise"))
+        api.clear_fault_plan()
+        api.create(mk("Notebook", "n2"))
+        mgr.run_until_idle()
+        assert "n2" in seen
+        assert mgr._watch_session.drops == 1
+        assert mgr._watch_session.relists == 0
+
+    def test_drop_with_history_reset_forces_relist(self):
+        api, mgr, seen = self._stack()
+        api.create(mk("Notebook", "n1"))
+        mgr.run_until_idle()
+        seen.clear()
+        # the classic dead-resourceVersion sequence: the stream drops,
+        # events land while the client is away, and etcd compaction then
+        # evicts exactly the window the client would resume from
+        api.install_fault_plan(FaultPlan([
+            FaultRule(verbs=("create",), kinds=("ConfigMap",),
+                      drop_watch=True),
+            FaultRule(verbs=("create",), kinds=("Secret",),
+                      reset_watch_history=True),
+        ]))
+        api.create(mk("ConfigMap", "noise"))   # drop fires; commit missed
+        api.create(mk("Secret", "compaction"))  # evicts the resume window
+        api.clear_fault_plan()
+        mgr.run_until_idle()
+        # resume rv predates the compacted window -> 410 Gone -> live
+        # re-subscribe + relist, which re-enqueues every primary
+        assert mgr._watch_session.drops == 1
+        assert mgr._watch_session.relists == 1
+        assert "n1" in seen
+        # and the session is live again for future events
+        api.create(mk("Notebook", "n2"))
+        mgr.run_until_idle()
+        assert "n2" in seen
+
+    def test_plain_watchers_survive_drops(self):
+        api, mgr, _ = self._stack()
+        cluster = FakeCluster(api)  # plain callback watcher (data plane)
+        cluster.add_node("n1")
+        api.install_fault_plan(FaultPlan(
+            [FaultRule(drop_watch=True, max_matches=1)]))
+        sts = mk("StatefulSet", "web")
+        sts.body["spec"] = {
+            "replicas": 1,
+            "template": {"metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": [{"name": "c",
+                                                  "image": "i"}]}},
+        }
+        sts.api_version = "apps/v1"
+        api.create(sts)  # fires the drop; kubelet must still realize pods
+        api.clear_fault_plan()
+        assert api.try_get("Pod", "default", "web-0") is not None

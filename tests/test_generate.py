@@ -211,3 +211,72 @@ class TestStagedKv:
         # backends; near-tie argmax flips are the only tolerated diffs
         agree = float(np.mean(np.asarray(staged) == np.asarray(unstaged)))
         assert agree >= 0.95, agree
+
+    def test_multi_token_decode_at_nonzero_cur_matches_unstaged(self):
+        """Chunked prefill / verify-style multi-token calls at cur>0: rows
+        [flushed, cur) live only in the stage, and the multi-token branch
+        must flush them into the main cache before attending — they used
+        to silently read as zeros (ADVICE round 5)."""
+        from kubeflow_tpu.models.configs import TINY
+
+        cfg = decode_config(TINY)
+        assert cfg.staged_kv
+        ucfg = cfg.with_(staged_kv=False)
+        params = Transformer(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        toks = jax.random.randint(jax.random.PRNGKey(5), (2, 15),
+                                  0, cfg.vocab_size)
+        # single-token steps leave a live stage (cur=7, slots [0,7));
+        # the 6-token chunk at cur=7 is the hazard case, and the single
+        # steps after it verify the re-seeded stage invariant holds
+        chunks = [5, 1, 1, 6, 1, 1]
+
+        def run(c):
+            model = Transformer(c)
+            cache: dict = {}
+            outs = []
+            pos = 0
+            for n in chunks:
+                seg = toks[:, pos:pos + n]
+                kw = {}
+                if pos:
+                    kw["positions"] = jnp.broadcast_to(
+                        pos + jnp.arange(n)[None, :], (2, n))
+                (logits, _), cache = model.apply(
+                    {"params": params, **cache}, seg, return_aux=True,
+                    decode=True, mutable=["cache"], **kw)
+                outs.append(np.asarray(logits))
+                pos += n
+            return outs
+
+        staged_outs = run(cfg)
+        unstaged_outs = run(ucfg)
+        # reading the stage rows as zeros collapses agreement to chance;
+        # correct flushing leaves only reassociation-level argmax flips
+        for i, (s, u) in enumerate(zip(staged_outs, unstaged_outs)):
+            agree = float(np.mean(s.argmax(-1) == u.argmax(-1)))
+            assert agree >= 0.95, (i, agree)
+
+    def test_staged_kv_requires_aligned_max_seq_len(self):
+        from kubeflow_tpu.models.configs import TINY
+
+        cfg = decode_config(TINY).with_(max_seq_len=30)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            Transformer(cfg).init(
+                jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32),
+                decode=True)
+
+    def test_decode_marker_preserves_explicit_choices(self):
+        """already_decode keys on the explicit decode marker: a training
+        config that merely looks decode-ish (remat off, xla attention)
+        still gets the decode defaults, while a decode_config product
+        keeps its explicit overrides (ADVICE round 5)."""
+        from kubeflow_tpu.models.configs import TINY
+
+        trainish = TINY.with_(remat=False, attention_impl="xla")
+        d = decode_config(trainish)
+        assert d.decode and d.fused_projections and d.staged_kv
+        # explicit opt-outs on a decode-shaped config survive re-entry
+        explicit = d.with_(staged_kv=False, fused_projections=False)
+        d2 = decode_config(explicit)
+        assert not d2.staged_kv and not d2.fused_projections

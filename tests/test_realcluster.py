@@ -984,6 +984,70 @@ class TestRateLimiter:
         assert time.monotonic() - t0 < 0.1
 
 
+class TestReflector410:
+    """The reflector must treat a mid-stream ERROR Status event with code
+    410 / reason Expired as GoneError (the informer loop then RELISTS,
+    never resuming from the dead resourceVersion) — real apiservers send
+    exactly this when the watch cache compacts past the client's rv."""
+
+    def _client_with_stream(self, monkeypatch, lines):
+        client = KubeClient(RestConfig(server="http://127.0.0.1:1"))
+
+        class FakeResp:
+            status = 200
+
+            def readline(self):
+                return lines.pop(0) if lines else b""
+
+            def read(self):
+                return b""
+
+        class FakeConn:
+            sock = None
+
+            def request(self, *a, **kw):
+                pass
+
+            def getresponse(self):
+                return FakeResp()
+
+            def close(self):
+                pass
+
+        monkeypatch.setattr(client, "_connect",
+                            lambda timeout: FakeConn())
+        return client
+
+    def test_error_410_event_raises_gone(self, monkeypatch):
+        from kubeflow_tpu.kube.client import _Informer
+
+        lines = [json.dumps({
+            "type": "ERROR",
+            "object": {"kind": "Status", "code": 410, "reason": "Expired",
+                       "message": "too old resource version 5"},
+        }).encode() + b"\n"]
+        client = self._client_with_stream(monkeypatch, lines)
+        info = client.scheme_registry.by_kind("Pod")
+        inf = _Informer("Pod", thread=None)
+        with pytest.raises(GoneError):
+            client._watch_stream(info, 5, inf)
+
+    def test_error_event_without_410_is_server_error(self, monkeypatch):
+        from kubeflow_tpu.kube.client import _Informer
+        from kubeflow_tpu.kube.errors import ServerError
+
+        lines = [json.dumps({
+            "type": "ERROR",
+            "object": {"kind": "Status", "code": 500,
+                       "message": "internal"},
+        }).encode() + b"\n"]
+        client = self._client_with_stream(monkeypatch, lines)
+        info = client.scheme_registry.by_kind("Pod")
+        inf = _Informer("Pod", thread=None)
+        with pytest.raises(ServerError):
+            client._watch_stream(info, 5, inf)
+
+
 class TestAuditLog:
     """The wire server's request-audit trail (envtest audit-log analog,
     odh suite_test.go:126-156): one JSONL line per request."""
