@@ -16,6 +16,11 @@ that needs no third-party tooling so the gate also runs in hermetic images:
     names (conservative: undecorated plain functions without *args /
     **kwargs only) — the locally-runnable slice of what mypy's
     call-checking provides
+  - Prometheus metric naming conventions at registration sites
+    (`.counter("...")` / `.gauge("...")` / `.histogram("...")` calls):
+    a `*_total` name must register a counter, and a `*_seconds` name a
+    histogram or gauge — a counter-suffixed gauge breaks PromQL
+    rate()/increase() silently (the bug this check was born from)
 """
 
 from __future__ import annotations
@@ -143,7 +148,43 @@ def check(path: Path, tree: "ast.AST | None" = None) -> list[str]:
             out.append(f"{rel}:{lineno}: trailing whitespace")
         if line.startswith("\t"):
             out.append(f"{rel}:{lineno}: tab indentation")
+    out.extend(f"{rel}:{line}: {msg}"
+               for line, msg in check_metric_names(tree))
     return out
+
+
+_METRIC_METHODS = ("counter", "gauge", "histogram")
+
+
+def check_metric_names(tree: ast.AST) -> list[tuple[int, str]]:
+    """Prometheus naming conventions at registration sites: `*_total` names
+    must be counters; `*_seconds` names must be histograms or gauges
+    (duration counters like `*_seconds_total` are fine — the `_total` rule
+    covers them)."""
+    problems: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        method = node.func.attr
+        if name.endswith("_total") and method != "counter":
+            problems.append((
+                node.lineno,
+                f"metric {name!r} has the counter suffix _total but is "
+                f"registered via .{method}() — register a counter or "
+                "rename"))
+        elif name.endswith("_seconds") and method == "counter":
+            problems.append((
+                node.lineno,
+                f"metric {name!r} is a duration (_seconds) but is "
+                "registered via .counter() — use a histogram or gauge "
+                "(or name it *_seconds_total)"))
+    return problems
 
 
 def _collect_signatures() -> dict:

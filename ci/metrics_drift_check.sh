@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Metric-inventory drift gate: boot the standalone manager, scrape
+# /metrics, and diff the metric-family inventory (name + type, from the
+# `# TYPE` exposition lines) against the committed golden list
+# (ci/metrics_families.golden).  A rename, removal, or type change of any
+# family fails CI here instead of silently breaking dashboards and
+# recording rules downstream.
+#
+# Intentional changes: update the golden with
+#   ci/metrics_drift_check.sh --update
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${METRICS_DRIFT_PORT:-18478}"
+GOLDEN="ci/metrics_families.golden"
+SCRAPE="$(mktemp)"
+FAMILIES="$(mktemp)"
+
+python -m kubeflow_tpu.main --metrics-addr "$PORT" --webhook-port -1 \
+  --run-seconds 30 >/dev/null 2>&1 &
+MGR_PID=$!
+cleanup() {
+  kill "$MGR_PID" 2>/dev/null || true
+  rm -f "$SCRAPE" "$FAMILIES"
+}
+trap cleanup EXIT
+
+# poll until the manager serves a scrape (stdlib only — no curl dependency)
+python - "$PORT" "$SCRAPE" <<'EOF'
+import sys, time, urllib.request
+
+port, out = sys.argv[1], sys.argv[2]
+deadline = time.time() + 20
+while True:
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
+        if "# TYPE" in body:
+            break
+    except Exception:
+        if time.time() > deadline:
+            raise SystemExit("manager never served /metrics")
+        time.sleep(0.25)
+with open(out, "w") as f:
+    f.write(body)
+EOF
+
+grep '^# TYPE ' "$SCRAPE" | awk '{print $3" "$4}' | sort > "$FAMILIES"
+
+if [[ "${1:-}" == "--update" ]]; then
+  cp "$FAMILIES" "$GOLDEN"
+  echo "updated $GOLDEN ($(wc -l < "$GOLDEN") families)"
+  exit 0
+fi
+
+if [[ ! -f "$GOLDEN" ]]; then
+  echo "missing $GOLDEN — bootstrap with: ci/metrics_drift_check.sh --update" >&2
+  exit 1
+fi
+
+if ! diff -u "$GOLDEN" "$FAMILIES"; then
+  echo >&2
+  echo "metric-family inventory drifted from $GOLDEN." >&2
+  echo "If intentional, refresh it: ci/metrics_drift_check.sh --update" >&2
+  exit 1
+fi
+echo "metrics drift check OK ($(wc -l < "$GOLDEN") families)"

@@ -67,4 +67,7 @@ fi
 # a deeper sweep — all FakeClock-driven, seconds of wall time)
 if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   bash ci/chaos_soak.sh
+  # metric-family inventory vs the committed golden list — renames/removals
+  # fail here instead of silently breaking dashboards
+  bash ci/metrics_drift_check.sh
 fi

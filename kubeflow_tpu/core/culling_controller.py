@@ -16,6 +16,7 @@ from typing import Optional
 from ..api.types import Notebook
 from ..kube import ApiServer, Manager, Request, Result, retry_on_conflict
 from ..tpu import env as tpuenv
+from ..utils import tracing
 from ..utils.clock import Clock
 from ..utils.config import CoreConfig
 from . import constants as C
@@ -24,6 +25,8 @@ from .jupyter import JupyterAPI
 from .metrics import NotebookMetrics
 
 logger = logging.getLogger("kubeflow_tpu.culling")
+
+_TRACER = tracing.get_tracer("kubeflow_tpu.core.culling")
 
 # annotation the in-notebook runtime sets once its pre-cull checkpoint is done
 CHECKPOINT_COMPLETE_ANNOTATION = C.ANNOTATION_CHECKPOINT_COMPLETE
@@ -80,31 +83,39 @@ class CullingReconciler:
         ):
             return self._requeue()
 
-        # probe Jupyter outside the retry loop (:163-169)
-        kernels = self.jupyter.get_kernels(req.name, req.namespace)
-        terminals = self.jupyter.get_terminals(req.name, req.namespace)
+        # idle probe + cull decision under a 'culling' phase span, so a
+        # trace shows whether an idle notebook was culled, held for a
+        # checkpoint, or found active again
+        with _TRACER.start_span(
+            "culling", {"namespace": req.namespace, "notebook": req.name}
+        ) as span:
+            # probe Jupyter outside the retry loop (:163-169)
+            kernels = self.jupyter.get_kernels(req.name, req.namespace)
+            terminals = self.jupyter.get_terminals(req.name, req.namespace)
 
-        def apply(meta) -> None:
-            culler.update_last_activity_from_kernels(meta, kernels, self.clock)
-            culler.update_last_activity_from_terminals(meta, terminals, self.clock)
-            culler.update_last_culling_check_timestamp(meta, self.clock)
-            if not culler.notebook_is_idle(
-                meta, self.clock, self.cfg.cull_idle_time_min
-            ):
-                # activity resumed: reset the checkpoint handshake so the
-                # next idle period gets a fresh request + grace window
-                culler.remove_checkpoint_annotations(meta)
-            else:
-                if self._should_wait_for_checkpoint(nb, meta):
-                    return
-                logger.info("culling notebook %s/%s", req.namespace, req.name)
-                culler.set_stop_annotation(meta, self.clock)
-                self.metrics.culling.labels(req.namespace, req.name).inc()
-                self.metrics.last_culling_timestamp.labels(
-                    req.namespace, req.name
-                ).set(self.clock.now())
+            def apply(meta) -> None:
+                culler.update_last_activity_from_kernels(meta, kernels, self.clock)
+                culler.update_last_activity_from_terminals(meta, terminals, self.clock)
+                culler.update_last_culling_check_timestamp(meta, self.clock)
+                if not culler.notebook_is_idle(
+                    meta, self.clock, self.cfg.cull_idle_time_min
+                ):
+                    # activity resumed: reset the checkpoint handshake so the
+                    # next idle period gets a fresh request + grace window
+                    culler.remove_checkpoint_annotations(meta)
+                else:
+                    if self._should_wait_for_checkpoint(nb, meta):
+                        span.add_event("culling.checkpoint_wait")
+                        return
+                    logger.info("culling notebook %s/%s", req.namespace, req.name)
+                    span.add_event("notebook.culled")
+                    culler.set_stop_annotation(meta, self.clock)
+                    self.metrics.culling.labels(req.namespace, req.name).inc()
+                    self.metrics.last_culling_timestamp.labels(
+                        req.namespace, req.name
+                    ).set(self.clock.now())
 
-        self._mutate(req, apply)
+            self._mutate(req, apply)
         return self._requeue()
 
     def _should_wait_for_checkpoint(self, nb: Notebook, meta) -> bool:

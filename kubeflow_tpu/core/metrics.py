@@ -49,14 +49,22 @@ class NotebookMetrics:
             "TPU chips requested by running notebook slices",
             labels=("namespace",),
         )
-        self.notebook_ready_seconds = self.registry.gauge(
+        # first-readiness latency distribution, observed once per notebook
+        # by the NotebookReconciler off the injected clock (the reference
+        # has no such metric; NotebookOS-style schedulers want it)
+        self.notebook_ready_seconds = self.registry.histogram(
             "notebook_to_ready_seconds",
             "Latency from Notebook creation to all workers Ready",
-            labels=("namespace", "name"),
+            labels=("namespace",),
+            buckets=(1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+                     1800.0, 3600.0),
         )
         # workqueue / retry observability (controller-runtime exports the
         # same family: workqueue_depth, workqueue_retries_total) — scraped
-        # from Manager.queue_stats() when a manager is attached
+        # from Manager.queue_stats() when a manager is attached.  The
+        # *_total families are monotonic counters fed by deltas from the
+        # scrape-state snapshot (a gauge set() from scrape state would
+        # break Prometheus rate()/increase() on counter-suffixed names)
         self.workqueue_depth = self.registry.gauge(
             "workqueue_depth",
             "Current reconcile requests queued per controller",
@@ -67,7 +75,7 @@ class NotebookMetrics:
             "Reconcile requests waiting out a retry backoff",
             labels=("controller",),
         )
-        self.workqueue_retries_total = self.registry.gauge(
+        self.workqueue_retries_total = self.registry.counter(
             "workqueue_retries_total",
             "Total rate-limited requeues scheduled per controller",
             labels=("controller",),
@@ -77,14 +85,28 @@ class NotebookMetrics:
             "Most recent backoff delay handed out per controller",
             labels=("controller",),
         )
-        self.reconcile_errors_total = self.registry.gauge(
+        self.reconcile_errors_total = self.registry.counter(
             "reconcile_errors_total",
             "Reconcile requests dropped after exhausting their retry budget",
             labels=("controller",),
         )
+        # last snapshot of the manager's cumulative totals, so each scrape
+        # feeds the counters exactly the delta since the previous scrape
+        self._counter_snapshots: dict[tuple[str, str], float] = {}
 
     def attach_manager(self, manager) -> None:
         self.manager = manager
+
+    def _feed_counter(self, counter, label: str, total: float) -> None:
+        """Advance a monotonic counter to `total` using deltas against the
+        previous scrape; a source reset (new manager) re-counts from zero."""
+        key = (counter.name, label)
+        prev = self._counter_snapshots.get(key, 0.0)
+        if total > prev:
+            counter.labels(label).inc(total - prev)
+        elif total < prev:
+            counter.labels(label).inc(total)
+        self._counter_snapshots[key] = float(total)
 
     def scrape(self) -> str:
         """List-based scrape (metrics.go:82-99): recompute gauges from the
@@ -125,10 +147,31 @@ class NotebookMetrics:
                     stats["depth"].get(name, 0))
                 self.workqueue_backoff_pending.labels(name).set(
                     stats["backoff_pending"].get(name, 0))
-                self.workqueue_retries_total.labels(name).set(
-                    stats["retries_total"].get(name, 0))
+                self._feed_counter(self.workqueue_retries_total, name,
+                                   stats["retries_total"].get(name, 0))
                 self.workqueue_last_backoff_seconds.labels(name).set(
                     stats["last_backoff_s"].get(name, 0.0))
-                self.reconcile_errors_total.labels(name).set(
-                    stats["errors_total"].get(name, 0))
-        return self.registry.render()
+                self._feed_counter(self.reconcile_errors_total, name,
+                                   stats["errors_total"].get(name, 0))
+        return self.render()
+
+    def render(self) -> str:
+        """Full exposition: this registry plus the attached manager's
+        reconcile/workqueue registry (controller_runtime_reconcile_*,
+        workqueue_*_duration_seconds) as one scrape body.  Families are
+        disjoint between the two registries, so the combined text stays a
+        valid single exposition."""
+        text = self.registry.render()
+        mgr_registry = getattr(self.manager, "metrics_registry", None)
+        if mgr_registry is not None:
+            text += mgr_registry.render()
+        return text
+
+    def families(self) -> list[tuple[str, str]]:
+        """(name, kind) inventory across both registries — what
+        ci/metrics_drift_check.sh freezes in its golden list."""
+        fams = self.registry.families()
+        mgr_registry = getattr(self.manager, "metrics_registry", None)
+        if mgr_registry is not None:
+            fams += mgr_registry.families()
+        return fams

@@ -26,12 +26,17 @@ from ..kube import (
     retry_on_conflict,
     set_controller_reference,
 )
+from ..utils import tracing
 from ..utils.clock import Clock
 from ..utils.config import CoreConfig
 from . import constants as C
 from .metrics import NotebookMetrics
 
 logger = logging.getLogger("kubeflow_tpu.core")
+
+# phase child spans (render/apply/status) parent onto the manager's
+# per-attempt reconcile root span via the shared context stack
+_TRACER = tracing.get_tracer("kubeflow_tpu.core.notebook")
 
 
 class NotebookReconciler:
@@ -48,6 +53,11 @@ class NotebookReconciler:
         self.metrics = metrics
         self.recorder = recorder or EventRecorder(api, "notebook-controller")
         self.clock = clock or Clock()
+        # first-readiness tracking for the notebook_to_ready_seconds
+        # histogram: first-seen clock time per live notebook (keyed by uid
+        # so a delete+recreate measures afresh), dropped once observed
+        self._first_seen: dict[tuple[str, str, str], float] = {}
+        self._ready_observed: set[tuple[str, str, str]] = set()
 
     # -- main loop (reference Reconcile, notebook_controller.go:94-294) -------
     def reconcile(self, req: Request) -> Result:
@@ -68,7 +78,9 @@ class NotebookReconciler:
         )
 
         # StatefulSets (one per slice; one total for CPU notebooks)
-        desired_sets = generate_statefulsets(nb, self.cfg)
+        with _TRACER.start_span("render") as render_span:
+            desired_sets = generate_statefulsets(nb, self.cfg)
+            render_span.set_attribute("statefulsets", len(desired_sets))
         existing = [
             s
             for s in self.api.list("StatefulSet", namespace=req.namespace)
@@ -96,6 +108,64 @@ class NotebookReconciler:
         # and re-raise so the manager's backoff retries the whole set; the
         # per-slice writes themselves are idempotent.
         errors: list[Exception] = []
+        with _TRACER.start_span("apply") as apply_span:
+            self._apply_workload(
+                nb, obj, req, desired_sets, existing, existing_by_name,
+                existing_by_slice, slice_of, live_names, matched_live, errors)
+
+            if errors:
+                apply_span.set_attribute("error", True)
+                apply_span.add_event("apply.errors", {
+                    "count": len(errors),
+                    "first": str(errors[0]),
+                })
+                # best-effort truthful status over EVERY existing STS,
+                # matched or not (a half-stopped slice must read
+                # Stopping/Degraded, never Stopped/Healthy), then fail the
+                # reconcile so the manager's backoff retries it
+                names = live_names + [
+                    s.name for s in existing if s.name not in matched_live]
+                try:
+                    self._update_status(nb, names)
+                except Exception:  # noqa: BLE001 — the slice error wins
+                    pass
+                raise errors[0]
+
+            # Services
+            svc = generate_service(nb)
+            set_controller_reference(obj, svc)
+            rh.reconcile_object(self.api, svc, rh.copy_service_fields)
+            if nb.tpu is not None:
+                headless = generate_headless_service(nb)
+                set_controller_reference(obj, headless)
+                rh.reconcile_object(self.api, headless, rh.copy_service_fields)
+
+            if self.cfg.use_istio:
+                vs = generate_virtual_service(nb, self.cfg)
+                set_controller_reference(obj, vs)
+                rh.reconcile_object(self.api, vs, rh.copy_spec)
+
+        # status from live STS + pods
+        self._update_status(nb, live_names)
+
+        # restart annotation (notebook_controller.go:259-294); for TPU
+        # notebooks restart is slice-atomic: delete every worker pod
+        annotations = self.api.get("Notebook", req.namespace, req.name).metadata.annotations
+        if annotations.get(C.ANNOTATION_NOTEBOOK_RESTART) == "true":
+            self._restart_pods(nb, live_names)
+            def clear() -> None:
+                live = self.api.get("Notebook", req.namespace, req.name)
+                live.metadata.annotations.pop(C.ANNOTATION_NOTEBOOK_RESTART, None)
+                self.api.update(live)
+            retry_on_conflict(clear)
+        return Result()
+
+    def _apply_workload(self, nb, obj, req, desired_sets, existing,
+                        existing_by_name, existing_by_slice, slice_of,
+                        live_names, matched_live, errors) -> None:
+        """The workload half of the 'apply' phase: per-slice StatefulSet
+        create/update plus scale-in pruning; errors aggregate into `errors`
+        for the caller's slice-atomic handling."""
         for idx, desired in enumerate(desired_sets):
             set_controller_reference(obj, desired)
             if desired.name:
@@ -138,48 +208,6 @@ class NotebookReconciler:
                     except Exception as err:  # noqa: BLE001
                         errors.append(err)
 
-        if errors:
-            # best-effort truthful status over EVERY existing STS, matched
-            # or not (a half-stopped slice must read Stopping/Degraded,
-            # never Stopped/Healthy), then fail the reconcile so the
-            # manager's rate-limited backoff retries it
-            names = live_names + [
-                s.name for s in existing if s.name not in matched_live]
-            try:
-                self._update_status(nb, names)
-            except Exception:  # noqa: BLE001 — the slice error wins
-                pass
-            raise errors[0]
-
-        # Services
-        svc = generate_service(nb)
-        set_controller_reference(obj, svc)
-        rh.reconcile_object(self.api, svc, rh.copy_service_fields)
-        if nb.tpu is not None:
-            headless = generate_headless_service(nb)
-            set_controller_reference(obj, headless)
-            rh.reconcile_object(self.api, headless, rh.copy_service_fields)
-
-        if self.cfg.use_istio:
-            vs = generate_virtual_service(nb, self.cfg)
-            set_controller_reference(obj, vs)
-            rh.reconcile_object(self.api, vs, rh.copy_spec)
-
-        # status from live STS + pods
-        self._update_status(nb, live_names)
-
-        # restart annotation (notebook_controller.go:259-294); for TPU
-        # notebooks restart is slice-atomic: delete every worker pod
-        annotations = self.api.get("Notebook", req.namespace, req.name).metadata.annotations
-        if annotations.get(C.ANNOTATION_NOTEBOOK_RESTART) == "true":
-            self._restart_pods(nb, live_names)
-            def clear() -> None:
-                live = self.api.get("Notebook", req.namespace, req.name)
-                live.metadata.annotations.pop(C.ANNOTATION_NOTEBOOK_RESTART, None)
-                self.api.update(live)
-            retry_on_conflict(clear)
-        return Result()
-
     # -- helpers ---------------------------------------------------------------
     def _pods_of(self, nb: Notebook, live_sts_name: str) -> list[KubeObject]:
         """Pods of a live StatefulSet, selected via its own selector — the
@@ -202,9 +230,17 @@ class NotebookReconciler:
                     pass
 
     def _update_status(self, nb: Notebook, live_names: list[str]) -> None:
+        with _TRACER.start_span("status") as span:
+            self._compute_and_write_status(nb, live_names, span)
+
+    def _compute_and_write_status(self, nb: Notebook, live_names: list[str],
+                                  span) -> None:
         """Mirror pod conditions + container state into the CR
         (createNotebookStatus, notebook_controller.go:299-374); TPU
-        notebooks additionally get per-worker states and slice health."""
+        notebooks additionally get per-worker states and slice health.
+        Condition/phase transitions land as events on the 'status' span,
+        and the first time a notebook reaches full readiness the
+        notebook_to_ready_seconds histogram observes the latency."""
         ready = 0
         worker_states: list[dict] = []
         conditions: list[dict] = []
@@ -295,6 +331,48 @@ class NotebookReconciler:
             worker_states=worker_states if tpu is not None else None,
             slice_health=slice_health,
         )
+
+        # transitions as span events: the trace timeline shows WHEN a slice
+        # degraded or a pod condition flipped, attempt-correlated
+        prev_status = nb.status or {}
+        prev_health = prev_status.get("sliceHealth")
+        if tpu is not None and slice_health != prev_health:
+            span.add_event("phase.transition", {
+                "field": "sliceHealth",
+                "from": prev_health or "",
+                "to": slice_health or "",
+            })
+        prev_conds = {
+            c.get("type"): c.get("status")
+            for c in (prev_status.get("conditions") or [])
+        }
+        for cond in conditions:
+            before = prev_conds.get(cond["type"])
+            if before != cond["status"]:
+                span.add_event("condition.transition", {
+                    "type": cond["type"],
+                    "from": before or "",
+                    "to": cond["status"],
+                })
+        span.set_attribute("readyReplicas", ready)
+
+        # first-readiness latency, measured on the injected clock from the
+        # first reconcile that saw this notebook (uid-keyed: delete+recreate
+        # measures afresh; no wall-clock reads, deterministic under FakeClock)
+        key = (nb.namespace, nb.name, nb.obj.metadata.uid)
+        first_seen = self._first_seen.setdefault(key, self.clock.now())
+        if ready >= expected_hosts and expected_hosts > 0 \
+                and key not in self._ready_observed:
+            self.metrics.notebook_ready_seconds.labels(nb.namespace).observe(
+                self.clock.now() - first_seen)
+            self._ready_observed.add(key)
+            self._first_seen.pop(key, None)
+            span.add_event("notebook.ready", {"seconds":
+                                              self.clock.now() - first_seen})
+        if len(self._ready_observed) > 8192:
+            self._ready_observed.clear()
+        if len(self._first_seen) > 8192:
+            self._first_seen.clear()
 
         def write() -> None:
             live = self.api.get("Notebook", nb.namespace, nb.name)

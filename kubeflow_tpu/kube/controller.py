@@ -20,12 +20,18 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
+from ..utils import tracing
 from ..utils.clock import Clock
+from ..utils.metrics import Registry
 from .errors import GoneError
 from .meta import KubeObject
 from .store import ApiServer, WatchEvent
 
 logger = logging.getLogger("kubeflow_tpu.kube")
+
+# every reconcile attempt runs under a root span from this tracer (noop
+# until an exporter is installed — utils.tracing.set_exporter)
+_TRACER = tracing.get_tracer("kubeflow_tpu.kube.manager")
 
 
 @dataclass(frozen=True)
@@ -75,6 +81,11 @@ class _Delayed:
     # clock over them; requeue_after waits are scheduled work and are NOT
     # auto-advanced (tests drive those with advance())
     retry: bool = field(default=False, compare=False)
+    # when the item entered the workqueue system (clock time); retries stamp
+    # at schedule time so the backoff wait shows up in
+    # workqueue_queue_duration_seconds, while requeue_after schedules (0.0)
+    # stamp at promotion — a timer wait is not queueing
+    enqueued_at: float = field(default=0.0, compare=False)
 
 
 # -- workqueue rate limiting ---------------------------------------------------
@@ -247,7 +258,7 @@ class Manager:
     """
 
     def __init__(self, api: ApiServer, clock: Optional[Clock] = None,
-                 rate_limiter=None) -> None:
+                 rate_limiter=None, registry: Optional[Registry] = None) -> None:
         self.api = api
         self.clock = clock or Clock()
         self._limiter = rate_limiter or default_rate_limiter(self.clock)
@@ -262,6 +273,35 @@ class Manager:
         # retries scheduled, last backoff delay, errors dropped
         self._retry_totals: dict[str, int] = {}
         self._last_backoff: dict[str, float] = {}
+        # controller-runtime's canonical reconcile/workqueue telemetry, all
+        # timed off the injected clock so FakeClock tests see exact values.
+        # core.metrics.NotebookMetrics concatenates this registry into the
+        # /metrics exposition when a manager is attached.
+        self.metrics_registry = registry or Registry()
+        self.reconcile_total = self.metrics_registry.counter(
+            "controller_runtime_reconcile_total",
+            "Total number of reconciliations per controller",
+            labels=("controller", "result"))
+        self.reconcile_time = self.metrics_registry.histogram(
+            "controller_runtime_reconcile_time_seconds",
+            "Length of time per reconciliation per controller",
+            labels=("controller",))
+        self.queue_duration = self.metrics_registry.histogram(
+            "workqueue_queue_duration_seconds",
+            "How long a request stays in the workqueue (retry backoff "
+            "included) before processing starts",
+            labels=("controller",))
+        self.work_duration = self.metrics_registry.histogram(
+            "workqueue_work_duration_seconds",
+            "How long processing a request from the workqueue takes",
+            labels=("controller",))
+        # enqueue timestamps feeding workqueue_queue_duration_seconds
+        self._enqueued_at: dict[tuple[str, Request], float] = {}
+        # one trace per retry chain: trace id held until the request
+        # succeeds, schedules itself (requeue_after), or is dropped;
+        # attempt numbers ride along as span attributes
+        self._trace_ids: dict[tuple[str, Request], str] = {}
+        self._attempt_seq: dict[tuple[str, Request], int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if hasattr(api, "subscribe"):
@@ -316,6 +356,9 @@ class Manager:
             dropped = [k for k in self._retries if k[0] == name]
             self._retries = {k: v for k, v in self._retries.items()
                              if k[0] != name}
+            for d in (self._enqueued_at, self._trace_ids, self._attempt_seq):
+                for k in [k for k in d if k[0] == name]:
+                    del d[k]
         for k in dropped:
             self._limiter.forget(k)
 
@@ -343,12 +386,16 @@ class Manager:
             out.extend(spec.mapper(obj))
         return out
 
-    def _enqueue(self, reg_name: str, req: Request) -> None:
+    def _enqueue(self, reg_name: str, req: Request,
+                 enqueued_at: Optional[float] = None) -> None:
         with self._lock:
             key = (reg_name, req)
             if key not in self._queued:
                 self._queued.add(key)
                 self._queue.append(key)
+                self._enqueued_at.setdefault(
+                    key,
+                    self.clock.now() if enqueued_at is None else enqueued_at)
 
     def enqueue(self, reg_name: str, req: Request) -> None:
         """Manual enqueue (tests, resync ticks)."""
@@ -380,7 +427,11 @@ class Manager:
                 return None
             key = self._queue.pop(0)
             self._queued.discard(key)
-            return key
+            enqueued_at = self._enqueued_at.pop(key, None)
+        if enqueued_at is not None:
+            self.queue_duration.labels(key[0]).observe(
+                max(self.clock.now() - enqueued_at, 0.0))
+        return key
 
     def _promote_delayed(self) -> None:
         now = self.clock.now()
@@ -388,7 +439,8 @@ class Manager:
             due = [d for d in self._delayed if d.due <= now]
             self._delayed = [d for d in self._delayed if d.due > now]
         for d in due:
-            self._enqueue(d.reg_name, d.request)
+            self._enqueue(d.reg_name, d.request,
+                          enqueued_at=d.enqueued_at or None)
 
     def _process_one(self) -> bool:
         if self._watch_session is not None and \
@@ -411,45 +463,99 @@ class Manager:
             with self._lock:
                 return any(r is reg for r in self._registrations)
 
+        # attempt numbering + trace identity: every attempt of one retry
+        # chain (error backoff / requeue=True) shares a trace id, so a
+        # chaos-soak trace shows which injected fault hit which attempt
+        attempt = self._attempt_seq.get(item, 0) + 1
+        self._attempt_seq[item] = attempt
+        start = self.clock.now()
+        outcome = "error"
         try:
-            result = reg.reconciler.reconcile(req) or Result()
-            self._retries.pop(item, None)
-            if not alive():
-                return True
-            if result.requeue_after > 0:
-                # explicit schedule: Forget (controller-runtime does on
-                # RequeueAfter) and wait out the caller's delay
-                self._limiter.forget(item)
-                with self._lock:
-                    self._delayed.append(
-                        _Delayed(self.clock.now() + result.requeue_after, reg_name, req)
-                    )
-            elif result.requeue:
-                # AddRateLimited without Forget: repeated requeue=True backs
-                # off like a failure would
-                self._requeue_rate_limited(item)
-            else:
-                self._limiter.forget(item)
-        except Exception as err:  # controller-runtime: requeue with backoff
-            if not alive():
-                return True
-            count = self._retries.get(item, 0) + 1
-            self._retries[item] = count
-            if count <= reg.max_retries:
-                delay = self._requeue_rate_limited(item)
-                logger.warning(
-                    "reconcile %s %s failed (attempt %d, retry in %.3fs): %s",
-                    reg_name, req, count, delay, err,
-                )
-            else:
-                logger.error(
-                    "reconcile %s %s dropped after %d attempts:\n%s",
-                    reg_name, req, count, traceback.format_exc(),
-                )
-                self._errors.append((reg_name, req, err))
-                self._retries.pop(item, None)  # fresh budget for future events
-                self._limiter.forget(item)
+            with _TRACER.start_span(
+                "reconcile",
+                attributes={
+                    "controller": reg_name,
+                    "namespace": req.namespace,
+                    "name": req.name,
+                    "attempt": attempt,
+                },
+                trace_id=self._trace_ids.get(item, ""),
+            ) as span:
+                if span.recording and item not in self._trace_ids:
+                    self._trace_ids[item] = span.trace_id
+                try:
+                    result = reg.reconciler.reconcile(req) or Result()
+                    if result.requeue_after > 0:
+                        outcome = "requeue_after"
+                    elif result.requeue:
+                        outcome = "requeue"
+                    else:
+                        outcome = "success"
+                    span.set_attribute("reconcile.result", outcome)
+                    self._retries.pop(item, None)
+                    if not alive():
+                        self._clear_request_trace(item)
+                        return True
+                    if result.requeue_after > 0:
+                        # explicit schedule: Forget (controller-runtime does
+                        # on RequeueAfter) and wait out the caller's delay
+                        self._limiter.forget(item)
+                        self._clear_request_trace(item)
+                        with self._lock:
+                            self._delayed.append(
+                                _Delayed(self.clock.now() + result.requeue_after,
+                                         reg_name, req)
+                            )
+                    elif result.requeue:
+                        # AddRateLimited without Forget: repeated
+                        # requeue=True backs off like a failure would
+                        self._requeue_rate_limited(item)
+                    else:
+                        self._limiter.forget(item)
+                        self._clear_request_trace(item)
+                except Exception as err:  # controller-runtime: requeue w/ backoff
+                    outcome = "error"
+                    span.set_attribute("error", True)
+                    span.set_attribute("reconcile.result", "error")
+                    span.add_event("reconcile.error", {
+                        "exception.type": type(err).__name__,
+                        "exception.message": str(err),
+                    })
+                    if not alive():
+                        self._clear_request_trace(item)
+                        return True
+                    count = self._retries.get(item, 0) + 1
+                    self._retries[item] = count
+                    if count <= reg.max_retries:
+                        delay = self._requeue_rate_limited(item)
+                        logger.warning(
+                            "reconcile %s %s failed (attempt %d, retry in "
+                            "%.3fs): %s",
+                            reg_name, req, count, delay, err,
+                        )
+                    else:
+                        logger.error(
+                            "reconcile %s %s dropped after %d attempts:\n%s",
+                            reg_name, req, count, traceback.format_exc(),
+                        )
+                        self._errors.append((reg_name, req, err))
+                        # fresh budget for future events
+                        self._retries.pop(item, None)
+                        self._limiter.forget(item)
+                        self._clear_request_trace(item)
+        finally:
+            duration = max(self.clock.now() - start, 0.0)
+            self.reconcile_time.labels(reg_name).observe(duration)
+            self.work_duration.labels(reg_name).observe(duration)
+            self.reconcile_total.labels(reg_name, outcome).inc()
         return True
+
+    def _clear_request_trace(self, item: tuple[str, Request]) -> None:
+        """The retry chain for this request is over (success, scheduled
+        requeue_after, drop, or unregister): the next event starts a fresh
+        trace with attempt 1."""
+        self._trace_ids.pop(item, None)
+        self._attempt_seq.pop(item, None)
 
     def _requeue_rate_limited(self, item: tuple[str, Request]) -> float:
         """Re-enqueue through the workqueue rate limiter: per-item
@@ -464,7 +570,8 @@ class Manager:
                 self._retry_totals.get(reg_name, 0) + 1
             self._last_backoff[reg_name] = delay
             self._delayed.append(
-                _Delayed(self.clock.now() + delay, reg_name, req, retry=True))
+                _Delayed(self.clock.now() + delay, reg_name, req, retry=True,
+                         enqueued_at=self.clock.now()))
         return delay
 
     def run_until_idle(self, max_iterations: int = 10_000,

@@ -36,6 +36,7 @@ import random
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
+from ..utils import tracing
 from .errors import ConflictError, ServerError
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
@@ -103,7 +104,13 @@ class FaultRule:
 
 @dataclass
 class FaultRecord:
-    """One injected fault, for post-hoc assertions."""
+    """One injected fault, for post-hoc assertions.
+
+    When a span exporter is installed, `trace_id`/`span_id` identify the
+    live reconcile span the fault hit (empty when the fault fired outside
+    any span, or tracing is noop) and `seq` is the fault's index in
+    `plan.log` — the same value stamped on the span event, so a soak can
+    pair every log entry with exactly one span event."""
 
     rule: str
     action: str
@@ -111,6 +118,9 @@ class FaultRecord:
     kind: str
     namespace: str
     name: str
+    trace_id: str = ""
+    span_id: str = ""
+    seq: int = -1
 
 
 class FaultPlan:
@@ -164,10 +174,30 @@ class FaultPlan:
                     self.rng.random() >= rule.probability:
                 continue
             self._fired[i] += 1
+            # stamp the fault onto whichever reconcile attempt it hit: the
+            # faulting ApiServer call may be running inside a controller
+            # phase child span, so walk up to the root (the manager's
+            # per-attempt reconcile span) — a chaos-soak trace then shows
+            # exactly which 409/503/watch-drop landed on which attempt
+            span = tracing.current_span()
+            while span.parent is not None:
+                span = span.parent
             rec = FaultRecord(
                 rule=rule.name or f"rule{i}", action=rule.action(),
-                verb=verb, kind=kind, namespace=namespace, name=name)
+                verb=verb, kind=kind, namespace=namespace, name=name,
+                trace_id=span.trace_id, span_id=span.span_id,
+                seq=len(self.log))
             self.log.append(rec)
+            span.add_event("fault.injected", {
+                "fault.rule": rec.rule,
+                "fault.action": rec.action,
+                "fault.verb": verb,
+                "fault.kind": kind,
+                "fault.namespace": namespace,
+                "fault.name": name,
+                "fault.seq": rec.seq,
+                "fault.plan_seed": self.seed,
+            })
             if rule.latency_s > 0:
                 self._inject_latency(rule.latency_s)
             if rule.reset_watch_history:

@@ -56,8 +56,13 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
             else:
                 self._respond(200, "ok", "text/plain")
         elif self.path == "/metrics":
-            registry = getattr(self.metrics, "registry", None)
-            body = registry.render() if registry is not None else ""
+            # scrape() recomputes list-derived gauges and folds in the
+            # manager's reconcile/workqueue registry; a bare render() would
+            # serve stale gauges and miss the controller_runtime_* families
+            if self.metrics is not None:
+                body = self.metrics.scrape()
+            else:
+                body = ""
             self._respond(200, body, "text/plain; version=0.0.4")
         elif self.path == "/state" and self.expose_state:
             api = self.manager.api if self.manager else None
@@ -236,12 +241,23 @@ def main(argv: Optional[list[str]] = None) -> int:
                              "trail (ts/verb/path/code) — the analog of "
                              "envtest's apiserver audit-log debug knob")
     parser.add_argument("--debug-log", action="store_true")
+    parser.add_argument("--log-format", choices=("text", "json"),
+                        default="text",
+                        help="json: structured one-object-per-line logs "
+                             "with trace_id/span_id correlation "
+                             "(utils/logging.py)")
     args = parser.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug_log else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    level = logging.DEBUG if args.debug_log else logging.INFO
+    if args.log_format == "json":
+        from .utils.logging import setup_structured_logging
+
+        setup_structured_logging(level)
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        )
     # webhook spans leave the process when OTEL_EXPORTER_OTLP_ENDPOINT is
     # set (odh main wires real OTel the same way; default stays noop)
     from .utils.tracing import setup_exporter_from_env

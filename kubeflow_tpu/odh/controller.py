@@ -25,6 +25,7 @@ from ..kube import (
     WatchSpec,
     retry_on_conflict,
 )
+from ..utils import tracing
 from ..utils.config import OdhConfig
 from . import auth, ca_bundle, constants as C, network, oauth, rbac, routing
 from .dspa import sync_elyra_runtime_config_secret
@@ -33,6 +34,10 @@ from .runtime_images import sync_runtime_images_configmap
 from .webhook import NotebookMutatingWebhook, NotebookValidatingWebhook
 
 logger = logging.getLogger("kubeflow_tpu.odh")
+
+# phase child spans (cert_trust/auth/routing) parent onto the manager's
+# per-attempt reconcile root span via the shared context stack
+_TRACER = tracing.get_tracer("kubeflow_tpu.odh.controller")
 
 LOCK_PULL_SECRET_MAX_ATTEMPTS = 3
 
@@ -73,9 +78,11 @@ class OpenshiftNotebookReconciler:
         if self._ensure_finalizers(nb):
             return Result(requeue=True)
 
-        ca_bundle.create_notebook_cert_configmap(self.api, nb)
-        if ca_bundle.is_configmap_deleted(self.api, nb):
-            ca_bundle.unset_notebook_cert_config(self.api, nb)
+        with _TRACER.start_span("cert_trust") as ct_span:
+            ca_bundle.create_notebook_cert_configmap(self.api, nb)
+            if ca_bundle.is_configmap_deleted(self.api, nb):
+                ct_span.add_event("cert_trust.source_configmap_deleted")
+                ca_bundle.unset_notebook_cert_config(self.api, nb)
 
         network.reconcile_all_network_policies(
             self.api, nb, self.cfg.controller_namespace
@@ -91,34 +98,42 @@ class OpenshiftNotebookReconciler:
             except Exception as err:
                 logger.warning("elyra secret reconcile failed: %s", err)
 
-        # ReferenceGrant before HTTPRoutes (notebook_controller.go:427-433)
-        routing.reconcile_reference_grant(self.api, nb, self.cfg.controller_namespace)
+        with _TRACER.start_span("routing") as routing_span:
+            auth_mode = self._auth_enabled(nb)
+            routing_span.set_attribute("auth_enabled", auth_mode)
+            # ReferenceGrant before HTTPRoutes (notebook_controller.go:427-433)
+            routing.reconcile_reference_grant(
+                self.api, nb, self.cfg.controller_namespace)
 
-        if self._auth_enabled(nb):
-            routing.ensure_conflicting_httproute_absent(
-                self.api, nb, self.cfg.controller_namespace, is_auth_mode=True
-            )
-            auth.reconcile_auth_resources(self.api, nb)
-            routing.reconcile_httproute(
-                self.api,
-                nb,
-                self.cfg.controller_namespace,
-                self.cfg.gateway_name,
-                self.cfg.gateway_namespace,
-                new_route=routing.new_kube_rbac_proxy_httproute,
-            )
-        else:
-            routing.ensure_conflicting_httproute_absent(
-                self.api, nb, self.cfg.controller_namespace, is_auth_mode=False
-            )
-            auth.cleanup_cluster_role_binding(self.api, nb)
-            routing.reconcile_httproute(
-                self.api,
-                nb,
-                self.cfg.controller_namespace,
-                self.cfg.gateway_name,
-                self.cfg.gateway_namespace,
-            )
+            if auth_mode:
+                routing.ensure_conflicting_httproute_absent(
+                    self.api, nb, self.cfg.controller_namespace,
+                    is_auth_mode=True
+                )
+                with _TRACER.start_span("auth"):
+                    auth.reconcile_auth_resources(self.api, nb)
+                routing.reconcile_httproute(
+                    self.api,
+                    nb,
+                    self.cfg.controller_namespace,
+                    self.cfg.gateway_name,
+                    self.cfg.gateway_namespace,
+                    new_route=routing.new_kube_rbac_proxy_httproute,
+                )
+            else:
+                routing.ensure_conflicting_httproute_absent(
+                    self.api, nb, self.cfg.controller_namespace,
+                    is_auth_mode=False
+                )
+                with _TRACER.start_span("auth"):
+                    auth.cleanup_cluster_role_binding(self.api, nb)
+                routing.reconcile_httproute(
+                    self.api,
+                    nb,
+                    self.cfg.controller_namespace,
+                    self.cfg.gateway_name,
+                    self.cfg.gateway_namespace,
+                )
 
         if self.cfg.mlflow_enabled:
             delay = reconcile_mlflow_integration(self.api, nb, self.recorder)

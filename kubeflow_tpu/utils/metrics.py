@@ -1,11 +1,19 @@
-"""Minimal Prometheus-style metrics registry (counters + gauges with labels)
-with text exposition, standing in for the controller-runtime metrics registry
-the reference uses (pkg/metrics/metrics.go:13-64)."""
+"""Minimal Prometheus-style metrics registry (counters, gauges, histograms
+with labels) with text exposition, standing in for the controller-runtime
+metrics registry the reference uses (pkg/metrics/metrics.go:13-64).
+
+Histograms follow the Prometheus data model exactly: cumulative `_bucket`
+series with an `le` label (including the implicit `+Inf`), plus `_sum` and
+`_count`.  The registry rejects duplicate registrations (two `# HELP`/
+`# TYPE` blocks for one family is a scrape error in Prometheus) but returns
+the existing metric on an identical re-registration, so idempotent setup
+paths stay cheap.
+"""
 
 from __future__ import annotations
 
 import threading
-from typing import Callable
+from typing import Callable, Optional
 
 
 class _Metric:
@@ -31,6 +39,9 @@ class _Metric:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + v
 
+    def _observe(self, key: tuple[str, ...], v: float) -> None:
+        raise TypeError(f"{self.name}: observe() requires a histogram")
+
     def value(self, *values: str) -> float:
         return self._values.get(tuple(values), 0.0)
 
@@ -39,6 +50,18 @@ class _Metric:
 
     def collect(self) -> dict[tuple[str, ...], float]:
         return dict(self._values)
+
+    def _label_str(self, key: tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{n}="{val}"' for n, val in zip(self.label_names, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def sample_lines(self) -> list[str]:
+        lines = []
+        for key, v in sorted(self.collect().items()):
+            lines.append(f"{self.name}{self._label_str(key)} {v:g}")
+        return lines
 
 
 class _Child:
@@ -51,6 +74,9 @@ class _Child:
 
     def set(self, v: float) -> None:
         self._metric._set(self._key, v)
+
+    def observe(self, v: float) -> None:
+        self._metric._observe(self._key, v)
 
 
 class Counter(_Metric):
@@ -69,6 +95,13 @@ class Gauge(_Metric):
         self._set((), v)
 
     def set_function(self, fn: Callable[[], float]) -> None:
+        # a labeled gauge has no single value for one callback to feed; the
+        # callback would render an unlabeled sample inside a labeled family,
+        # which Prometheus rejects
+        if self.label_names:
+            raise ValueError(
+                f"{self.name}: set_function() requires an unlabeled gauge "
+                f"(labels {self.label_names} declared)")
         self._fn = fn
 
     def collect(self) -> dict[tuple[str, ...], float]:
@@ -78,36 +111,176 @@ class Gauge(_Metric):
         return super().collect()
 
 
+# The Prometheus client_golang DefBuckets — what controller-runtime's
+# reconcile-time histogram uses below its long exponential tail; plenty of
+# resolution for both sub-ms in-memory reconciles and multi-second backoffs.
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (`le`-labeled `_bucket` series plus
+    `_sum`/`_count`), the exposition shape of
+    controller_runtime_reconcile_time_seconds."""
+
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...],
+                 buckets: Optional[tuple[float, ...]] = None):
+        super().__init__(name, help_, label_names)
+        bounds = tuple(sorted(set(buckets if buckets is not None
+                                  else DEFAULT_BUCKETS)))
+        if not bounds:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        self.buckets = bounds  # upper bounds, +Inf implicit
+        # key -> per-bucket counts (len(buckets)+1, last is +Inf)
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+
+    def kind(self) -> str:
+        return "histogram"
+
+    def observe(self, v: float) -> None:
+        self._observe((), v)
+
+    def _observe(self, key: tuple[str, ...], v: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1))
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + v
+
+    def _set(self, key: tuple[str, ...], v: float) -> None:
+        raise TypeError(f"{self.name}: set() is not valid on a histogram")
+
+    def _add(self, key: tuple[str, ...], v: float) -> None:
+        raise TypeError(f"{self.name}: inc() is not valid on a histogram")
+
+    # -- read side (tests assert on these) ------------------------------------
+    def count_value(self, *values: str) -> int:
+        with self._lock:
+            return sum(self._counts.get(tuple(values), ()))
+
+    def sum_value(self, *values: str) -> float:
+        with self._lock:
+            return self._sums.get(tuple(values), 0.0)
+
+    def bucket_counts(self, *values: str) -> dict[float, int]:
+        """Cumulative count per upper bound (inf included), as exposed."""
+        with self._lock:
+            counts = self._counts.get(tuple(values),
+                                      [0] * (len(self.buckets) + 1))
+            out: dict[float, int] = {}
+            running = 0
+            for bound, c in zip(self.buckets, counts):
+                running += c
+                out[bound] = running
+            out[float("inf")] = running + counts[-1]
+            return out
+
+    def value(self, *values: str) -> float:
+        return float(self.count_value(*values))
+
+    def collect(self) -> dict[tuple[str, ...], float]:
+        with self._lock:
+            return {k: float(sum(c)) for k, c in self._counts.items()}
+
+    def sample_lines(self) -> list[str]:
+        lines = []
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            running = 0
+            for bound, c in zip(self.buckets, counts):
+                running += c
+                le = 'le="%g"' % bound
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{self._label_str(key, le)} {running}")
+            total = running + counts[-1]
+            inf = 'le="+Inf"'
+            lines.append(
+                f"{self.name}_bucket"
+                f"{self._label_str(key, inf)} {total}")
+            lines.append(
+                f"{self.name}_sum{self._label_str(key)} "
+                f"{sums.get(key, 0.0):g}")
+            lines.append(f"{self.name}_count{self._label_str(key)} {total}")
+        return lines
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: list[_Metric] = []
+        self._by_name: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._by_name.get(metric.name)
+            if existing is not None:
+                identical = (
+                    type(existing) is type(metric)
+                    and existing.help == metric.help
+                    and existing.label_names == metric.label_names
+                    and getattr(existing, "buckets", None)
+                    == getattr(metric, "buckets", None)
+                )
+                if identical:
+                    return existing
+                raise ValueError(
+                    f"metric {metric.name!r} already registered as a "
+                    f"{existing.kind()} with labels {existing.label_names}; "
+                    "duplicate families render two HELP/TYPE blocks, which "
+                    "Prometheus rejects")
+            self._metrics.append(metric)
+            self._by_name[metric.name] = metric
+            return metric
 
     def counter(
         self, name: str, help_: str = "", labels: tuple[str, ...] = ()
     ) -> Counter:
-        m = Counter(name, help_, labels)
-        self._metrics.append(m)
+        m = self._register(Counter(name, help_, tuple(labels)))
+        assert isinstance(m, Counter)
         return m
 
     def gauge(
         self, name: str, help_: str = "", labels: tuple[str, ...] = ()
     ) -> Gauge:
-        m = Gauge(name, help_, labels)
-        self._metrics.append(m)
+        m = self._register(Gauge(name, help_, tuple(labels)))
+        assert isinstance(m, Gauge)
         return m
+
+    def histogram(
+        self, name: str, help_: str = "", labels: tuple[str, ...] = (),
+        buckets: Optional[tuple[float, ...]] = None,
+    ) -> Histogram:
+        m = self._register(Histogram(name, help_, tuple(labels),
+                                     buckets=buckets))
+        assert isinstance(m, Histogram)
+        return m
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._by_name.get(name)
+
+    def families(self) -> list[tuple[str, str]]:
+        """(name, kind) per registered family, in registration order — the
+        inventory ci/metrics_drift_check.sh diffs against its golden list."""
+        with self._lock:
+            return [(m.name, m.kind()) for m in self._metrics]
 
     def render(self) -> str:
         """Prometheus text exposition format."""
         lines: list[str] = []
-        for m in self._metrics:
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
             lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind()}")
-            for key, v in sorted(m.collect().items()):
-                if key:
-                    labels = ",".join(
-                        f'{n}="{val}"' for n, val in zip(m.label_names, key)
-                    )
-                    lines.append(f"{m.name}{{{labels}}} {v:g}")
-                else:
-                    lines.append(f"{m.name} {v:g}")
+            lines.extend(m.sample_lines())
         return "\n".join(lines) + "\n"

@@ -15,6 +15,7 @@ wires it at startup (setup_exporter_from_env).
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
 import logging
 import os
@@ -25,6 +26,22 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 logger = logging.getLogger("kubeflow_tpu.tracing")
+
+# injectable time source so span timelines are deterministic under a
+# FakeClock (set_clock); None falls back to the wall clock
+_clock = None
+
+
+def set_clock(clock) -> None:
+    """Route span/event timestamps through `clock.now()` (a FakeClock in
+    tests makes trace timelines deterministic); None restores time.time."""
+    global _clock
+    _clock = clock
+
+
+def _now() -> float:
+    c = _clock
+    return c.now() if c is not None else time.time()
 
 
 @dataclass
@@ -49,7 +66,7 @@ class Span:
 
     def add_event(self, name: str, attributes: Optional[dict] = None) -> None:
         if self.recording:
-            self.events.append(SpanEvent(name, dict(attributes or {}), time.time()))
+            self.events.append(SpanEvent(name, dict(attributes or {}), _now()))
 
     def set_attribute(self, key: str, value) -> None:
         if self.recording:
@@ -57,6 +74,23 @@ class Span:
 
 
 _NOOP_SPAN = Span(name="", recording=False)
+
+# The active-span stack, shared by every Tracer in the process (OTel's
+# context propagation): a child span started anywhere inside a reconcile —
+# a controller phase, the admission webhook re-entered through an ApiServer
+# write, a fault injection — parents onto the live reconcile span.  A
+# contextvar is per-thread (and per-async-task), so threaded managers and
+# webhook callouts cannot cross-contaminate each other's stacks.
+_SPAN_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "kubeflow_tpu_span_stack", default=())
+
+
+def current_span() -> Span:
+    """The innermost live span on this thread/context (noop when none) —
+    the hook kube.faults uses to stamp injected faults onto whichever
+    reconcile attempt the fault actually hit."""
+    stack = _SPAN_STACK.get()
+    return stack[-1] if stack else _NOOP_SPAN
 
 
 class InMemorySpanExporter:
@@ -90,40 +124,42 @@ class InMemorySpanExporter:
 class Tracer:
     def __init__(self, name: str) -> None:
         self.name = name
-        self._local = threading.local()
 
     def current_span(self) -> Span:
-        stack = getattr(self._local, "stack", None)
-        return stack[-1] if stack else _NOOP_SPAN
+        return current_span()
 
     @contextlib.contextmanager
     def start_span(
-        self, name: str, attributes: Optional[dict] = None
+        self, name: str, attributes: Optional[dict] = None,
+        trace_id: str = "",
     ) -> Iterator[Span]:
+        """Open a span as a child of the context's current span.  For a ROOT
+        span (no parent on the stack) `trace_id` pins the trace identity —
+        the manager passes the same id for every retry of one reconcile
+        request so its attempts line up on one trace timeline."""
         # the exporter is resolved per-span, matching the reference's lazily
         # created tracer whose provider is swapped in by tests
         exporter = _exporter
         if exporter is None:
             yield _NOOP_SPAN
             return
-        stack = getattr(self._local, "stack", None)
-        if stack is None:
-            stack = self._local.stack = []
+        stack = _SPAN_STACK.get()
         parent = stack[-1] if stack else None
         span = Span(
             name=name,
             attributes=dict(attributes or {}),
             parent=parent,
-            start_time=time.time(),
-            trace_id=parent.trace_id if parent else os.urandom(16).hex(),
+            start_time=_now(),
+            trace_id=parent.trace_id if parent
+            else (trace_id or os.urandom(16).hex()),
             span_id=os.urandom(8).hex(),
         )
-        stack.append(span)
+        token = _SPAN_STACK.set(stack + (span,))
         try:
             yield span
         finally:
-            stack.pop()
-            span.end_time = time.time()
+            _SPAN_STACK.reset(token)
+            span.end_time = _now()
             exporter.export(span)
 
 

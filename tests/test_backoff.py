@@ -240,6 +240,56 @@ class TestManagerBackoff:
         assert 'workqueue_retries_total{controller="nb"} 2' in text
         assert 'reconcile_errors_total{controller="nb"} 1' in text
 
+    def test_backoff_delays_land_in_queue_duration_histogram(self):
+        """A request that backs off twice shows those delays in the
+        workqueue_queue_duration_seconds buckets — timed entirely off the
+        FakeClock (enqueue-timestamp -> pop), no wall-clock reads."""
+        api, clock, mgr = self._mgr()
+        rec = Failing(fail_times=2, clock=clock)
+        mgr.register("nb", rec, for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+
+        hist = mgr.queue_duration
+        assert hist.count_value("nb") == 3  # initial + 2 backoff requeues
+        buckets = hist.bucket_counts("nb")
+        # initial enqueue popped with no clock movement: <= 5ms bucket
+        assert buckets[0.005] == 1
+        # first backoff: 5ms base * [1, 1.1) jitter -> (5, 5.5]ms
+        assert buckets[0.01] == 2
+        # second backoff: 10-11ms
+        assert buckets[0.025] == 3
+        assert buckets[float("inf")] == 3
+        # the sum is exactly the two backoff delays (initial wait was 0)
+        assert 0.015 <= hist.sum_value("nb") <= 0.0165
+        # work/reconcile histograms saw every attempt
+        assert mgr.work_duration.count_value("nb") == 3
+        assert mgr.reconcile_time.count_value("nb") == 3
+
+    def test_requeue_after_wait_is_not_queue_time(self):
+        """requeue_after is a timer, not queueing: the scheduled wait must
+        NOT inflate workqueue_queue_duration_seconds."""
+        api, clock, mgr = self._mgr()
+
+        class Scheduler:
+            calls = 0
+
+            def reconcile(self, req):
+                Scheduler.calls += 1
+                return Result(requeue_after=60.0) if Scheduler.calls == 1 \
+                    else Result()
+
+        mgr.register("nb", Scheduler(), for_kind="Notebook")
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        mgr.advance(61)
+        assert Scheduler.calls == 2
+        hist = mgr.queue_duration
+        assert hist.count_value("nb") == 2
+        # both pops saw ~0 queue time; the 60s timer never entered the queue
+        assert hist.bucket_counts("nb")[0.005] == 2
+        assert hist.sum_value("nb") <= 1.0 + 1e-9
+
     def test_max_of_rate_limiter_takes_worst(self):
         clock = FakeClock()
         rl = MaxOfRateLimiter(

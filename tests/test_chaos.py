@@ -249,6 +249,84 @@ class TestChaosSoak:
         # the soak must actually have injected chaos to mean anything
         assert total_faults > SOAK_ROUNDS, total_faults
 
+    def test_trace_integrity_under_faults(self, env):
+        """Observability acceptance: run soak rounds with a span exporter
+        installed and assert (1) every injected fault from the FaultPlan log
+        appears as a `fault.injected` event on exactly one reconcile span —
+        the very attempt it hit, (2) no span is dropped or left unfinished
+        even when reconciles error mid-phase, and (3) every non-root span's
+        parent was exported too (no orphaned timelines)."""
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.tracing import InMemorySpanExporter
+
+        api, cluster, mgr = env
+        exporter = InMemorySpanExporter()
+        tracing.set_exporter(exporter)
+        tracing.set_clock(mgr.clock)
+        try:
+            nb = Notebook.new(
+                "soak", "user1", tpu=TPUSpec("v5e", "4x4"),
+                annotations={OC.ANNOTATION_INJECT_AUTH: "true"},
+            )
+            api.create(nb.obj)
+            mgr.run_until_idle()
+
+            rng = random.Random(SOAK_SEED + 1)
+            injected: list[tuple[int, object]] = []  # (plan_seed, record)
+            rounds = 0
+            while len(injected) < 8 and rounds < 12:
+                rounds += 1
+                plan_seed = rng.randrange(2**31)
+                plan = random_fault_plan(plan_seed, kinds=FAULT_KINDS,
+                                         clock=mgr.clock)
+                api.install_fault_plan(plan)
+                self._perturb(rng, api, cluster, "soak")
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                mgr.settle(max_seconds=7200.0)
+                api.clear_fault_plan()
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                mgr.settle(max_seconds=7200.0)
+                injected.extend((plan.seed, rec) for rec in plan.log)
+            assert injected, "soak injected no faults to trace"
+
+            spans = exporter.spans
+            by_id = {s.span_id: s for s in spans}
+            # (2) every exported span finished; (3) parents exported
+            for s in spans:
+                assert s.end_time >= s.start_time > 0, \
+                    f"unfinished span {s.name}"
+                if s.parent is not None:
+                    assert s.parent.span_id in by_id, \
+                        f"orphaned span {s.name}"
+            # (1) fault <-> span-event pairing is exact and 1:1
+            fault_events = [
+                (s, e) for s in spans for e in s.events
+                if e.name == "fault.injected"
+            ]
+            assert len(fault_events) == len(injected), (
+                "fault log and span events disagree: "
+                f"{len(injected)} injected, {len(fault_events)} events")
+            for plan_seed, rec in injected:
+                assert rec.span_id, f"fault fired outside any span: {rec}"
+                owners = [
+                    s for s, e in fault_events
+                    if e.attributes["fault.plan_seed"] == plan_seed
+                    and e.attributes["fault.seq"] == rec.seq
+                ]
+                assert len(owners) == 1, (rec, [s.name for s in owners])
+                span = owners[0]
+                assert span.name == "reconcile", span.name
+                assert span.span_id == rec.span_id
+                assert span.trace_id == rec.trace_id
+                assert span.parent is None  # faults stamp the attempt ROOT
+                assert "controller" in span.attributes
+        finally:
+            api.clear_fault_plan()
+            tracing.set_exporter(None)
+            tracing.set_clock(None)
+
     def test_soak_is_reproducible_for_a_seed(self, env):
         """The same plan seed yields the same injections — the printed seed
         genuinely reproduces a failing round."""

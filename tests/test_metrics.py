@@ -1,0 +1,316 @@
+"""utils/metrics Registry semantics + exposition-format conformance.
+
+The render() output is what Prometheus actually ingests, so these tests
+round-trip it through a STRICT text-exposition parser (HELP/TYPE blocks,
+sample-to-family suffix rules, histogram bucket monotonicity and
+_count/_sum coherence) instead of substring checks — a malformed exposition
+fails loudly here rather than silently breaking a scrape.  Also covers the
+registry's duplicate-registration guard and the labeled-gauge
+set_function rejection.
+"""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from kubeflow_tpu.utils.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Histogram,
+    Registry,
+)
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{([^{}]*)\})?"                     # optional labels
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf)|NaN|\+Inf)$")
+_LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="([^"\\]*)"$')
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict Prometheus text-format parser: returns
+    {family: {"help": str, "type": str, "samples": {(name, labels): float}}}
+    and raises AssertionError on any structural violation."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families: dict[str, dict] = {}
+    current: str | None = None
+    for line in text[:-1].split("\n"):
+        if line.startswith("# HELP "):
+            _, _, name, help_ = line.split(" ", 3)
+            assert name not in families, f"duplicate # HELP block for {name}"
+            families[name] = {"help": help_, "type": None, "samples": {}}
+            current = None
+        elif line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"malformed TYPE line: {line!r}"
+            name, kind = parts[2], parts[3]
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name in families, f"TYPE before HELP for {name}"
+            assert families[name]["type"] is None, f"duplicate TYPE: {name}"
+            families[name]["type"] = kind
+            current = name
+        else:
+            assert current is not None, f"sample before any TYPE: {line!r}"
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            sample_name, label_blob, value = m.groups()
+            fam = families[current]
+            if fam["type"] == "histogram":
+                allowed = {f"{current}_bucket", f"{current}_sum",
+                           f"{current}_count"}
+            else:
+                allowed = {current}
+            assert sample_name in allowed, (
+                f"sample {sample_name!r} does not belong to family "
+                f"{current!r} ({fam['type']})")
+            labels = {}
+            if label_blob:
+                for pair in label_blob.split(","):
+                    lm = _LABEL_RE.match(pair)
+                    assert lm, f"malformed label pair {pair!r} in {line!r}"
+                    assert lm.group(1) not in labels, f"dup label: {line!r}"
+                    labels[lm.group(1)] = lm.group(2)
+            key = (sample_name, tuple(sorted(labels.items())))
+            assert key not in fam["samples"], f"duplicate sample: {line!r}"
+            fam["samples"][key] = float(value.replace("Inf", "inf"))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"family {name} has HELP but no TYPE"
+        if fam["type"] == "histogram":
+            _check_histogram_family(name, fam["samples"])
+    return families
+
+
+def _check_histogram_family(name: str, samples: dict) -> None:
+    """Bucket cumulativity, +Inf == _count, and _sum presence per series."""
+    series: dict[tuple, dict[float, float]] = {}
+    counts: dict[tuple, float] = {}
+    sums: set[tuple] = set()
+    for (sample_name, labels), value in samples.items():
+        base = {k: v for k, v in labels if k != "le"}
+        key = tuple(sorted(base.items()))
+        if sample_name == f"{name}_bucket":
+            le = dict(labels)["le"]
+            series.setdefault(key, {})[float(le.replace("Inf", "inf"))] = value
+        elif sample_name == f"{name}_count":
+            counts[key] = value
+        elif sample_name == f"{name}_sum":
+            sums.add(key)
+    for key, buckets in series.items():
+        bounds = sorted(buckets)
+        assert bounds[-1] == float("inf"), f"{name}{key}: no +Inf bucket"
+        cumulative = [buckets[b] for b in bounds]
+        assert all(a <= b for a, b in zip(cumulative, cumulative[1:])), (
+            f"{name}{key}: buckets not cumulative: {cumulative}")
+        assert key in counts and counts[key] == buckets[float("inf")], (
+            f"{name}{key}: _count != +Inf bucket")
+        assert key in sums, f"{name}{key}: missing _sum"
+
+
+class TestHistogram:
+    def test_observe_buckets_sum_count(self):
+        h = Histogram("lat_seconds", "h", (), buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count_value() == 5
+        assert h.sum_value() == pytest.approx(56.05)
+        assert h.bucket_counts() == {0.1: 1, 1.0: 3, 10.0: 4,
+                                     float("inf"): 5}
+
+    def test_labeled_series_are_independent(self):
+        h = Histogram("lat_seconds", "h", ("c",), buckets=(1.0,))
+        h.labels("a").observe(0.5)
+        h.labels("b").observe(2.0)
+        assert h.bucket_counts("a") == {1.0: 1, float("inf"): 1}
+        assert h.bucket_counts("b") == {1.0: 0, float("inf"): 1}
+
+    def test_boundary_value_lands_in_le_bucket(self):
+        # Prometheus buckets are `le` (less-or-EQUAL)
+        h = Histogram("lat_seconds", "h", (), buckets=(1.0, 2.0))
+        h.observe(1.0)
+        assert h.bucket_counts()[1.0] == 1
+
+    def test_inc_and_set_rejected(self):
+        h = Histogram("lat_seconds", "h", ("c",))
+        with pytest.raises(TypeError):
+            h.labels("a").inc()
+        with pytest.raises(TypeError):
+            h.labels("a").set(1.0)
+
+    def test_observe_on_counter_rejected(self):
+        c = Counter("x_total", "c", ("l",))
+        with pytest.raises(TypeError):
+            c.labels("a").observe(1.0)
+
+    def test_default_buckets_sorted_unique(self):
+        assert list(DEFAULT_BUCKETS) == sorted(set(DEFAULT_BUCKETS))
+
+
+class TestRegistryDuplicates:
+    def test_identical_reregistration_returns_existing(self):
+        r = Registry()
+        a = r.counter("x_total", "help", labels=("l",))
+        b = r.counter("x_total", "help", labels=("l",))
+        assert a is b
+        assert len(r.families()) == 1
+
+    def test_conflicting_kind_raises(self):
+        r = Registry()
+        r.counter("dup_metric", "help")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("dup_metric", "help")
+
+    def test_conflicting_labels_raise(self):
+        r = Registry()
+        r.gauge("g", "help", labels=("a",))
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("g", "help", labels=("b",))
+
+    def test_conflicting_histogram_buckets_raise(self):
+        r = Registry()
+        r.histogram("h_seconds", "help", buckets=(1.0,))
+        with pytest.raises(ValueError, match="already registered"):
+            r.histogram("h_seconds", "help", buckets=(2.0,))
+
+    def test_labeled_gauge_set_function_rejected(self):
+        r = Registry()
+        g = r.gauge("g", "help", labels=("l",))
+        with pytest.raises(ValueError, match="unlabeled"):
+            g.set_function(lambda: 1.0)
+
+    def test_unlabeled_gauge_set_function_renders(self):
+        r = Registry()
+        g = r.gauge("g", "help")
+        g.set_function(lambda: 42.0)
+        assert "g 42" in r.render()
+
+
+class TestExpositionRoundTrip:
+    def test_registry_with_all_kinds_parses_strictly(self):
+        r = Registry()
+        c = r.counter("requests_total", "Total requests", labels=("code",))
+        c.labels("200").inc(3)
+        c.labels("500").inc()
+        g = r.gauge("depth", "Queue depth")
+        g.set(7)
+        h = r.histogram("lat_seconds", "Latency", labels=("op",),
+                        buckets=(0.1, 1.0))
+        h.labels("get").observe(0.05)
+        h.labels("get").observe(0.5)
+        h.labels("put").observe(9.0)
+
+        fams = parse_exposition(r.render())
+        assert set(fams) == {"requests_total", "depth", "lat_seconds"}
+        assert fams["requests_total"]["type"] == "counter"
+        assert fams["requests_total"]["samples"][
+            ("requests_total", (("code", "200"),))] == 3
+        assert fams["depth"]["samples"][("depth", ())] == 7
+        assert fams["lat_seconds"]["type"] == "histogram"
+        assert fams["lat_seconds"]["samples"][
+            ("lat_seconds_bucket", (("le", "0.1"), ("op", "get")))] == 1
+        assert fams["lat_seconds"]["samples"][
+            ("lat_seconds_count", (("op", "put"),))] == 1
+
+    def test_parser_rejects_duplicate_family(self):
+        bad = ("# HELP x h\n# TYPE x counter\nx 1\n"
+               "# HELP x h\n# TYPE x counter\nx 2\n")
+        with pytest.raises(AssertionError, match="duplicate # HELP"):
+            parse_exposition(bad)
+
+    def test_parser_rejects_noncumulative_histogram(self):
+        bad = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+               "h_sum 1\nh_count 3\n")
+        with pytest.raises(AssertionError, match="not cumulative"):
+            parse_exposition(bad)
+
+
+class TestFullStackScrape:
+    """Acceptance: the combined NotebookMetrics + Manager exposition is a
+    valid single scrape with reconcile-time histogram buckets for BOTH
+    controllers."""
+
+    def _env(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+        from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+        from kubeflow_tpu.odh.controller import setup_odh_controllers
+        from kubeflow_tpu.utils.clock import FakeClock
+        from kubeflow_tpu.utils.config import CoreConfig, OdhConfig
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node", allocatable={"cpu": "64",
+                                                  "memory": "256Gi"})
+        mgr = Manager(api, clock=FakeClock())
+        metrics = NotebookMetrics(api, manager=mgr)
+        setup_core_controllers(mgr, CoreConfig(), metrics)
+        setup_odh_controllers(mgr, OdhConfig(controller_namespace="odh"))
+        return api, mgr, metrics
+
+    def test_reconcile_histograms_for_both_controllers(self):
+        from kubeflow_tpu.api.types import Notebook
+
+        api, mgr, metrics = self._env()
+        api.create(Notebook.new("obs-nb", "user1").obj)
+        mgr.run_until_idle()
+
+        text = metrics.scrape()
+        fams = parse_exposition(text)
+        assert fams["controller_runtime_reconcile_time_seconds"]["type"] \
+            == "histogram"
+        samples = fams["controller_runtime_reconcile_time_seconds"]["samples"]
+        for controller in ("notebook", "odh-notebook"):
+            key = ("controller_runtime_reconcile_time_seconds_bucket",
+                   (("controller", controller), ("le", "+Inf")))
+            assert samples[key] >= 1, f"no reconcile histogram for {controller}"
+        # result-labeled totals and workqueue duration histograms ride along
+        assert fams["controller_runtime_reconcile_total"]["type"] == "counter"
+        assert fams["workqueue_queue_duration_seconds"]["type"] == "histogram"
+        assert fams["workqueue_work_duration_seconds"]["type"] == "histogram"
+        assert mgr.reconcile_total.value("notebook", "success") >= 1
+
+    def test_notebook_ready_histogram_observed_once(self):
+        from kubeflow_tpu.api.types import Notebook
+
+        api, mgr, metrics = self._env()
+        api.create(Notebook.new("rdy-nb", "user1").obj)
+        mgr.run_until_idle()
+        assert metrics.notebook_ready_seconds.count_value("user1") == 1
+        # further reconciles must not re-observe an already-ready notebook
+        nb = api.get("Notebook", "user1", "rdy-nb")
+        nb.metadata.labels["touch"] = "1"
+        api.update(nb)
+        mgr.run_until_idle()
+        assert metrics.notebook_ready_seconds.count_value("user1") == 1
+
+    def test_retry_and_error_totals_are_monotonic_counters(self):
+        """The satellite fix: scrape-fed *_total families are counters fed
+        by deltas — two scrapes must not double-count."""
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.kube import ApiServer, KubeObject, Manager, ObjectMeta
+        from kubeflow_tpu.utils.clock import FakeClock
+
+        class Failing:
+            def reconcile(self, req):
+                raise RuntimeError("boom")
+
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+        mgr.register("nb", Failing(), for_kind="Notebook", max_retries=2)
+        api.create(KubeObject(api_version="v1", kind="Notebook",
+                              metadata=ObjectMeta(name="x", namespace="d")))
+        mgr.run_until_idle()
+        metrics = NotebookMetrics(api, manager=mgr)
+        first = metrics.scrape()
+        second = metrics.scrape()
+        fams = parse_exposition(second)
+        assert fams["workqueue_retries_total"]["type"] == "counter"
+        assert fams["reconcile_errors_total"]["type"] == "counter"
+        key = ("workqueue_retries_total", (("controller", "nb"),))
+        assert parse_exposition(first)["workqueue_retries_total"][
+            "samples"][key] == 2
+        assert fams["workqueue_retries_total"]["samples"][key] == 2
+        assert fams["reconcile_errors_total"]["samples"][
+            ("reconcile_errors_total", (("controller", "nb"),))] == 1

@@ -1,0 +1,353 @@
+"""Traced reconciles + trace-correlated logs (the PR-2 tentpole surface).
+
+Covers the manager's per-attempt reconcile root spans (one trace per retry
+chain, attempt numbers as attributes), controller phase child spans
+parenting onto the live reconcile span through the shared context stack,
+fault injections landing as span events on the attempt they hit, the
+structured-JSON log layer's trace_id/span_id injection, and the lint
+gate's metric naming-convention rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import logging as pylog
+
+import pytest
+
+from kubeflow_tpu.kube import (
+    ApiServer,
+    KubeObject,
+    Manager,
+    ObjectMeta,
+    Result,
+)
+from kubeflow_tpu.kube.faults import FaultPlan, FaultRule
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.logging import JsonFormatter, setup_structured_logging
+from kubeflow_tpu.utils.tracing import InMemorySpanExporter, get_tracer
+
+
+@pytest.fixture()
+def exporter():
+    exp = InMemorySpanExporter()
+    tracing.set_exporter(exp)
+    yield exp
+    tracing.set_exporter(None)
+
+
+def mk(kind: str, name: str, namespace: str = "default") -> KubeObject:
+    return KubeObject(api_version="v1", kind=kind,
+                      metadata=ObjectMeta(name=name, namespace=namespace))
+
+
+class TestReconcileSpans:
+    def test_every_attempt_gets_a_root_span_sharing_one_trace(self, exporter):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+
+        class Flaky:
+            calls = 0
+
+            def reconcile(self, req):
+                Flaky.calls += 1
+                if Flaky.calls <= 2:
+                    raise RuntimeError("boom")
+                return Result()
+
+        mgr.register("nb", Flaky(), for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+
+        spans = exporter.find("reconcile")
+        assert len(spans) == 3
+        # one retry chain == one trace; attempts number 1..3
+        assert len({s.trace_id for s in spans}) == 1
+        assert [s.attributes["attempt"] for s in spans] == [1, 2, 3]
+        assert [s.attributes["reconcile.result"] for s in spans] == \
+            ["error", "error", "success"]
+        assert all(s.attributes["controller"] == "nb" for s in spans)
+        assert all(s.attributes["name"] == "nb1" for s in spans)
+        # failed attempts carry the exception as a span event
+        err_events = [e for s in spans[:2] for e in s.events
+                      if e.name == "reconcile.error"]
+        assert len(err_events) == 2
+        assert err_events[0].attributes["exception.type"] == "RuntimeError"
+
+    def test_fresh_event_starts_a_fresh_trace(self, exporter):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+
+        class Ok:
+            def reconcile(self, req):
+                return Result()
+
+        mgr.register("nb", Ok(), for_kind="Notebook")
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        obj = api.get("Notebook", "default", "nb1")
+        obj.metadata.labels["touch"] = "1"
+        api.update(obj)
+        mgr.run_until_idle()
+
+        spans = exporter.find("reconcile")
+        assert len(spans) == 2
+        assert spans[0].trace_id != spans[1].trace_id
+        assert [s.attributes["attempt"] for s in spans] == [1, 1]
+
+    def test_requeue_true_extends_the_trace(self, exporter):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+
+        class Requeuer:
+            calls = 0
+
+            def reconcile(self, req):
+                Requeuer.calls += 1
+                return Result(requeue=Requeuer.calls < 2)
+
+        mgr.register("nb", Requeuer(), for_kind="Notebook")
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        spans = exporter.find("reconcile")
+        assert len(spans) == 2
+        assert spans[0].trace_id == spans[1].trace_id
+        assert spans[0].attributes["reconcile.result"] == "requeue"
+
+    def test_reconcile_total_classifies_outcomes(self):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+
+        class Script:
+            calls = 0
+
+            def reconcile(self, req):
+                Script.calls += 1
+                if Script.calls == 1:
+                    raise RuntimeError("boom")
+                if Script.calls == 2:
+                    return Result(requeue=True)
+                if Script.calls == 3:
+                    return Result(requeue_after=30.0)
+                return Result()
+
+        mgr.register("nb", Script(), for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        mgr.advance(31)
+        assert mgr.reconcile_total.value("nb", "error") == 1
+        assert mgr.reconcile_total.value("nb", "requeue") == 1
+        assert mgr.reconcile_total.value("nb", "requeue_after") == 1
+        assert mgr.reconcile_total.value("nb", "success") == 1
+        assert mgr.reconcile_time.count_value("nb") == 4
+        assert mgr.work_duration.count_value("nb") == 4
+
+    def test_controller_phase_spans_parent_onto_reconcile_root(self, exporter):
+        from kubeflow_tpu.api.types import Notebook
+        from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+        from kubeflow_tpu.kube import FakeCluster
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1", allocatable={"cpu": "64", "memory": "256Gi"})
+        mgr = Manager(api, clock=FakeClock())
+        setup_core_controllers(mgr, CoreConfig())
+        api.create(Notebook.new("traced", "user1").obj)
+        mgr.run_until_idle()
+
+        roots = {s.span_id: s for s in exporter.find("reconcile")}
+        for phase in ("render", "apply", "status"):
+            phase_spans = exporter.find(phase)
+            assert phase_spans, f"no {phase!r} spans exported"
+            for s in phase_spans:
+                assert s.parent is not None and \
+                    s.parent.span_id in roots, f"{phase} span not parented"
+                assert s.trace_id == s.parent.trace_id
+
+    def test_condition_and_ready_events_on_status_span(self, exporter):
+        from kubeflow_tpu.api.types import Notebook
+        from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+        from kubeflow_tpu.kube import FakeCluster
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1", allocatable={"cpu": "64", "memory": "256Gi"})
+        mgr = Manager(api, clock=FakeClock())
+        setup_core_controllers(mgr, CoreConfig())
+        api.create(Notebook.new("evt", "user1").obj)
+        mgr.run_until_idle()
+
+        events = [e for s in exporter.find("status") for e in s.events]
+        names = {e.name for e in events}
+        assert "condition.transition" in names
+        assert "notebook.ready" in names
+
+
+class TestFaultSpanEvents:
+    def test_injected_fault_stamps_the_live_reconcile_span(self, exporter):
+        api = ApiServer()
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+
+        class Getter:
+            def reconcile(self, req):
+                api.get("Notebook", req.namespace, req.name)
+                return Result()
+
+        mgr.register("nb", Getter(), for_kind="Notebook", max_retries=5)
+        plan = FaultPlan([FaultRule(verbs=("get",), kinds=("Notebook",),
+                                    error="unavailable", max_matches=1,
+                                    name="drill")], clock=clock)
+        api.create(mk("Notebook", "nb1"))
+        api.install_fault_plan(plan)
+        mgr.run_until_idle()
+        api.clear_fault_plan()
+
+        assert len(plan.log) == 1
+        rec = plan.log[0]
+        assert rec.span_id and rec.trace_id
+        span = next(s for s in exporter.find("reconcile")
+                    if s.span_id == rec.span_id)
+        assert span.attributes["controller"] == "nb"
+        fault_events = [e for e in span.events if e.name == "fault.injected"]
+        assert len(fault_events) == 1
+        assert fault_events[0].attributes["fault.action"] == \
+            "error:unavailable"
+        assert fault_events[0].attributes["fault.verb"] == "get"
+        assert fault_events[0].attributes["fault.seq"] == rec.seq
+        # the faulted attempt errored; the retry succeeded on the SAME trace
+        spans = [s for s in exporter.find("reconcile")
+                 if s.trace_id == rec.trace_id]
+        assert len(spans) == 2
+        assert spans[0].attributes["reconcile.result"] == "error"
+        assert spans[1].attributes["reconcile.result"] == "success"
+
+    def test_fault_inside_phase_child_lands_on_root_span(self, exporter):
+        """A fault hitting an ApiServer call made inside a controller phase
+        child span must stamp the reconcile ROOT, not the child."""
+        api = ApiServer()
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        tracer = get_tracer("test.phase")
+
+        class Phased:
+            def reconcile(self, req):
+                with tracer.start_span("inner-phase"):
+                    api.list("Pod", namespace=req.namespace)
+                return Result()
+
+        mgr.register("nb", Phased(), for_kind="Notebook", max_retries=5)
+        plan = FaultPlan([FaultRule(verbs=("list",), kinds=("Pod",),
+                                    latency_s=0.25, max_matches=1)],
+                         clock=clock)
+        api.create(mk("Notebook", "nb1"))
+        api.install_fault_plan(plan)
+        mgr.run_until_idle()
+        api.clear_fault_plan()
+
+        assert len(plan.log) == 1
+        rec = plan.log[0]
+        root = next(s for s in exporter.find("reconcile")
+                    if s.span_id == rec.span_id)
+        assert [e.name for e in root.events] == ["fault.injected"]
+        inner = exporter.find("inner-phase")[0]
+        assert not inner.events
+        assert inner.parent.span_id == root.span_id
+        # injected latency advanced the manager clock inside the attempt,
+        # so the reconcile-time histogram saw it deterministically
+        assert mgr.reconcile_time.sum_value("nb") == pytest.approx(0.25)
+
+
+class TestStructuredLogging:
+    def test_log_lines_inside_a_span_carry_trace_ids(self, exporter):
+        formatter = JsonFormatter()
+        record = pylog.LogRecord("kubeflow_tpu.core", pylog.INFO, __file__,
+                                 1, "culling notebook %s/%s", ("ns", "nb"),
+                                 None)
+        with get_tracer("t").start_span("reconcile") as span:
+            line = formatter.format(record)
+        data = json.loads(line)
+        assert data["msg"] == "culling notebook ns/nb"
+        assert data["level"] == "info"
+        assert data["logger"] == "kubeflow_tpu.core"
+        assert data["trace_id"] == span.trace_id
+        assert data["span_id"] == span.span_id
+
+    def test_log_lines_outside_spans_omit_trace_ids(self):
+        formatter = JsonFormatter()
+        record = pylog.LogRecord("x", pylog.WARNING, __file__, 1, "m", (),
+                                 None)
+        data = json.loads(formatter.format(record))
+        assert "trace_id" not in data and "span_id" not in data
+        assert data["level"] == "warning"
+
+    def test_extra_fields_and_exceptions_serialize(self):
+        formatter = JsonFormatter()
+        try:
+            raise ValueError("nope")
+        except ValueError:
+            import sys
+
+            record = pylog.LogRecord("x", pylog.ERROR, __file__, 1,
+                                     "failed", (), sys.exc_info())
+        record.namespace = "user1"
+        data = json.loads(formatter.format(record))
+        assert data["namespace"] == "user1"
+        assert "ValueError: nope" in data["exc"]
+
+    def test_setup_structured_logging_emits_parseable_lines(self):
+        stream = io.StringIO()
+        root = pylog.getLogger()
+        saved_handlers = list(root.handlers)
+        saved_level = root.level
+        try:
+            setup_structured_logging(pylog.INFO, stream=stream)
+            pylog.getLogger("kubeflow_tpu.test").info(
+                "hello %d", 7, extra={"controller": "nb"})
+        finally:
+            for h in list(root.handlers):
+                root.removeHandler(h)
+            for h in saved_handlers:
+                root.addHandler(h)
+            root.setLevel(saved_level)
+        data = json.loads(stream.getvalue().strip())
+        assert data["msg"] == "hello 7"
+        assert data["controller"] == "nb"
+        assert data["logger"] == "kubeflow_tpu.test"
+
+
+class TestMetricNamingLint:
+    def _problems(self, src: str):
+        import importlib.util
+        from pathlib import Path
+
+        spec = importlib.util.spec_from_file_location(
+            "ci_lint", Path(__file__).parent.parent / "ci" / "lint.py")
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+        return lint.check_metric_names(ast.parse(src))
+
+    def test_total_suffix_requires_counter(self):
+        problems = self._problems(
+            "reg.gauge('workqueue_retries_total', 'h')\n")
+        assert len(problems) == 1
+        assert "_total" in problems[0][1]
+
+    def test_seconds_suffix_rejects_counter(self):
+        problems = self._problems("reg.counter('reconcile_seconds', 'h')\n")
+        assert len(problems) == 1
+
+    def test_conforming_registrations_pass(self):
+        src = (
+            "reg.counter('x_total', 'h')\n"
+            "reg.counter('cpu_seconds_total', 'h')\n"
+            "reg.gauge('depth', 'h')\n"
+            "reg.histogram('lat_seconds', 'h')\n"
+            "reg.gauge('last_backoff_seconds', 'h')\n"
+        )
+        assert self._problems(src) == []
