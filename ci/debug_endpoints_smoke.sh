@@ -1,0 +1,93 @@
+#!/usr/bin/env bash
+# Debug-surface smoke: boot the standalone manager (demo mode, so real
+# reconciles run and the flight recorder has attempts), then exercise the
+# operator introspection path end-to-end over real HTTP:
+#   - /debug/reconciles returns recorded attempts with results/durations,
+#   - /debug/workqueue returns the per-item queue view,
+#   - /metrics negotiated as OpenMetrics carries exemplars context and the
+#     spec-required `# EOF` terminator (and still serves classic
+#     Prometheus text without the Accept header),
+#   - an exemplar/recorded trace id resolves on /debug/traces/<id>.
+# Wired into ci/run_tests.sh (controlplane lane).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${DEBUG_SMOKE_PORT:-18479}"
+
+python -m kubeflow_tpu.main --metrics-addr "$PORT" --webhook-port -1 \
+  --demo --run-seconds 60 >/dev/null 2>&1 &
+MGR_PID=$!
+cleanup() {
+  kill "$MGR_PID" 2>/dev/null || true
+}
+trap cleanup EXIT
+
+python - "$PORT" <<'EOF'
+import json
+import sys
+import time
+import urllib.request
+
+port = sys.argv[1]
+base = f"http://127.0.0.1:{port}"
+
+
+def get(path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), \
+            resp.read().decode()
+
+
+# wait until the manager has reconciled the demo notebook
+deadline = time.time() + 30
+while True:
+    try:
+        _, _, body = get("/debug/reconciles")
+        snap = json.loads(body)
+        if snap["recorded_total"] > 0:
+            break
+    except Exception:
+        pass
+    if time.time() > deadline:
+        raise SystemExit("manager never recorded a reconcile attempt")
+    time.sleep(0.25)
+
+attempts = snap["attempts"]
+assert attempts, snap
+for a in attempts:
+    assert a["result"] in ("success", "error", "requeue", "requeue_after"), a
+    assert a["duration_s"] >= 0.0 and a["trace_id"], a
+print(f"debug smoke: {snap['recorded_total']} attempts recorded, "
+      f"{len(snap['objects'])} objects")
+
+# per-object filter returns only that object's history
+key = attempts[-1]["object"]
+_, _, body = get(f"/debug/reconciles?object={key}")
+per_obj = json.loads(body)
+assert per_obj["attempts"], per_obj
+assert all(a["object"] == key for a in per_obj["attempts"])
+
+# a recorded trace resolves with its span tree
+status, _, body = get(f"/debug/traces/{attempts[-1]['trace_id']}")
+trace = json.loads(body)
+assert status == 200 and trace["spans"], trace
+
+status, _, body = get("/debug/workqueue")
+wq = json.loads(body)
+assert status == 200
+assert "queued" in wq and "delayed" in wq and "retries" in wq, wq
+
+# content negotiation: OpenMetrics on request, Prometheus text otherwise
+status, ctype, body = get(
+    "/metrics", headers={"Accept": "application/openmetrics-text"})
+assert status == 200 and "application/openmetrics-text" in ctype, ctype
+assert body.rstrip().endswith("# EOF"), body[-200:]
+assert "# TYPE controller_runtime_reconcile_time_seconds histogram" in body
+
+status, ctype, body = get("/metrics")
+assert status == 200 and ctype.startswith("text/plain"), ctype
+assert "# EOF" not in body
+print("debug smoke: OK (/debug/reconciles, /debug/traces, "
+      "/debug/workqueue, OpenMetrics negotiation)")
+EOF

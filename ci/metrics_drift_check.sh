@@ -45,7 +45,21 @@ with open(out, "w") as f:
     f.write(body)
 EOF
 
-grep '^# TYPE ' "$SCRAPE" | awk '{print $3" "$4}' | sort > "$FAMILIES"
+# control-plane families from the live scrape + data-plane families from
+# the runtime StepTimer registry (register_step_metrics imports without
+# jax, so this inventory is cheap and runs everywhere)
+{
+  grep '^# TYPE ' "$SCRAPE" | awk '{print $3" "$4}'
+  python - <<'EOF'
+from kubeflow_tpu.runtime.metrics import register_step_metrics
+from kubeflow_tpu.utils.metrics import Registry
+
+reg = Registry()
+register_step_metrics(reg)
+for name, kind in reg.families():
+    print(name, kind)
+EOF
+} | sort > "$FAMILIES"
 
 if [[ "${1:-}" == "--update" ]]; then
   cp "$FAMILIES" "$GOLDEN"

@@ -70,4 +70,8 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   # metric-family inventory vs the committed golden list — renames/removals
   # fail here instead of silently breaking dashboards
   bash ci/metrics_drift_check.sh
+  # boot the standalone manager and drive the operator debug surface
+  # (/debug/reconciles, /debug/workqueue, OpenMetrics negotiation) over
+  # real HTTP — the flight-recorder path users actually hit
+  bash ci/debug_endpoints_smoke.sh
 fi

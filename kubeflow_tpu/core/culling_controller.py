@@ -87,7 +87,8 @@ class CullingReconciler:
         # trace shows whether an idle notebook was culled, held for a
         # checkpoint, or found active again
         with _TRACER.start_span(
-            "culling", {"namespace": req.namespace, "notebook": req.name}
+            "culling", {"phase": "culling", "namespace": req.namespace,
+                        "notebook": req.name}
         ) as span:
             # probe Jupyter outside the retry loop (:163-169)
             kernels = self.jupyter.get_kernels(req.name, req.namespace)

@@ -108,7 +108,7 @@ class NotebookMetrics:
             counter.labels(label).inc(total)
         self._counter_snapshots[key] = float(total)
 
-    def scrape(self) -> str:
+    def scrape(self, openmetrics: bool = False) -> str:
         """List-based scrape (metrics.go:82-99): recompute gauges from the
         live StatefulSet set, then render."""
         running_notebooks: dict[str, set[str]] = {}  # ns -> notebook names
@@ -153,18 +153,21 @@ class NotebookMetrics:
                     stats["last_backoff_s"].get(name, 0.0))
                 self._feed_counter(self.reconcile_errors_total, name,
                                    stats["errors_total"].get(name, 0))
-        return self.render()
+        return self.render(openmetrics=openmetrics)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         """Full exposition: this registry plus the attached manager's
         reconcile/workqueue registry (controller_runtime_reconcile_*,
         workqueue_*_duration_seconds) as one scrape body.  Families are
         disjoint between the two registries, so the combined text stays a
-        valid single exposition."""
-        text = self.registry.render()
+        valid single exposition.  The OpenMetrics variant carries bucket
+        exemplars and ends with the spec-required `# EOF` terminator."""
+        text = self.registry.render(openmetrics=openmetrics)
         mgr_registry = getattr(self.manager, "metrics_registry", None)
         if mgr_registry is not None:
-            text += mgr_registry.render()
+            text += mgr_registry.render(openmetrics=openmetrics)
+        if openmetrics:
+            text += "# EOF\n"
         return text
 
     def families(self) -> list[tuple[str, str]]:

@@ -78,7 +78,8 @@ class NotebookReconciler:
         )
 
         # StatefulSets (one per slice; one total for CPU notebooks)
-        with _TRACER.start_span("render") as render_span:
+        with _TRACER.start_span("render",
+                                {"phase": "render"}) as render_span:
             desired_sets = generate_statefulsets(nb, self.cfg)
             render_span.set_attribute("statefulsets", len(desired_sets))
         existing = [
@@ -108,7 +109,7 @@ class NotebookReconciler:
         # and re-raise so the manager's backoff retries the whole set; the
         # per-slice writes themselves are idempotent.
         errors: list[Exception] = []
-        with _TRACER.start_span("apply") as apply_span:
+        with _TRACER.start_span("apply", {"phase": "apply"}) as apply_span:
             self._apply_workload(
                 nb, obj, req, desired_sets, existing, existing_by_name,
                 existing_by_slice, slice_of, live_names, matched_live, errors)
@@ -230,7 +231,7 @@ class NotebookReconciler:
                     pass
 
     def _update_status(self, nb: Notebook, live_names: list[str]) -> None:
-        with _TRACER.start_span("status") as span:
+        with _TRACER.start_span("status", {"phase": "status"}) as span:
             self._compute_and_write_status(nb, live_names, span)
 
     def _compute_and_write_status(self, nb: Notebook, live_names: list[str],
@@ -363,8 +364,13 @@ class NotebookReconciler:
         first_seen = self._first_seen.setdefault(key, self.clock.now())
         if ready >= expected_hosts and expected_hosts > 0 \
                 and key not in self._ready_observed:
+            # exemplar the readiness latency with the attempt's trace: the
+            # scrape's fat readiness bucket points at the reconcile that
+            # finally turned the notebook Ready
+            tid = span.trace_id
             self.metrics.notebook_ready_seconds.labels(nb.namespace).observe(
-                self.clock.now() - first_seen)
+                self.clock.now() - first_seen,
+                exemplar={"trace_id": tid} if tid else None)
             self._ready_observed.add(key)
             self._first_seen.pop(key, None)
             span.add_event("notebook.ready", {"seconds":

@@ -167,6 +167,9 @@ class _Informer:
     # synthesize DELETED events for objects that vanished while the watch
     # was down (client-go's DeletedFinalStateUnknown)
     known: dict[tuple[str, str], KubeObject] = field(default_factory=dict)
+    # set once the initial list completed (client-go HasSynced): readiness
+    # gates on every informer reaching this point
+    synced: threading.Event = field(default_factory=threading.Event)
 
 
 class KubeClient:
@@ -385,6 +388,14 @@ class KubeClient:
             self._informers[kind] = inf
             inf.thread.start()
 
+    def informers_synced(self) -> bool:
+        """True once every started informer finished its initial list
+        (cache.WaitForCacheSync); False with no informers running — a
+        manager that never started its event sources is not ready."""
+        if not self._informers:
+            return False
+        return all(inf.synced.is_set() for inf in self._informers.values())
+
     def stop_informers(self) -> None:
         for inf in self._informers.values():
             inf.stop.set()
@@ -437,6 +448,7 @@ class KubeClient:
                     if key not in fresh:
                         self._dispatch(WatchEvent(EventType.DELETED, gone))
                 inf.known = fresh
+                inf.synced.set()
                 while not inf.stop.is_set():
                     rv = self._watch_stream(info, rv, inf)
             except GoneError:

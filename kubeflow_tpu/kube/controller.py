@@ -22,6 +22,7 @@ from typing import Callable, Optional, Protocol
 
 from ..utils import tracing
 from ..utils.clock import Clock
+from ..utils.flightrecorder import FlightRecorder
 from ..utils.metrics import Registry
 from .errors import GoneError
 from .meta import KubeObject
@@ -258,9 +259,13 @@ class Manager:
     """
 
     def __init__(self, api: ApiServer, clock: Optional[Clock] = None,
-                 rate_limiter=None, registry: Optional[Registry] = None) -> None:
+                 rate_limiter=None, registry: Optional[Registry] = None,
+                 flight_recorder: Optional[FlightRecorder] = None) -> None:
         self.api = api
         self.clock = clock or Clock()
+        # bounded in-process history of completed reconcile attempts, fed
+        # with each attempt's finished root span (/debug/reconciles reads it)
+        self.flight_recorder = flight_recorder or FlightRecorder()
         self._limiter = rate_limiter or default_rate_limiter(self.clock)
         self._registrations: list[_Registration] = []
         self._lock = threading.Lock()
@@ -303,6 +308,7 @@ class Manager:
         self._trace_ids: dict[tuple[str, Request], str] = {}
         self._attempt_seq: dict[tuple[str, Request], int] = {}
         self._stop = threading.Event()
+        self._started = False
         self._thread: Optional[threading.Thread] = None
         if hasattr(api, "subscribe"):
             # in-memory ApiServer: a resumable session that survives
@@ -429,8 +435,13 @@ class Manager:
             self._queued.discard(key)
             enqueued_at = self._enqueued_at.pop(key, None)
         if enqueued_at is not None:
+            # a retry's queue wait belongs to its live retry chain: exemplar
+            # the observation with that trace so a fat queue-duration bucket
+            # links straight to the backoff timeline that caused it
+            tid = self._trace_ids.get(key, "")
             self.queue_duration.labels(key[0]).observe(
-                max(self.clock.now() - enqueued_at, 0.0))
+                max(self.clock.now() - enqueued_at, 0.0),
+                exemplar={"trace_id": tid} if tid else None)
         return key
 
     def _promote_delayed(self) -> None:
@@ -470,6 +481,7 @@ class Manager:
         self._attempt_seq[item] = attempt
         start = self.clock.now()
         outcome = "error"
+        root_span: Optional[tracing.Span] = None
         try:
             with _TRACER.start_span(
                 "reconcile",
@@ -481,6 +493,7 @@ class Manager:
                 },
                 trace_id=self._trace_ids.get(item, ""),
             ) as span:
+                root_span = span
                 if span.recording and item not in self._trace_ids:
                     self._trace_ids[item] = span.trace_id
                 try:
@@ -545,9 +558,22 @@ class Manager:
                         self._clear_request_trace(item)
         finally:
             duration = max(self.clock.now() - start, 0.0)
-            self.reconcile_time.labels(reg_name).observe(duration)
-            self.work_duration.labels(reg_name).observe(duration)
+            # exemplar the duration histograms with this attempt's trace so
+            # an OpenMetrics scrape can pivot from a latency bucket to the
+            # recorded trace (/debug/traces/<trace_id>)
+            ex = ({"trace_id": root_span.trace_id}
+                  if root_span is not None and root_span.trace_id else None)
+            self.reconcile_time.labels(reg_name).observe(duration,
+                                                         exemplar=ex)
+            self.work_duration.labels(reg_name).observe(duration,
+                                                        exemplar=ex)
             self.reconcile_total.labels(reg_name, outcome).inc()
+            if root_span is not None:
+                try:
+                    self.flight_recorder.record(root_span)
+                except Exception:  # noqa: BLE001 — observability must
+                    # never take the reconcile loop down with it
+                    logger.exception("flight recorder rejected a span")
         return True
 
     def _clear_request_trace(self, item: tuple[str, Request]) -> None:
@@ -670,9 +696,63 @@ class Manager:
                 "controllers": [r.name for r in self._registrations],
             }
 
+    def workqueue_debug(self) -> dict:
+        """Per-item workqueue introspection for /debug/workqueue: the live
+        queue (with enqueue timestamps), every delayed item with its due
+        deadline and whether it is a retry backoff or a requeue_after
+        schedule, and per-item retry counts — the view queue_stats()
+        aggregates away."""
+        def obj(req: Request) -> str:
+            return f"{req.namespace}/{req.name}"
+
+        with self._lock:
+            now = self.clock.now()
+            return {
+                "now": now,
+                "controllers": [r.name for r in self._registrations],
+                "queued": [
+                    {"controller": k[0], "object": obj(k[1]),
+                     "queued_for_s": max(
+                         now - self._enqueued_at.get(k, now), 0.0)}
+                    for k in self._queue
+                ],
+                "delayed": [
+                    {"controller": d.reg_name, "object": obj(d.request),
+                     "due_at": d.due, "due_in_s": max(d.due - now, 0.0),
+                     "retry": d.retry}
+                    for d in sorted(self._delayed)
+                ],
+                "retries": [
+                    {"controller": k[0], "object": obj(k[1]), "count": v}
+                    for k, v in sorted(self._retries.items(),
+                                       key=lambda kv: -kv[1])
+                ],
+                "depth": len(self._queue),
+                "backoff_pending": sum(1 for d in self._delayed if d.retry),
+            }
+
     @property
     def dropped_errors(self) -> list[tuple[str, Request, BaseException]]:
         return list(self._errors)
+
+    # -- readiness ------------------------------------------------------------
+    @property
+    def started(self) -> bool:
+        """True once start() launched the worker loop (readiness gate —
+        liveness is `not stopped`, see main.py /healthz vs /readyz)."""
+        return self._started
+
+    def caches_synced(self) -> bool:
+        """Whether the event sources backing the workqueue are live: the
+        in-memory watch session is connected (it reconnects lazily after an
+        injected drop), or — on a real-cluster backend — every informer
+        finished its initial list (client-go WaitForCacheSync analog)."""
+        if self._watch_session is not None:
+            return self._watch_session.connected
+        synced = getattr(self.api, "informers_synced", None)
+        if callable(synced):
+            return bool(synced())
+        return True
 
     # -- standalone threaded mode ---------------------------------------------
     def start(self, poll_interval_s: float = 0.05) -> None:
@@ -692,6 +772,7 @@ class Manager:
 
         self._thread = threading.Thread(target=loop, daemon=True, name="kube-manager")
         self._thread.start()
+        self._started = True
 
     def stop(self) -> None:
         self._stop.set()

@@ -106,11 +106,13 @@ class FaultRule:
 class FaultRecord:
     """One injected fault, for post-hoc assertions.
 
-    When a span exporter is installed, `trace_id`/`span_id` identify the
-    live reconcile span the fault hit (empty when the fault fired outside
-    any span, or tracing is noop) and `seq` is the fault's index in
-    `plan.log` — the same value stamped on the span event, so a soak can
-    pair every log entry with exactly one span event."""
+    `trace_id`/`span_id` identify the live reconcile root span the fault
+    hit — spans always record in-process (utils/tracing.py), so the ids are
+    populated with or without an exporter installed and empty only when the
+    fault fired outside any span.  `seq` is the fault's index in `plan.log`
+    — the same value stamped on the span event, so a soak can pair every
+    log entry with exactly one span event, and the flight recorder can
+    attribute each fault to the attempt it hit."""
 
     rule: str
     action: str

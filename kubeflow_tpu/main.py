@@ -39,38 +39,156 @@ from .kube import ApiServer, FakeCluster, LeaderElector, Manager
 from .utils.config import CoreConfig, OdhConfig
 
 
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def negotiate_metrics_format(accept: str) -> bool:
+    """True when the Accept header asks for OpenMetrics.  Proper media-range
+    parsing with q-values: Prometheus sends
+    `application/openmetrics-text;version=1.0.0;q=0.5,text/plain;q=0.3`
+    and expects the exemplar-capable format to win; a plain curl (Accept
+    `*/*` or absent) gets the classic text format."""
+    q_om, q_plain = 0.0, 0.0
+    for part in (accept or "").split(","):
+        bits = part.split(";")
+        media = bits[0].strip().lower()
+        q = 1.0
+        for param in bits[1:]:
+            param = param.strip()
+            if param.startswith("q="):
+                try:
+                    q = float(param[2:])
+                except ValueError:
+                    q = 0.0
+        if media == "application/openmetrics-text":
+            q_om = max(q_om, q)
+        elif media in ("text/plain", "text/*", "*/*"):
+            q_plain = max(q_plain, q)
+    return q_om > 0 and q_om >= q_plain
+
+
 class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
-    """healthz/readyz ping handlers + Prometheus /metrics
-    (main.go:125-133, metrics on :8080)."""
+    """Probe + scrape + debug surface (main.go:125-133, metrics on :8080):
+
+    - /healthz  — liveness: process up and the manager not stopped;
+    - /readyz   — readiness: additionally the manager STARTED, its
+      watch/informer caches synced, and (when leader election is on) this
+      replica actually leading — a follower pod is alive but must not
+      receive traffic;
+    - /metrics  — content-negotiated: OpenMetrics (exemplars + `# EOF`)
+      when the scraper asks for it, Prometheus text 0.0.4 otherwise;
+    - /debug/reconciles, /debug/traces/<id>, /debug/workqueue — the flight
+      recorder and workqueue introspection, loopback-only (same rationale
+      as /state: diagnosis happens via `kubectl exec`/port-forward, and
+      trace payloads carry object names and error strings that must not be
+      scrapeable from off-pod);
+    - /state    — in-memory store dump (includes Secret data; additionally
+      gated on --expose-state)."""
 
     manager: Optional[Manager] = None
     metrics: Optional[NotebookMetrics] = None
+    elector = None  # LeaderElector when --enable-leader-election
     expose_state: bool = False  # /state dumps Secrets — loopback/debug only
 
+    def _loopback_only(self) -> bool:
+        """True when the request may see debug payloads: the TCP peer is a
+        loopback address (pod-local exec / port-forward lands here)."""
+        host = self.client_address[0]
+        return host in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+
+    def _not_ready(self) -> str:
+        """Empty string when ready to serve traffic, else the reason."""
+        mgr = self.manager
+        if mgr is None:
+            return "no manager"
+        if mgr.stopped:
+            return "manager stopped"
+        if not mgr.started:
+            return "manager not started"
+        if not mgr.caches_synced():
+            return "caches not synced"
+        if self.elector is not None and not self.elector.is_leader:
+            return "not the leader"
+        return ""
+
     def do_GET(self):  # noqa: N802  (stdlib API)
-        if self.path in ("/healthz", "/readyz"):
-            # a stopped manager (TLS-profile restart, fatal error) must fail
-            # probes so the Deployment actually restarts the pod
+        import urllib.parse
+
+        url = urllib.parse.urlsplit(self.path)
+        path = url.path
+        if path == "/healthz":
+            # liveness only: a stopped manager (TLS-profile restart, fatal
+            # error) must fail so the Deployment actually restarts the pod,
+            # but an unsynced follower is perfectly alive
             if self.manager is not None and self.manager.stopped:
                 self._respond(503, "manager stopped", "text/plain")
             else:
                 self._respond(200, "ok", "text/plain")
-        elif self.path == "/metrics":
+        elif path == "/readyz":
+            reason = self._not_ready()
+            if reason:
+                self._respond(503, f"not ready: {reason}", "text/plain")
+            else:
+                self._respond(200, "ok", "text/plain")
+        elif path == "/metrics":
             # scrape() recomputes list-derived gauges and folds in the
             # manager's reconcile/workqueue registry; a bare render() would
             # serve stale gauges and miss the controller_runtime_* families
+            openmetrics = negotiate_metrics_format(
+                self.headers.get("Accept", ""))
             if self.metrics is not None:
-                body = self.metrics.scrape()
+                body = self.metrics.scrape(openmetrics=openmetrics)
             else:
-                body = ""
-            self._respond(200, body, "text/plain; version=0.0.4")
-        elif self.path == "/state" and self.expose_state:
+                body = "# EOF\n" if openmetrics else ""
+            self._respond(200, body,
+                          OPENMETRICS_CONTENT_TYPE if openmetrics
+                          else PROMETHEUS_CONTENT_TYPE)
+        elif path.startswith("/debug/"):
+            if not self._loopback_only():
+                self._respond(403, "debug endpoints are loopback-only",
+                              "text/plain")
+                return
+            self._serve_debug(path, urllib.parse.parse_qs(url.query))
+        elif path == "/state" and self.expose_state:
+            if not self._loopback_only():
+                self._respond(403, "/state is loopback-only", "text/plain")
+                return
             api = self.manager.api if self.manager else None
             # the real-cluster KubeClient has no dump(); only the in-memory
             # store can be exported
             dump = getattr(api, "dump", None)
             body = json.dumps(dump() if callable(dump) else {}, default=str)
             self._respond(200, body, "application/json")
+        else:
+            self._respond(404, "not found", "text/plain")
+
+    def _serve_debug(self, path: str, query: dict) -> None:
+        mgr = self.manager
+        if mgr is None:
+            self._respond(503, "no manager", "text/plain")
+            return
+        recorder = mgr.flight_recorder
+        if path == "/debug/reconciles":
+            object_key = (query.get("object") or [None])[0]
+            body = recorder.snapshot(object_key=object_key)
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
+        elif path.startswith("/debug/traces/"):
+            trace_id = path[len("/debug/traces/"):]
+            trace = recorder.trace(trace_id)
+            if trace is None:
+                self._respond(404, json.dumps(
+                    {"error": f"trace {trace_id!r} not recorded "
+                     "(unknown, or evicted from the bounded trace store)"}),
+                    "application/json")
+            else:
+                self._respond(200, json.dumps(trace, default=str),
+                              "application/json")
+        elif path == "/debug/workqueue":
+            self._respond(200, json.dumps(mgr.workqueue_debug(), default=str),
+                          "application/json")
         else:
             self._respond(404, "not found", "text/plain")
 
@@ -87,15 +205,17 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
 
 
 def serve_http(port: int, manager: Manager, metrics: NotebookMetrics,
-               expose_state: bool = False):
+               expose_state: bool = False, elector=None):
     """Health + metrics on all interfaces (the kubelet probes the pod IP and
     Prometheus scrapes :8080 from outside the pod, as in the reference).
-    The /state debug dump — which includes Secret data — is served only when
-    `expose_state` is set (--expose-state, standalone/demo use)."""
+    The /debug/* introspection endpoints answer only loopback clients, and
+    the /state debug dump — which includes Secret data — additionally needs
+    `expose_state` (--expose-state, standalone/demo use)."""
     handler = type(
         "Handler",
         (HealthAndMetricsHandler,),
-        {"manager": manager, "metrics": metrics, "expose_state": expose_state},
+        {"manager": manager, "metrics": metrics, "elector": elector,
+         "expose_state": expose_state},
     )
     server = http.server.ThreadingHTTPServer(("0.0.0.0", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -276,8 +396,22 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.expose_state and real:
         logging.warning("--expose-state ignored with a real cluster backend "
                         "(the KubeClient has no store to dump; /state stays 404)")
+    # the elector is built before the HTTP server so /readyz can gate on
+    # leadership (a follower is alive but not ready); it starts later
+    elector: Optional[LeaderElector] = None
+    if args.enable_leader_election:
+        from .utils.config import OdhConfig as _Odh
+
+        elector = LeaderElector(
+            api,
+            lease_name="kubeflow-tpu-notebook-controller",
+            namespace=args.leader_election_namespace
+            or _Odh.from_env().controller_namespace,
+            identity=f"{socket.gethostname()}-{os.getpid()}",
+        )
     server = serve_http(args.metrics_addr, mgr, metrics,
-                        expose_state=args.expose_state and not real)
+                        expose_state=args.expose_state and not real,
+                        elector=elector)
     webhook_server = start_webhook_server(api, args) if real else None
     wire_server = None
     if args.serve_api >= 0 and real:
@@ -312,17 +446,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         mgr.start()
         logging.info("manager started; metrics on :%d", args.metrics_addr)
 
-    elector: Optional[LeaderElector] = None
-    if args.enable_leader_election:
-        from .utils.config import OdhConfig as _Odh
-
-        elector = LeaderElector(
-            api,
-            lease_name="kubeflow-tpu-notebook-controller",
-            namespace=args.leader_election_namespace
-            or _Odh.from_env().controller_namespace,
-            identity=f"{socket.gethostname()}-{os.getpid()}",
-        )
+    if elector is not None:
         elector.start_background(
             on_started=start_reconciling,
             on_stopped=mgr.stop,  # lost lease -> exit 1 -> pod restart

@@ -78,7 +78,8 @@ class OpenshiftNotebookReconciler:
         if self._ensure_finalizers(nb):
             return Result(requeue=True)
 
-        with _TRACER.start_span("cert_trust") as ct_span:
+        with _TRACER.start_span("cert_trust",
+                                {"phase": "cert_trust"}) as ct_span:
             ca_bundle.create_notebook_cert_configmap(self.api, nb)
             if ca_bundle.is_configmap_deleted(self.api, nb):
                 ct_span.add_event("cert_trust.source_configmap_deleted")
@@ -98,7 +99,8 @@ class OpenshiftNotebookReconciler:
             except Exception as err:
                 logger.warning("elyra secret reconcile failed: %s", err)
 
-        with _TRACER.start_span("routing") as routing_span:
+        with _TRACER.start_span("routing",
+                                {"phase": "routing"}) as routing_span:
             auth_mode = self._auth_enabled(nb)
             routing_span.set_attribute("auth_enabled", auth_mode)
             # ReferenceGrant before HTTPRoutes (notebook_controller.go:427-433)
@@ -110,7 +112,7 @@ class OpenshiftNotebookReconciler:
                     self.api, nb, self.cfg.controller_namespace,
                     is_auth_mode=True
                 )
-                with _TRACER.start_span("auth"):
+                with _TRACER.start_span("auth", {"phase": "auth"}):
                     auth.reconcile_auth_resources(self.api, nb)
                 routing.reconcile_httproute(
                     self.api,
@@ -125,7 +127,7 @@ class OpenshiftNotebookReconciler:
                     self.api, nb, self.cfg.controller_namespace,
                     is_auth_mode=False
                 )
-                with _TRACER.start_span("auth"):
+                with _TRACER.start_span("auth", {"phase": "auth"}):
                     auth.cleanup_cluster_role_binding(self.api, nb)
                 routing.reconcile_httproute(
                     self.api,

@@ -2,22 +2,33 @@
 
 The north-star metrics from BASELINE.md are measured here (the control-plane
 Prometheus metrics live in core/metrics.py; this is the data-plane side,
-exported in Prometheus text format so the same scrape infra picks both up).
+exported through the same `utils.metrics.Registry` so both planes share one
+exposition format, HELP/TYPE metadata, the ci/lint.py naming rule, and the
+ci/metrics_drift_check.sh family inventory).
+
+`jax` is imported lazily (hbm_usage_bytes) so the family inventory and the
+StepTimer's timing logic are usable from control-plane tooling — the drift
+check registers the families without touching an accelerator, and tests
+drive the timer off an injected monotonic clock instead of
+time.perf_counter.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
-import jax
+from ..utils.metrics import Histogram, Registry
 
-from ..models.configs import TransformerConfig
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..models.configs import TransformerConfig
 
 
 def hbm_usage_bytes() -> dict[str, int]:
     """Per-device HBM in use (0s on backends without memory_stats)."""
+    import jax
+
     usage = {}
     for dev in jax.local_devices():
         stats = getattr(dev, "memory_stats", lambda: None)() or {}
@@ -25,25 +36,76 @@ def hbm_usage_bytes() -> dict[str, int]:
     return usage
 
 
+# train steps span ~ms (tiny models, microbatches) to minutes (large-model
+# accumulation); DefaultBuckets tops out at 10s, too short for the tail
+STEP_TIME_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def register_step_metrics(registry: Registry) -> dict:
+    """Register the data-plane training families on `registry` and return
+    them by short name.  Idempotent (the Registry returns the existing
+    family on identical re-registration); ci/metrics_drift_check.sh calls
+    this to fold the data-plane inventory into the golden list."""
+    return {
+        "step_duration": registry.histogram(
+            "notebook_training_step_duration_seconds",
+            "Distribution of synced train-step wall time",
+            buckets=STEP_TIME_BUCKETS),
+        "tokens_per_second": registry.gauge(
+            "notebook_training_tokens_per_second",
+            "Rolling training throughput over the step window"),
+        "mfu_ratio": registry.gauge(
+            "notebook_training_mfu_ratio",
+            "Rolling model FLOPs utilization (0-1) over the step window"),
+        "hbm_bytes_in_use": registry.gauge(
+            "notebook_training_hbm_bytes_in_use",
+            "HBM bytes in use across local devices"),
+    }
+
+
 @dataclass
 class StepTimer:
-    """Rolling train-step telemetry; call `observe()` once per synced step."""
+    """Rolling train-step telemetry; call `observe()` once per synced step.
 
-    config: TransformerConfig
+    Timing reads `time_fn` — a monotonic-seconds callable, perf_counter by
+    default — so tests inject a fake (FakeClock.now works) and assert exact
+    step times and histogram buckets.  Every family lives in `registry`
+    (own one by default; pass a shared Registry to co-expose with other
+    metrics): step time is a real Histogram, and the derived gauges
+    (throughput, MFU, HBM) recompute lazily at scrape time."""
+
+    config: "TransformerConfig"
     batch: int
     seq_len: int
     num_chips: int
     accelerator: str = "v5e"
     window: int = 20
+    registry: Optional[Registry] = None
+    time_fn: Callable[[], float] = time.perf_counter
     _times: list[float] = field(default_factory=list)
     _last: Optional[float] = None
 
+    def __post_init__(self) -> None:
+        if self.registry is None:
+            self.registry = Registry()
+        m = register_step_metrics(self.registry)
+        self._step_hist: Histogram = m["step_duration"]
+        # derived values recompute at collect()/render() time, so a scrape
+        # is always current without observe() having to push gauges
+        m["tokens_per_second"].set_function(lambda: self.tokens_per_s)
+        m["mfu_ratio"].set_function(lambda: self.mfu)
+        m["hbm_bytes_in_use"].set_function(
+            lambda: float(sum(hbm_usage_bytes().values())))
+
     def observe(self) -> None:
-        now = time.perf_counter()
+        now = self.time_fn()
         if self._last is not None:
-            self._times.append(now - self._last)
+            dt = now - self._last
+            self._times.append(dt)
             if len(self._times) > self.window:
                 self._times.pop(0)
+            self._step_hist.observe(dt)
         self._last = now
 
     @property
@@ -76,11 +138,6 @@ class StepTimer:
         }
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition the workbench image can serve on /metrics."""
-        r = self.report()
-        lines = []
-        for key, value in r.items():
-            name = f"notebook_training_{key}"
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {value}")
-        return "\n".join(lines) + "\n"
+        """Prometheus exposition the workbench image can serve on /metrics
+        — full HELP/TYPE metadata from the shared Registry."""
+        return self.registry.render()

@@ -1,0 +1,267 @@
+"""Bounded, queryable flight recorder for reconcile attempts.
+
+PR 2 made every reconcile attempt a traced span with fault events — but
+fire-and-forget: once exported (or dropped by the noop exporter) nothing
+in the pod remembers it, so "why was notebook X slow to become Ready an
+hour ago" needs an external trace backend the standalone/demo mode does
+not have.  Production notebook platforms answer exactly these questions
+from recent per-session history (NotebookOS, arXiv:2503.20591;
+ElasticNotebook, arXiv:2309.11083).  This module keeps that history
+in-process, bounded, and queryable:
+
+  - a ring buffer of the last `capacity` completed attempt summaries
+    (object key, controller, result, total + per-phase durations pulled
+    from the span tree, trace id, error text, injected-fault events);
+  - a capped per-object history, so one hot object cannot evict every
+    other object's recent past from the queryable view;
+  - separate retained sets for the SLOWEST and ERRORED attempts — the
+    attempts an operator actually asks about — which survive ring
+    eviction;
+  - a capped trace store (span trees by trace id) backing
+    `/debug/traces/<trace_id>` and OpenMetrics exemplar resolution.
+
+The Manager feeds `record()` with each finished reconcile ROOT span
+(kube/controller.py); spans always record in-process (utils/tracing.py),
+so the recorder works with no exporter installed and is deterministic
+under a FakeClock.  All durations come from span timestamps, which follow
+`tracing.set_clock`.  Everything is O(bounds) memory and lock-guarded —
+the recorder must never be the thing that takes down the control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def span_to_dict(span) -> dict:
+    """Serialize a finished Span (and its children, recursively) to plain
+    JSON-able data for the /debug endpoints."""
+    return {
+        "name": span.name,
+        "trace_id": span.trace_id,
+        "span_id": span.span_id,
+        "parent_span_id": span.parent.span_id if span.parent else "",
+        "start_time": span.start_time,
+        "end_time": span.end_time,
+        "duration_s": max(span.end_time - span.start_time, 0.0),
+        "attributes": dict(span.attributes),
+        "events": [
+            {"name": e.name, "timestamp": e.timestamp,
+             "attributes": dict(e.attributes)}
+            for e in span.events
+        ],
+        "children": [span_to_dict(c) for c in span.children],
+    }
+
+
+def _phase_durations(root) -> dict[str, float]:
+    """Per-phase seconds from the attempt's span tree.  A span counts as a
+    phase when it carries a `phase` attribute (the controllers stamp
+    render/apply/status, cert_trust/routing/auth, culling) or is a direct
+    child of the root; keyed by that attribute (else the span name), with
+    repeated phases summing.  Nested phases (odh's `auth` runs inside
+    `routing`) report their own wall time AND count inside the enclosing
+    phase — phase durations are attributions, not a partition."""
+    out: dict[str, float] = {}
+
+    def visit(span, direct: bool) -> None:
+        for child in span.children:
+            if direct or "phase" in child.attributes:
+                phase = str(child.attributes.get("phase", child.name))
+                out[phase] = out.get(phase, 0.0) + \
+                    max(child.end_time - child.start_time, 0.0)
+            visit(child, False)
+
+    visit(root, True)
+    return out
+
+
+@dataclass
+class AttemptRecord:
+    """One completed reconcile attempt, summarized from its span tree."""
+
+    object_key: str           # "namespace/name"
+    controller: str
+    attempt: int
+    result: str               # success / error / requeue / requeue_after
+    start_time: float
+    end_time: float
+    duration_s: float
+    phases: dict[str, float] = field(default_factory=dict)
+    trace_id: str = ""
+    span_id: str = ""
+    error: str = ""           # "ExceptionType: message" for errored attempts
+    faults: list[dict] = field(default_factory=list)  # fault.injected events
+
+    def to_dict(self) -> dict:
+        return {
+            "object": self.object_key,
+            "controller": self.controller,
+            "attempt": self.attempt,
+            "result": self.result,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "duration_s": self.duration_s,
+            "phases": dict(self.phases),
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "error": self.error,
+            "faults": [dict(f) for f in self.faults],
+        }
+
+
+class FlightRecorder:
+    """Ring buffer + retained sets + trace store; see module docstring.
+
+    Bounds: `capacity` attempts in the ring, `per_object` attempts per
+    object key across at most `max_objects` keys (LRU-evicted),
+    `keep_slowest` / `keep_errored` retained attempts, `keep_traces` span
+    trees (LRU-evicted; a retained attempt whose trace aged out still has
+    its summary — only the span detail is gone)."""
+
+    def __init__(self, capacity: int = 512, per_object: int = 32,
+                 keep_slowest: int = 16, keep_errored: int = 16,
+                 keep_traces: int = 256, max_objects: int = 1024) -> None:
+        self.capacity = capacity
+        self.per_object = per_object
+        self.keep_slowest = keep_slowest
+        self.keep_errored = keep_errored
+        self.keep_traces = keep_traces
+        self.max_objects = max_objects
+        self._lock = threading.Lock()
+        self._ring: deque[AttemptRecord] = deque(maxlen=capacity)
+        self._by_object: "OrderedDict[str, deque[AttemptRecord]]" = \
+            OrderedDict()
+        self._slowest: list[AttemptRecord] = []
+        self._errored: deque[AttemptRecord] = deque(maxlen=keep_errored)
+        # trace_id -> list of attempt root-span trees (one per attempt of
+        # the retry chain), serialized at record time
+        self._traces: "OrderedDict[str, list[dict]]" = OrderedDict()
+        self.recorded_total = 0
+
+    # -- write side (Manager, on root-span completion) ------------------------
+    def record(self, root_span) -> Optional[AttemptRecord]:
+        """Summarize a finished reconcile root span into the recorder.
+        Returns the record (tests introspect it), or None for spans that
+        are not attempt roots."""
+        if root_span is None or not root_span.recording or \
+                root_span.parent is not None:
+            return None
+        attrs = root_span.attributes
+        object_key = "%s/%s" % (attrs.get("namespace", ""),
+                                attrs.get("name", ""))
+        error = ""
+        faults = []
+        for ev in root_span.events:
+            if ev.name == "reconcile.error":
+                error = "%s: %s" % (
+                    ev.attributes.get("exception.type", ""),
+                    ev.attributes.get("exception.message", ""))
+            elif ev.name == "fault.injected":
+                faults.append(dict(ev.attributes))
+        rec = AttemptRecord(
+            object_key=object_key,
+            controller=str(attrs.get("controller", "")),
+            attempt=int(attrs.get("attempt", 0)),
+            result=str(attrs.get("reconcile.result", "unknown")),
+            start_time=root_span.start_time,
+            end_time=root_span.end_time,
+            duration_s=max(root_span.end_time - root_span.start_time, 0.0),
+            phases=_phase_durations(root_span),
+            trace_id=root_span.trace_id,
+            span_id=root_span.span_id,
+            error=error,
+            faults=faults,
+        )
+        tree = span_to_dict(root_span)
+        with self._lock:
+            self.recorded_total += 1
+            self._ring.append(rec)
+            history = self._by_object.get(object_key)
+            if history is None:
+                history = deque(maxlen=self.per_object)
+                self._by_object[object_key] = history
+            history.append(rec)
+            self._by_object.move_to_end(object_key)
+            while len(self._by_object) > self.max_objects:
+                self._by_object.popitem(last=False)
+            if rec.result == "error" or rec.error:
+                self._errored.append(rec)
+            self._slowest.append(rec)
+            self._slowest.sort(key=lambda r: r.duration_s, reverse=True)
+            del self._slowest[self.keep_slowest:]
+            attempts = self._traces.setdefault(rec.trace_id, [])
+            attempts.append(tree)
+            self._traces.move_to_end(rec.trace_id)
+            while len(self._traces) > self.keep_traces:
+                self._traces.popitem(last=False)
+        return rec
+
+    # -- read side (the /debug endpoints, tests) ------------------------------
+    def attempts(self, object_key: Optional[str] = None
+                 ) -> list[AttemptRecord]:
+        """Recorded attempts, oldest first: the ring, or one object's
+        capped history when `object_key` ("ns/name") is given."""
+        with self._lock:
+            if object_key is None:
+                return list(self._ring)
+            return list(self._by_object.get(object_key, ()))
+
+    def slowest(self) -> list[AttemptRecord]:
+        with self._lock:
+            return list(self._slowest)
+
+    def errored(self) -> list[AttemptRecord]:
+        with self._lock:
+            return list(self._errored)
+
+    def trace(self, trace_id: str) -> Optional[dict]:
+        """The recorded span trees of one trace (one root per attempt of
+        the retry chain), or None if unknown / evicted."""
+        with self._lock:
+            attempts = self._traces.get(trace_id)
+            if attempts is None:
+                return None
+            return {"trace_id": trace_id, "attempts": len(attempts),
+                    "spans": [dict(t) for t in attempts]}
+
+    def objects(self) -> dict[str, int]:
+        """Object keys with recorded history -> attempt count retained."""
+        with self._lock:
+            return {k: len(v) for k, v in self._by_object.items()}
+
+    def snapshot(self, object_key: Optional[str] = None) -> dict:
+        """The /debug/reconciles body: bounds, totals, and the requested
+        view (global ring or one object's history) plus retained sets."""
+        with self._lock:
+            view = (list(self._by_object.get(object_key, ()))
+                    if object_key is not None else list(self._ring))
+            return {
+                "recorded_total": self.recorded_total,
+                "bounds": {
+                    "capacity": self.capacity,
+                    "per_object": self.per_object,
+                    "keep_slowest": self.keep_slowest,
+                    "keep_errored": self.keep_errored,
+                    "keep_traces": self.keep_traces,
+                },
+                "object": object_key,
+                "attempts": [r.to_dict() for r in view],
+                "slowest": [r.to_dict() for r in self._slowest],
+                "errored": [r.to_dict() for r in self._errored],
+                "objects": {k: len(v) for k, v in self._by_object.items()},
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._by_object.clear()
+            self._slowest.clear()
+            self._errored.clear()
+            self._traces.clear()
+
+
+__all__ = ["AttemptRecord", "FlightRecorder", "span_to_dict"]

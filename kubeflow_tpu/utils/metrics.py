@@ -8,6 +8,14 @@ series with an `le` label (including the implicit `+Inf`), plus `_sum` and
 `# TYPE` blocks for one family is a scrape error in Prometheus) but returns
 the existing metric on an identical re-registration, so idempotent setup
 paths stay cheap.
+
+Histogram observations may carry an EXEMPLAR — a small label set (e.g.
+{"trace_id": ...}) pinning one concrete observation per bucket — rendered
+only in the OpenMetrics exposition (`render(openmetrics=True)`:
+`name_bucket{le="x"} n # {trace_id="..."} value`, counter families
+declared without their `_total` suffix, `# EOF` appended by the serving
+layer).  That is the metrics→traces pivot: a scrape shows a fat latency
+bucket AND a trace id an operator can open in /debug/traces.
 """
 
 from __future__ import annotations
@@ -39,7 +47,8 @@ class _Metric:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + v
 
-    def _observe(self, key: tuple[str, ...], v: float) -> None:
+    def _observe(self, key: tuple[str, ...], v: float,
+                 exemplar: Optional[dict] = None) -> None:
         raise TypeError(f"{self.name}: observe() requires a histogram")
 
     def value(self, *values: str) -> float:
@@ -57,7 +66,7 @@ class _Metric:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
-    def sample_lines(self) -> list[str]:
+    def sample_lines(self, openmetrics: bool = False) -> list[str]:
         lines = []
         for key, v in sorted(self.collect().items()):
             lines.append(f"{self.name}{self._label_str(key)} {v:g}")
@@ -75,8 +84,8 @@ class _Child:
     def set(self, v: float) -> None:
         self._metric._set(self._key, v)
 
-    def observe(self, v: float) -> None:
-        self._metric._observe(self._key, v)
+    def observe(self, v: float, exemplar: Optional[dict] = None) -> None:
+        self._metric._observe(self._key, v, exemplar)
 
 
 class Counter(_Metric):
@@ -134,24 +143,36 @@ class Histogram(_Metric):
         # key -> per-bucket counts (len(buckets)+1, last is +Inf)
         self._counts: dict[tuple[str, ...], list[int]] = {}
         self._sums: dict[tuple[str, ...], float] = {}
+        # key -> bucket index -> (labels, observed value): the most recent
+        # exemplar per bucket, pinned to the bucket the observation FELL in
+        # so the OpenMetrics invariant (exemplar value <= le) holds
+        self._exemplars: dict[tuple[str, ...],
+                              dict[int, tuple[dict, float]]] = {}
 
     def kind(self) -> str:
         return "histogram"
 
-    def observe(self, v: float) -> None:
-        self._observe((), v)
+    def observe(self, v: float, exemplar: Optional[dict] = None) -> None:
+        self._observe((), v, exemplar)
 
-    def _observe(self, key: tuple[str, ...], v: float) -> None:
+    def _observe(self, key: tuple[str, ...], v: float,
+                 exemplar: Optional[dict] = None) -> None:
         with self._lock:
             counts = self._counts.setdefault(
                 key, [0] * (len(self.buckets) + 1))
+            idx = len(self.buckets)
             for i, bound in enumerate(self.buckets):
                 if v <= bound:
                     counts[i] += 1
+                    idx = i
                     break
             else:
                 counts[-1] += 1
             self._sums[key] = self._sums.get(key, 0.0) + v
+            if exemplar:
+                self._exemplars.setdefault(key, {})[idx] = (
+                    {str(k): str(val) for k, val in exemplar.items()},
+                    float(v))
 
     def _set(self, key: tuple[str, ...], v: float) -> None:
         raise TypeError(f"{self.name}: set() is not valid on a histogram")
@@ -188,24 +209,45 @@ class Histogram(_Metric):
         with self._lock:
             return {k: float(sum(c)) for k, c in self._counts.items()}
 
-    def sample_lines(self) -> list[str]:
+    def exemplar(self, *values: str) -> dict[float, tuple[dict, float]]:
+        """Bucket upper bound -> (labels, observed value) for the stored
+        exemplars of one label set (tests assert on this)."""
+        with self._lock:
+            stored = self._exemplars.get(tuple(values), {})
+            bounds = self.buckets + (float("inf"),)
+            return {bounds[i]: (dict(lbl), v)
+                    for i, (lbl, v) in stored.items()}
+
+    @staticmethod
+    def _exemplar_suffix(ex: Optional[tuple[dict, float]]) -> str:
+        if not ex:
+            return ""
+        labels, v = ex
+        inner = ",".join(f'{k}="{val}"' for k, val in sorted(labels.items()))
+        return " # {%s} %g" % (inner, v)
+
+    def sample_lines(self, openmetrics: bool = False) -> list[str]:
         lines = []
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
+            exemplars = {k: dict(v) for k, v in self._exemplars.items()}
         for key, counts in items:
+            ex = exemplars.get(key, {}) if openmetrics else {}
             running = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 running += c
                 le = 'le="%g"' % bound
                 lines.append(
                     f"{self.name}_bucket"
-                    f"{self._label_str(key, le)} {running}")
+                    f"{self._label_str(key, le)} {running}"
+                    f"{self._exemplar_suffix(ex.get(i))}")
             total = running + counts[-1]
             inf = 'le="+Inf"'
             lines.append(
                 f"{self.name}_bucket"
-                f"{self._label_str(key, inf)} {total}")
+                f"{self._label_str(key, inf)} {total}"
+                f"{self._exemplar_suffix(ex.get(len(self.buckets)))}")
             lines.append(
                 f"{self.name}_sum{self._label_str(key)} "
                 f"{sums.get(key, 0.0):g}")
@@ -274,13 +316,22 @@ class Registry:
         with self._lock:
             return [(m.name, m.kind()) for m in self._metrics]
 
-    def render(self) -> str:
-        """Prometheus text exposition format."""
+    def render(self, openmetrics: bool = False) -> str:
+        """Text exposition.  Default: Prometheus text format 0.0.4.  With
+        `openmetrics=True`: OpenMetrics 1.0 — counter families declared
+        without the `_total` sample suffix, histogram buckets annotated
+        with their stored exemplars.  The `# EOF` terminator is the
+        SERVING layer's job (one per exposition, and this registry may be
+        only part of a combined scrape body)."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
-            lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind()}")
-            lines.extend(m.sample_lines())
+            family = m.name
+            if openmetrics and m.kind() == "counter" and \
+                    family.endswith("_total"):
+                family = family[: -len("_total")]
+            lines.append(f"# HELP {family} {m.help}")
+            lines.append(f"# TYPE {family} {m.kind()}")
+            lines.extend(m.sample_lines(openmetrics=openmetrics))
         return "\n".join(lines) + "\n"

@@ -10,6 +10,15 @@ tests — tracing as a test observability channel — plus an OtlpHttpExporter
 (the OTLP/HTTP JSON protocol, POST {endpoint}/v1/traces) so spans leave the
 process in production: set OTEL_EXPORTER_OTLP_ENDPOINT and the manager
 wires it at startup (setup_exporter_from_env).
+
+Spans are ALWAYS recorded in-process (they feed the reconcile flight
+recorder, utils/flightrecorder.py, which must work in the standalone pod
+with no trace backend at all); whether a finished span LEAVES the process
+is a separate decision made by the installed exporter.  Production export
+is tail-based (TailSampler): the full span tree of an attempt is buffered
+until its root finishes, then exported when the attempt errored or was
+slow, else kept with a small probability — errors and outliers always
+reach the backend while the fast-success firehose stays in-process.
 """
 
 from __future__ import annotations
@@ -19,9 +28,11 @@ import contextvars
 import json
 import logging
 import os
+import random
 import threading
 import time
 import urllib.request
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -63,6 +74,9 @@ class Span:
     # W3C-style ids (hex): all spans of one trace share trace_id
     trace_id: str = ""
     span_id: str = ""
+    # finished child spans, linked by the tracer when each child ends — the
+    # span tree the flight recorder pulls per-phase durations from
+    children: list["Span"] = field(default_factory=list)
 
     def add_event(self, name: str, attributes: Optional[dict] = None) -> None:
         if self.recording:
@@ -136,13 +150,12 @@ class Tracer:
         """Open a span as a child of the context's current span.  For a ROOT
         span (no parent on the stack) `trace_id` pins the trace identity —
         the manager passes the same id for every retry of one reconcile
-        request so its attempts line up on one trace timeline."""
-        # the exporter is resolved per-span, matching the reference's lazily
-        # created tracer whose provider is swapped in by tests
-        exporter = _exporter
-        if exporter is None:
-            yield _NOOP_SPAN
-            return
+        request so its attempts line up on one trace timeline.
+
+        The span is always recorded (the flight recorder consumes the tree
+        even with no exporter installed); it is exported only when an
+        exporter is present, resolved at span END so a TailSampler sees the
+        finished root and can decide for the whole attempt."""
         stack = _SPAN_STACK.get()
         parent = stack[-1] if stack else None
         span = Span(
@@ -160,7 +173,11 @@ class Tracer:
         finally:
             _SPAN_STACK.reset(token)
             span.end_time = _now()
-            exporter.export(span)
+            if parent is not None:
+                parent.children.append(span)
+            exporter = _exporter
+            if exporter is not None:
+                exporter.export(span)
 
 
 def _otlp_value(v) -> dict:
@@ -268,6 +285,105 @@ class OtlpHttpExporter:
         self.flush()
 
 
+class TailSampler:
+    """Tail-based sampling: hold an attempt's spans until its ROOT ends,
+    then export the whole tree or drop it, deciding on what actually
+    happened — the opposite of head sampling, which must guess before the
+    outcome exists.
+
+    Policy (checked in order against the finished root span):
+      - `error`: the root carries ``error=True`` or
+        ``reconcile.result == "error"`` — ALWAYS exported;
+      - `slow`: root duration >= ``slow_threshold_s`` — ALWAYS exported;
+      - `probabilistic`: otherwise kept with ``sample_rate`` probability
+        from a seeded RNG (deterministic for tests), else dropped.
+
+    Child spans buffer per trace id until their root arrives; retries of
+    one request share a trace but run sequentially, so at each root
+    completion the buffer holds exactly that attempt's children.  The
+    buffer is bounded (`max_buffered_traces`, oldest evicted as dropped)
+    so a root that never closes cannot grow memory.  The decision is
+    stamped on the root as the `sampling.decision` attribute."""
+
+    def __init__(self, exporter, slow_threshold_s: float = 1.0,
+                 sample_rate: float = 0.01, seed: int = 0,
+                 max_buffered_traces: int = 4096) -> None:
+        self.exporter = exporter
+        self.slow_threshold_s = slow_threshold_s
+        self.sample_rate = sample_rate
+        self.max_buffered_traces = max_buffered_traces
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._buffer: "OrderedDict[str, list[Span]]" = OrderedDict()
+        self.exported_total = 0
+        self.dropped_total = 0
+        self.decisions: dict[str, int] = {}
+
+    def _decide(self, root: Span) -> str:
+        """Export reason, or '' to drop the attempt's spans."""
+        if root.attributes.get("error") or \
+                root.attributes.get("reconcile.result") == "error":
+            return "error"
+        if root.end_time - root.start_time >= self.slow_threshold_s:
+            return "slow"
+        if self._rng.random() < self.sample_rate:
+            return "probabilistic"
+        return ""
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            if span.parent is not None:
+                bucket = self._buffer.setdefault(span.trace_id, [])
+                bucket.append(span)
+                self._buffer.move_to_end(span.trace_id)
+                while len(self._buffer) > self.max_buffered_traces:
+                    _, evicted = self._buffer.popitem(last=False)
+                    self.dropped_total += len(evicted)
+                return
+            batch = self._buffer.pop(span.trace_id, [])
+            batch.append(span)
+            reason = self._decide(span)
+            if reason:
+                span.attributes["sampling.decision"] = reason
+                self.decisions[reason] = self.decisions.get(reason, 0) + 1
+                self.exported_total += len(batch)
+            else:
+                self.dropped_total += len(batch)
+                return
+        for s in batch:
+            self.exporter.export(s)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "exported_total": self.exported_total,
+                "dropped_total": self.dropped_total,
+                "buffered_traces": len(self._buffer),
+                "decisions": dict(self.decisions),
+                "slow_threshold_s": self.slow_threshold_s,
+                "sample_rate": self.sample_rate,
+            }
+
+    def flush(self) -> None:
+        """Export anything still buffered (roots that never closed — e.g.
+        sampler installed mid-trace), then flush the inner exporter."""
+        with self._lock:
+            leftovers = [s for batch in self._buffer.values() for s in batch]
+            self._buffer.clear()
+            self.exported_total += len(leftovers)
+        for s in leftovers:
+            self.exporter.export(s)
+        inner_flush = getattr(self.exporter, "flush", None)
+        if callable(inner_flush):
+            inner_flush()
+
+    def shutdown(self) -> None:
+        self.flush()
+        inner = getattr(self.exporter, "shutdown", None)
+        if callable(inner):
+            inner()
+
+
 _provider_lock = threading.Lock()
 _exporter = None  # anything with .export(Span)
 
@@ -283,16 +399,36 @@ def set_exporter(exporter) -> None:
 def setup_exporter_from_env(env=None):
     """Install an OtlpHttpExporter when OTEL_EXPORTER_OTLP_ENDPOINT is set
     (the standard OTel env contract; OTEL_SERVICE_NAME optional).  Returns
-    the exporter (caller owns shutdown()) or None."""
+    the installed exporter (caller owns shutdown()) or None.
+
+    Export is tail-sampled by default: errored and slow attempts always
+    leave the process, fast successes with TRACE_TAIL_SAMPLE_RATE
+    probability (default 0.01).  TRACE_TAIL_SLOW_THRESHOLD_S tunes the
+    slow cut (default 1.0s); TRACE_TAIL_SAMPLING=false restores the old
+    export-everything behavior."""
     env = env if env is not None else os.environ
     endpoint = env.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
     if not endpoint:
         return None
     exporter = OtlpHttpExporter(
         endpoint, service_name=env.get("OTEL_SERVICE_NAME", "kubeflow-tpu"))
-    set_exporter(exporter)
-    logger.info("OTLP trace export -> %s", exporter.url)
-    return exporter
+    installed = exporter
+    if env.get("TRACE_TAIL_SAMPLING", "true").lower() not in (
+            "0", "false", "no", "off"):
+        installed = TailSampler(
+            exporter,
+            slow_threshold_s=float(
+                env.get("TRACE_TAIL_SLOW_THRESHOLD_S", "1.0")),
+            sample_rate=float(env.get("TRACE_TAIL_SAMPLE_RATE", "0.01")),
+        )
+        logger.info(
+            "OTLP trace export -> %s (tail-sampled: errors + >%.3fs "
+            "always, else p=%.3f)", exporter.url,
+            installed.slow_threshold_s, installed.sample_rate)
+    else:
+        logger.info("OTLP trace export -> %s (unsampled)", exporter.url)
+    set_exporter(installed)
+    return installed
 
 
 def get_tracer(name: str) -> Tracer:

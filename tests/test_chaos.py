@@ -340,3 +340,165 @@ class TestChaosSoak:
                  r.drop_watch, r.reset_watch_history, r.probability,
                  r.max_matches, r.after)
                 for r in b.rules]
+
+
+class TestFlightRecorderDebugSoak:
+    """PR-3 acceptance: drive a TPU notebook through injected faults under
+    FakeClock, then recover the full history PURELY via the flight recorder
+    and the /debug HTTP endpoints — every attempt's result and duration,
+    the slowest attempt's trace with per-phase spans, every injected fault
+    attributed to the attempt it hit — and prove the telemetry spine around
+    it: OpenMetrics exemplar trace ids resolve to recorded traces, and tail
+    sampling exports ALL errored/slow attempts while dropping the
+    fast-success firehose."""
+
+    def test_post_hoc_diagnosis_via_debug_endpoints(self):
+        import json
+        import re
+        import urllib.error
+        import urllib.request
+
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.kube.faults import FaultPlan, FaultRule
+        from kubeflow_tpu.main import serve_http
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.tracing import InMemorySpanExporter, TailSampler
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api)
+        setup_core_controllers(mgr, CoreConfig(), metrics)
+        setup_odh_controllers(mgr, OdhConfig(controller_namespace=CENTRAL_NS))
+        inner = InMemorySpanExporter()
+        sampler = TailSampler(inner, slow_threshold_s=0.2, sample_rate=0.0,
+                              seed=3)
+        tracing.set_exporter(sampler)
+        tracing.set_clock(clock)
+        server = serve_http(0, mgr, metrics)
+        port = server.server_address[1]
+
+        def get(path, headers=None, ok=(200,)):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    assert resp.status in ok
+                    return resp.read().decode()
+            except urllib.error.HTTPError as err:
+                assert err.code in ok, (path, err.code)
+                return err.read().decode()
+
+        try:
+            nb = Notebook.new("fr", "user1", tpu=TPUSpec("v5e", "4x4"))
+            api.create(nb.obj)
+            mgr.run_until_idle()
+
+            # phase A: two injected 503s on the notebook controller's
+            # StatefulSet list -> two errored attempts, then recovery
+            plan_err = FaultPlan([FaultRule(
+                verbs=("list",), kinds=("StatefulSet",),
+                error="unavailable", max_matches=2, name="err")],
+                clock=clock)
+            api.install_fault_plan(plan_err)
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+            api.clear_fault_plan()
+            assert plan_err.exhausted() and len(plan_err.log) == 2
+
+            # phase B: one 0.5s latency on the Notebook get -> one SLOW
+            # (but successful) attempt, above the 0.2s tail threshold
+            plan_lag = FaultPlan([FaultRule(
+                verbs=("get",), kinds=("Notebook",),
+                latency_s=0.5, max_matches=1, name="lag")], clock=clock)
+            api.install_fault_plan(plan_lag)
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+            api.clear_fault_plan()
+            assert len(plan_lag.log) == 1
+            assert not mgr.dropped_errors
+            assert_steady_state(api, "user1", "fr", 4)
+
+            # -- recover the history purely over the /debug surface -------
+            snap = json.loads(get("/debug/reconciles?object=user1/fr"))
+            attempts = snap["attempts"]
+            assert attempts, "no recorded attempts for user1/fr"
+            for a in attempts:  # every attempt: result + duration
+                assert a["result"] in ("success", "error", "requeue",
+                                       "requeue_after"), a
+                assert a["duration_s"] >= 0.0 and a["trace_id"], a
+
+            # every injected fault is attributed to EXACTLY the attempt
+            # (root span) it hit, carrying the fault's seq
+            everything = json.loads(get("/debug/reconciles"))
+            all_attempts = everything["attempts"]
+            for plan in (plan_err, plan_lag):
+                for rec in plan.log:
+                    owners = [a for a in all_attempts
+                              if a["span_id"] == rec.span_id]
+                    assert len(owners) == 1, rec
+                    a = owners[0]
+                    assert a["trace_id"] == rec.trace_id
+                    assert any(f.get("fault.seq") == rec.seq
+                               for f in a["faults"]), (rec, a)
+                    if rec.action.startswith("error:"):
+                        assert a["result"] == "error" and a["error"], a
+
+            # the two 503s are the ONLY errored attempts, retained
+            errored = everything["errored"]
+            assert len(errored) == 2
+            assert {a["span_id"] for a in errored} == \
+                {rec.span_id for rec in plan_err.log}
+
+            # slowest attempt = the latency-injected one; its trace has the
+            # controller's per-phase spans
+            slowest = everything["slowest"][0]
+            assert slowest["duration_s"] >= 0.5
+            assert slowest["span_id"] == plan_lag.log[0].span_id
+            assert slowest["phases"], slowest
+            trace = json.loads(get(f"/debug/traces/{slowest['trace_id']}"))
+            tree = next(s for s in trace["spans"]
+                        if s["span_id"] == slowest["span_id"])
+            child_names = {c["name"] for c in tree["children"]}
+            assert {"render", "apply", "status"} <= child_names, child_names
+
+            # -- exemplars: the OpenMetrics scrape pivots to recorded
+            # traces ------------------------------------------------------
+            om = get("/metrics",
+                     headers={"Accept": "application/openmetrics-text"})
+            assert om.rstrip().endswith("# EOF")
+            tids = set(re.findall(r'# \{trace_id="([0-9a-f]+)"\}', om))
+            assert tids, "no exemplars in the OpenMetrics scrape"
+            for tid in tids:
+                resolved = json.loads(get(f"/debug/traces/{tid}"))
+                assert resolved["spans"], tid
+
+            # -- tail sampling: all errored + slow exported, fast-success
+            # attempts dropped --------------------------------------------
+            exported_roots = inner.find("reconcile")
+            decisions = [s.attributes["sampling.decision"]
+                         for s in exported_roots]
+            assert sorted(decisions) == ["error", "error", "slow"]
+            assert {s.span_id for s in exported_roots
+                    if s.attributes["sampling.decision"] == "error"} == \
+                {rec.span_id for rec in plan_err.log}
+            slow_root = next(s for s in exported_roots
+                             if s.attributes["sampling.decision"] == "slow")
+            assert slow_root.span_id == plan_lag.log[0].span_id
+            # exported attempts come with their phase children
+            assert inner.find("render") and inner.find("status")
+            # ...while the fast-success majority stayed in-process only
+            recorded = everything["recorded_total"]
+            assert recorded > len(exported_roots) * 3
+            assert sampler.dropped_total > 0
+        finally:
+            api.clear_fault_plan()
+            tracing.set_exporter(None)
+            tracing.set_clock(None)
+            server.shutdown()

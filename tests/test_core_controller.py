@@ -336,3 +336,68 @@ class TestMetricsScrape:
         assert metrics.running.value("user1") == 1
         # chips: 2 slices x 2 hosts x 4 chips
         assert metrics.tpu_chips_requested.value("user1") == 16
+
+
+class TestEventReemissionCoverage:
+    """Satellite coverage for EventReemitReconciler: owned StatefulSet AND
+    Pod events re-emit onto the Notebook exactly once, and the UID dedup
+    window holds across repeated reconciles of the same Event."""
+
+    @staticmethod
+    def _notebook_events(api, ns="user1"):
+        return [
+            e for e in api.list("Event", namespace=ns)
+            if e.body["involvedObject"]["kind"] == "Notebook"
+        ]
+
+    def test_statefulset_event_reemitted_on_notebook(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        from kubeflow_tpu.kube import EventRecorder
+
+        sts = api.get("StatefulSet", "user1", "test-nb")
+        EventRecorder(api, "statefulset-controller").event(
+            sts, "Warning", "FailedCreate", "quota exceeded")
+        mgr.run_until_idle()
+        nb_events = self._notebook_events(api)
+        assert len(nb_events) == 1
+        assert nb_events[0].body["reason"] == "FailedCreate"
+        assert "Reissued from statefulset/test-nb" in \
+            nb_events[0].body["message"]
+
+    def test_reemitted_exactly_once_across_repeated_reconciles(self, env):
+        """Re-reconciling the SAME Event (level-triggered re-delivery,
+        resync, relist) must not re-emit: the UID dedup absorbs it, so the
+        Notebook event count stays 1 (a second emission would bump the
+        recorder's aggregation count)."""
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        from kubeflow_tpu.kube import EventRecorder, Request
+
+        pod = api.get("Pod", "user1", "test-nb-0")
+        src = EventRecorder(api, "kubelet").event(
+            pod, "Warning", "BackOff", "restarting failed container")
+        mgr.run_until_idle()
+        assert len(self._notebook_events(api)) == 1
+
+        # drive the same Event through the reconciler several more times
+        for _ in range(3):
+            mgr.enqueue("event-reemit", Request("user1", src.name))
+            mgr.run_until_idle()
+        nb_events = self._notebook_events(api)
+        assert len(nb_events) == 1
+        assert int(nb_events[0].body.get("count", 1)) == 1
+
+    def test_unowned_object_event_not_reemitted(self, env):
+        api, cluster, mgr, _, _ = env
+        create_nb(api, mgr)
+        from kubeflow_tpu.kube import EventRecorder, KubeObject, ObjectMeta
+
+        # a pod with no notebook-name label: not ours
+        stray = api.create(KubeObject(
+            api_version="v1", kind="Pod",
+            metadata=ObjectMeta(name="stray", namespace="user1")))
+        EventRecorder(api, "kubelet").event(
+            stray, "Warning", "Failed", "image pull error")
+        mgr.run_until_idle()
+        assert self._notebook_events(api) == []

@@ -62,6 +62,14 @@ class TestManagerHTTP:
                     return resp.status, resp.read().decode()
 
             assert get("/healthz")[0] == 200
+            # liveness and readiness are split: the process is alive but
+            # the manager has not started reconciling yet
+            try:
+                get("/readyz")
+                assert False, "/readyz must fail before mgr.start()"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            mgr.start()
             assert get("/readyz")[0] == 200
             status, body = get("/metrics")
             assert status == 200
@@ -73,4 +81,5 @@ class TestManagerHTTP:
         except urllib.error.HTTPError as e:
             assert e.code == 404  # /nope
         finally:
+            mgr.stop()
             server.shutdown()

@@ -1,0 +1,455 @@
+"""Flight recorder, tail-sampled export, and the /debug + /readyz surface
+(the PR-3 tentpole): bounded attempt history assembled from span trees,
+TailSampler policy (errors/slow always exported, fast successes dropped),
+the loopback-gated /debug endpoints over real HTTP, content-negotiated
+/metrics, and the liveness/readiness split."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.kube import ApiServer, KubeObject, Manager, ObjectMeta, Result
+from kubeflow_tpu.main import (
+    HealthAndMetricsHandler,
+    negotiate_metrics_format,
+    serve_http,
+)
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+from kubeflow_tpu.utils.tracing import InMemorySpanExporter, TailSampler, get_tracer
+
+
+@pytest.fixture()
+def clock():
+    c = FakeClock()
+    tracing.set_clock(c)
+    yield c
+    tracing.set_clock(None)
+
+
+def mk(kind: str, name: str, namespace: str = "default") -> KubeObject:
+    return KubeObject(api_version="v1", kind=kind,
+                      metadata=ObjectMeta(name=name, namespace=namespace))
+
+
+def attempt_span(tracer, clock, controller="nb", namespace="ns", name="x",
+                 attempt=1, result="success", phases=(), error=None,
+                 trace_id=""):
+    """Build one finished reconcile root span tree, deterministically."""
+    with tracer.start_span("reconcile", {
+        "controller": controller, "namespace": namespace, "name": name,
+        "attempt": attempt,
+    }, trace_id=trace_id) as root:
+        for phase, seconds in phases:
+            with tracer.start_span(phase, {"phase": phase}):
+                clock.advance(seconds)
+        if error is not None:
+            root.set_attribute("error", True)
+            root.add_event("reconcile.error", {
+                "exception.type": type(error).__name__,
+                "exception.message": str(error)})
+        root.set_attribute("reconcile.result", result)
+    return root
+
+
+class TestFlightRecorder:
+    def test_attempt_summarized_from_span_tree(self, clock):
+        tracer = get_tracer("t")
+        rec = FlightRecorder()
+        root = attempt_span(tracer, clock, phases=[("render", 0.1),
+                                                   ("apply", 0.3),
+                                                   ("status", 0.05)])
+        a = rec.record(root)
+        assert a.object_key == "ns/x"
+        assert a.controller == "nb"
+        assert a.result == "success"
+        assert a.duration_s == pytest.approx(0.45)
+        assert a.phases == {"render": pytest.approx(0.1),
+                            "apply": pytest.approx(0.3),
+                            "status": pytest.approx(0.05)}
+        assert a.trace_id == root.trace_id and a.span_id == root.span_id
+        # spans record with NO exporter installed: the recorder is the
+        # in-process consumer the standalone pod relies on
+        assert tracing._exporter is None
+
+    def test_nested_phase_and_plain_grandchild(self, clock):
+        """A grandchild WITH a phase attribute (odh auth inside routing)
+        counts as its own phase; one without (webhook re-entered inside
+        apply) stays inside its enclosing phase."""
+        tracer = get_tracer("t")
+        rec = FlightRecorder()
+        with tracer.start_span("reconcile", {
+            "controller": "odh", "namespace": "ns", "name": "x",
+            "attempt": 1,
+        }) as root:
+            with tracer.start_span("routing", {"phase": "routing"}):
+                clock.advance(0.1)
+                with tracer.start_span("auth", {"phase": "auth"}):
+                    clock.advance(0.2)
+            with tracer.start_span("apply", {"phase": "apply"}):
+                with tracer.start_span("webhook"):
+                    clock.advance(0.4)
+            root.set_attribute("reconcile.result", "success")
+        a = rec.record(root)
+        assert a.phases["routing"] == pytest.approx(0.3)  # includes auth
+        assert a.phases["auth"] == pytest.approx(0.2)
+        assert a.phases["apply"] == pytest.approx(0.4)
+        assert "webhook" not in a.phases
+
+    def test_error_text_and_fault_attribution(self, clock):
+        tracer = get_tracer("t")
+        rec = FlightRecorder()
+        with tracer.start_span("reconcile", {
+            "controller": "nb", "namespace": "ns", "name": "x", "attempt": 2,
+        }) as root:
+            root.add_event("fault.injected", {"fault.rule": "drill",
+                                              "fault.seq": 7})
+            root.set_attribute("error", True)
+            root.add_event("reconcile.error", {
+                "exception.type": "ServerError",
+                "exception.message": "injected: internal error"})
+            root.set_attribute("reconcile.result", "error")
+        a = rec.record(root)
+        assert a.result == "error"
+        assert a.error == "ServerError: injected: internal error"
+        assert a.faults == [{"fault.rule": "drill", "fault.seq": 7}]
+        assert rec.errored()[-1] is a
+
+    def test_ring_and_per_object_bounds(self, clock):
+        tracer = get_tracer("t")
+        rec = FlightRecorder(capacity=4, per_object=2)
+        for i in range(6):
+            rec.record(attempt_span(tracer, clock, name="a", attempt=i + 1))
+        assert len(rec.attempts()) == 4          # ring evicted the oldest
+        history = rec.attempts("ns/a")
+        assert [r.attempt for r in history] == [5, 6]  # per-object cap
+        assert rec.attempts("ns/missing") == []
+
+    def test_slowest_and_errored_survive_ring_eviction(self, clock):
+        tracer = get_tracer("t")
+        rec = FlightRecorder(capacity=2, keep_slowest=2, keep_errored=2)
+        rec.record(attempt_span(tracer, clock, name="slow",
+                                phases=[("apply", 5.0)]))
+        rec.record(attempt_span(tracer, clock, name="bad", result="error",
+                                error=RuntimeError("boom")))
+        for i in range(4):
+            rec.record(attempt_span(tracer, clock, name=f"fast{i}"))
+        ring_objects = {r.object_key for r in rec.attempts()}
+        assert "ns/slow" not in ring_objects  # evicted from the ring...
+        assert rec.slowest()[0].object_key == "ns/slow"  # ...but retained
+        assert rec.errored()[0].object_key == "ns/bad"
+
+    def test_trace_store_resolves_and_evicts(self, clock):
+        tracer = get_tracer("t")
+        rec = FlightRecorder(keep_traces=1)
+        first = attempt_span(tracer, clock, name="a",
+                             phases=[("render", 0.1)])
+        rec.record(first)
+        got = rec.trace(first.trace_id)
+        assert got is not None and got["attempts"] == 1
+        assert got["spans"][0]["children"][0]["name"] == "render"
+        second = attempt_span(tracer, clock, name="b")
+        rec.record(second)
+        assert rec.trace(first.trace_id) is None  # LRU-evicted
+        assert rec.trace(second.trace_id) is not None
+
+    def test_retry_chain_groups_attempts_under_one_trace(self, clock):
+        tracer = get_tracer("t")
+        rec = FlightRecorder()
+        first = attempt_span(tracer, clock, attempt=1, result="error",
+                             error=RuntimeError("boom"))
+        rec.record(first)
+        rec.record(attempt_span(tracer, clock, attempt=2,
+                                trace_id=first.trace_id))
+        got = rec.trace(first.trace_id)
+        assert got["attempts"] == 2
+        assert [s["attributes"]["attempt"] for s in got["spans"]] == [1, 2]
+
+
+class TestTailSampler:
+    @pytest.fixture()
+    def sampled(self, clock):
+        inner = InMemorySpanExporter()
+        sampler = TailSampler(inner, slow_threshold_s=1.0, sample_rate=0.0)
+        tracing.set_exporter(sampler)
+        yield inner, sampler
+        tracing.set_exporter(None)
+
+    def test_fast_success_dropped_children_included(self, clock, sampled):
+        inner, sampler = sampled
+        tracer = get_tracer("t")
+        attempt_span(tracer, clock, phases=[("apply", 0.1)])
+        assert inner.spans == []
+        assert sampler.dropped_total == 2  # root + child
+        assert sampler.stats()["buffered_traces"] == 0
+
+    def test_errored_attempt_always_exported(self, clock, sampled):
+        inner, sampler = sampled
+        tracer = get_tracer("t")
+        root = attempt_span(tracer, clock, result="error",
+                            error=RuntimeError("boom"),
+                            phases=[("apply", 0.1)])
+        names = [s.name for s in inner.spans]
+        assert names == ["apply", "reconcile"]  # whole tree, child first
+        assert root.attributes["sampling.decision"] == "error"
+        assert sampler.exported_total == 2
+
+    def test_slow_attempt_always_exported(self, clock, sampled):
+        inner, _ = sampled
+        tracer = get_tracer("t")
+        root = attempt_span(tracer, clock, phases=[("apply", 2.0)])
+        assert [s.name for s in inner.spans] == ["apply", "reconcile"]
+        assert root.attributes["sampling.decision"] == "slow"
+
+    def test_probabilistic_keep_is_seeded(self, clock):
+        tracer = get_tracer("t")
+        inner = InMemorySpanExporter()
+        sampler = TailSampler(inner, slow_threshold_s=100.0, sample_rate=0.5,
+                              seed=42)
+        tracing.set_exporter(sampler)
+        try:
+            for _ in range(40):
+                attempt_span(tracer, clock)
+        finally:
+            tracing.set_exporter(None)
+        kept = len(inner.find("reconcile"))
+        assert 0 < kept < 40  # sampled, not all-or-nothing
+        assert sampler.stats()["decisions"] == {"probabilistic": kept}
+
+    def test_buffer_bound_evicts_oldest(self, clock):
+        inner = InMemorySpanExporter()
+        sampler = TailSampler(inner, max_buffered_traces=2)
+        tracer = get_tracer("t")
+        # three distinct traces whose roots never reach the sampler: the
+        # oldest trace's buffered spans are evicted as dropped
+        children = []
+        for i in range(3):
+            with tracer.start_span(f"root{i}"):
+                with tracer.start_span("child") as c:
+                    children.append(c)
+        for c in children:
+            sampler.export(c)
+        assert sampler.stats()["buffered_traces"] == 2
+        assert sampler.dropped_total == 1
+
+    def test_flush_exports_leftovers(self, clock):
+        inner = InMemorySpanExporter()
+        sampler = TailSampler(inner)
+        tracer = get_tracer("t")
+        with tracer.start_span("orphan-parent"):
+            with tracer.start_span("child") as c:
+                pass
+        sampler.export(c)  # child buffered, root never arrives
+        assert inner.spans == []
+        sampler.flush()
+        assert [s.name for s in inner.spans] == ["child"]
+
+
+class TestContentNegotiation:
+    def test_negotiation_matrix(self):
+        nego = negotiate_metrics_format
+        assert nego("application/openmetrics-text") is True
+        assert nego("application/openmetrics-text; version=1.0.0; q=0.9,"
+                    "text/plain;version=0.0.4;q=0.5,*/*;q=0.1") is True
+        assert nego("") is False
+        assert nego("*/*") is False
+        assert nego("text/plain") is False
+        assert nego("application/openmetrics-text;q=0") is False
+        # the scraper explicitly prefers classic text: honor it
+        assert nego("text/plain;q=0.9,"
+                    "application/openmetrics-text;q=0.5") is False
+
+
+class ScriptedReconciler:
+    """error, error, then success PER OBJECT — deterministic retry chains
+    even when several objects interleave on the queue."""
+
+    def __init__(self, failures: int = 2):
+        self.failures = failures
+        self.calls: dict[str, int] = {}
+
+    def reconcile(self, req):
+        n = self.calls.get(req.name, 0) + 1
+        self.calls[req.name] = n
+        if n <= self.failures:
+            raise RuntimeError("boom")
+        return Result()
+
+
+class TestDebugEndpoints:
+    @pytest.fixture()
+    def stack(self, clock):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+
+        api = ApiServer()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api, manager=mgr)
+        server = serve_http(0, mgr, metrics)
+        port = server.server_address[1]
+        yield api, mgr, port
+        server.shutdown()
+
+    @staticmethod
+    def get(port, path, headers=None):
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                     headers=headers or {})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.headers.get("Content-Type", ""), \
+                resp.read().decode()
+
+    def test_reconciles_global_and_filtered(self, stack):
+        api, mgr, port = stack
+        mgr.register("nb", ScriptedReconciler(), for_kind="Notebook",
+                     max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        api.create(mk("Notebook", "nb2"))
+        mgr.run_until_idle()
+
+        _, ctype, body = self.get(port, "/debug/reconciles")
+        assert ctype == "application/json"
+        snap = json.loads(body)
+        assert snap["recorded_total"] == 6  # 3 attempts per object
+        assert {a["object"] for a in snap["attempts"]} == \
+            {"default/nb1", "default/nb2"}
+        assert len(snap["errored"]) == 4
+
+        _, _, body = self.get(port,
+                              "/debug/reconciles?object=default/nb1")
+        per = json.loads(body)
+        assert [a["attempt"] for a in per["attempts"]] == [1, 2, 3]
+        assert [a["result"] for a in per["attempts"]] == \
+            ["error", "error", "success"]
+        assert all(a["duration_s"] >= 0.0 for a in per["attempts"])
+
+    def test_trace_endpoint_resolves_recorded_trace(self, stack):
+        api, mgr, port = stack
+        mgr.register("nb", ScriptedReconciler(), for_kind="Notebook",
+                     max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        _, _, body = self.get(port, "/debug/reconciles?object=default/nb1")
+        tid = json.loads(body)["attempts"][0]["trace_id"]
+        status, _, body = self.get(port, f"/debug/traces/{tid}")
+        trace = json.loads(body)
+        assert status == 200 and trace["attempts"] == 3
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self.get(port, "/debug/traces/ffffffffffffffff")
+        assert err.value.code == 404
+
+    def test_workqueue_debug_shows_backoff_deadlines(self, stack):
+        api, mgr, port = stack
+
+        class AlwaysFails:
+            def reconcile(self, req):
+                raise RuntimeError("nope")
+
+        mgr.register("nb", AlwaysFails(), for_kind="Notebook", max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        # one attempt, no clock advance: the retry sits in backoff
+        mgr.run_until_idle(max_iterations=10_000, advance_clock=False)
+        _, _, body = self.get(port, "/debug/workqueue")
+        wq = json.loads(body)
+        assert wq["backoff_pending"] == 1
+        (delayed,) = wq["delayed"]
+        assert delayed["retry"] is True
+        assert delayed["object"] == "default/nb1"
+        assert delayed["due_at"] > wq["now"]
+        assert wq["retries"] == [
+            {"controller": "nb", "object": "default/nb1", "count": 1}]
+
+    def test_debug_endpoints_are_loopback_only(self, stack, monkeypatch):
+        api, mgr, port = stack
+        monkeypatch.setattr(HealthAndMetricsHandler, "_loopback_only",
+                            lambda self: False)
+        for path in ("/debug/reconciles", "/debug/workqueue",
+                     "/debug/traces/abc"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self.get(port, path)
+            assert err.value.code == 403, path
+
+    def test_metrics_negotiation_over_http(self, stack):
+        api, mgr, port = stack
+        mgr.register("nb", ScriptedReconciler(), for_kind="Notebook",
+                     max_retries=5)
+        api.create(mk("Notebook", "nb1"))
+        mgr.run_until_idle()
+        status, ctype, body = self.get(
+            port, "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert status == 200
+        assert ctype.startswith("application/openmetrics-text")
+        assert body.rstrip().endswith("# EOF")
+        # exemplars on the reconcile-time buckets resolve to recorded traces
+        import re
+
+        tids = set(re.findall(r'# \{trace_id="([0-9a-f]+)"\}', body))
+        assert tids
+        for tid in tids:
+            assert mgr.flight_recorder.trace(tid) is not None, tid
+        # OpenMetrics counters drop the _total suffix from the family decl
+        assert "# TYPE controller_runtime_reconcile counter" in body
+        assert 'controller_runtime_reconcile_total{' in body
+
+        status, ctype, body = self.get(port, "/metrics")
+        assert ctype == "text/plain; version=0.0.4"
+        assert "# EOF" not in body and "# {" not in body
+        assert "# TYPE controller_runtime_reconcile_total counter" in body
+
+
+class TestReadinessSplit:
+    @staticmethod
+    def get_code(port, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+                return resp.status
+        except urllib.error.HTTPError as err:
+            return err.code
+
+    def test_caches_synced_tracks_watch_connection(self):
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+        assert mgr.caches_synced()
+        mgr._watch_session.on_watch_dropped()
+        assert not mgr.caches_synced()
+        mgr.run_until_idle()  # lazy reconnect happens at the next step
+        assert mgr.caches_synced()
+
+    def test_readyz_transitions(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+
+        class StubElector:
+            is_leader = False
+
+        api = ApiServer()
+        mgr = Manager(api, clock=FakeClock())
+        metrics = NotebookMetrics(api, manager=mgr)
+        elector = StubElector()
+        server = serve_http(0, mgr, metrics, elector=elector)
+        port = server.server_address[1]
+        try:
+            # alive but not ready: the manager never started
+            assert self.get_code(port, "/healthz") == 200
+            assert self.get_code(port, "/readyz") == 503
+            mgr.start()
+            # started but a follower: still not ready
+            assert self.get_code(port, "/readyz") == 503
+            elector.is_leader = True
+            assert self.get_code(port, "/readyz") == 200
+            # losing the lease flips readiness without killing liveness
+            elector.is_leader = False
+            assert self.get_code(port, "/readyz") == 503
+            assert self.get_code(port, "/healthz") == 200
+            # a stopped manager fails BOTH (restart the pod)
+            elector.is_leader = True
+            mgr.stop()
+            assert self.get_code(port, "/readyz") == 503
+            assert self.get_code(port, "/healthz") == 503
+        finally:
+            mgr.stop()
+            server.shutdown()

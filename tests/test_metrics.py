@@ -314,3 +314,67 @@ class TestFullStackScrape:
         assert fams["workqueue_retries_total"]["samples"][key] == 2
         assert fams["reconcile_errors_total"]["samples"][
             ("reconcile_errors_total", (("controller", "nb"),))] == 1
+
+
+class TestExemplarsAndOpenMetrics:
+    """Histogram exemplars + the OpenMetrics exposition variant: exemplars
+    render per bucket only in OpenMetrics, counter families drop the
+    `_total` suffix from their HELP/TYPE declaration (samples keep it),
+    and the classic Prometheus text format stays byte-for-byte free of
+    both so existing scrapers never see syntax they cannot parse."""
+
+    def test_exemplar_stored_on_the_falling_bucket(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "h", labels=("c",),
+                          buckets=(0.1, 1.0))
+        h.labels("nb").observe(0.05, exemplar={"trace_id": "aaa"})
+        h.labels("nb").observe(0.5, exemplar={"trace_id": "bbb"})
+        h.labels("nb").observe(5.0, exemplar={"trace_id": "ccc"})
+        ex = h.exemplar("nb")
+        assert ex[0.1] == ({"trace_id": "aaa"}, 0.05)
+        assert ex[1.0] == ({"trace_id": "bbb"}, 0.5)
+        assert ex[float("inf")] == ({"trace_id": "ccc"}, 5.0)
+        # the latest observation per bucket wins
+        h.labels("nb").observe(0.07, exemplar={"trace_id": "ddd"})
+        assert h.exemplar("nb")[0.1] == ({"trace_id": "ddd"}, 0.07)
+
+    def test_openmetrics_render_carries_exemplars(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar={"trace_id": "deadbeef"})
+        om = reg.render(openmetrics=True)
+        assert ('lat_seconds_bucket{le="0.1"} 1 '
+                '# {trace_id="deadbeef"} 0.05') in om
+        # classic text format: no exemplar syntax anywhere
+        prom = reg.render()
+        assert "# {" not in prom
+        assert 'lat_seconds_bucket{le="0.1"} 1' in prom
+
+    def test_openmetrics_counter_family_drops_total_suffix(self):
+        reg = Registry()
+        c = reg.counter("reconcile_total", "total reconciles")
+        c.inc(3)
+        om = reg.render(openmetrics=True)
+        assert "# TYPE reconcile counter" in om
+        assert "# HELP reconcile total reconciles" in om
+        assert "reconcile_total 3" in om
+        prom = reg.render()
+        assert "# TYPE reconcile_total counter" in prom
+
+    def test_observation_without_exemplar_renders_bare(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "h", buckets=(0.1,))
+        h.observe(0.05)
+        om = reg.render(openmetrics=True)
+        assert 'lat_seconds_bucket{le="0.1"} 1\n' in om
+
+    def test_prometheus_render_still_parses_strictly(self):
+        """Exemplar storage must not leak into the 0.0.4 exposition the
+        strict round-trip parser validates."""
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "h", labels=("c",))
+        h.labels("nb").observe(0.003, exemplar={"trace_id": "abc"})
+        reg.counter("ops_total", "t", labels=("c",)).labels("nb").inc()
+        fams = parse_exposition(reg.render())
+        assert fams["lat_seconds"]["type"] == "histogram"
+        _check_histogram_family("lat_seconds", fams["lat_seconds"]["samples"])

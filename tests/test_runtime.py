@@ -85,8 +85,56 @@ class TestStepMetrics:
         assert timer.tokens_per_s == pytest.approx(4 * 128 / 0.1)
         assert 0.0 < timer.mfu < 1e-3  # tiny model, far from peak
         text = timer.prometheus_text()
-        assert "notebook_training_mfu" in text
-        assert "notebook_training_tokens_per_s" in text
+        assert "notebook_training_mfu_ratio" in text
+        assert "notebook_training_tokens_per_second" in text
+        # Registry-rendered exposition: full HELP/TYPE metadata
+        assert "# TYPE notebook_training_step_duration_seconds histogram" \
+            in text
+        assert "# TYPE notebook_training_mfu_ratio gauge" in text
+
+    def test_injectable_clock_feeds_step_histogram(self):
+        """The satellite: timing reads the injected monotonic clock, not
+        time.perf_counter, so step telemetry is exact under a FakeClock."""
+        from kubeflow_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=0.0)
+        timer = StepTimer(TINY, batch=4, seq_len=128, num_chips=1,
+                          time_fn=clock.now)
+        timer.observe()            # arms the timer; no interval yet
+        clock.advance(0.1)
+        timer.observe()
+        clock.advance(0.3)
+        timer.observe()
+        assert timer.step_time_s == pytest.approx(0.2)
+        hist = timer.registry.get("notebook_training_step_duration_seconds")
+        assert hist.count_value() == 2
+        assert hist.sum_value() == pytest.approx(0.4)
+        buckets = hist.bucket_counts()
+        assert buckets[0.1] == 1   # the 0.1s step
+        assert buckets[0.5] == 2   # both by 0.5s
+        assert timer.tokens_per_s == pytest.approx(4 * 128 / 0.2)
+
+    def test_families_shared_registry_and_naming_rule(self):
+        """Families register on a shared Registry (drift-check inventory)
+        and every name passes the ci/lint.py metric-naming conventions."""
+        from kubeflow_tpu.runtime.metrics import register_step_metrics
+        from kubeflow_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        register_step_metrics(reg)
+        fams = dict(reg.families())
+        assert fams["notebook_training_step_duration_seconds"] == "histogram"
+        assert fams["notebook_training_tokens_per_second"] == "gauge"
+        assert fams["notebook_training_mfu_ratio"] == "gauge"
+        assert fams["notebook_training_hbm_bytes_in_use"] == "gauge"
+        # idempotent re-registration (two timers sharing one registry)
+        register_step_metrics(reg)
+        assert len(reg.families()) == 4
+        for name, kind in fams.items():
+            if name.endswith("_total"):
+                assert kind == "counter", name
+            if name.endswith("_seconds"):
+                assert kind in ("histogram", "gauge"), name
 
     def test_hbm_usage_shape(self):
         usage = hbm_usage_bytes()

@@ -97,14 +97,31 @@ def test_export_failure_never_raises():
 
 
 def test_env_setup_noop_without_endpoint():
-    from kubeflow_tpu.utils.tracing import setup_exporter_from_env
+    from kubeflow_tpu.utils.tracing import TailSampler, setup_exporter_from_env
 
     assert setup_exporter_from_env({}) is None
+    # default: the OTLP exporter is wrapped in the tail sampler, with the
+    # policy knobs read from the environment
+    sampler = setup_exporter_from_env(
+        {"OTEL_EXPORTER_OTLP_ENDPOINT": "http://127.0.0.1:1",
+         "OTEL_SERVICE_NAME": "svc-x",
+         "TRACE_TAIL_SLOW_THRESHOLD_S": "2.5",
+         "TRACE_TAIL_SAMPLE_RATE": "0.25"})
+    try:
+        assert isinstance(sampler, TailSampler)
+        assert sampler.slow_threshold_s == 2.5
+        assert sampler.sample_rate == 0.25
+        assert sampler.exporter.service_name == "svc-x"
+        assert sampler.exporter.url.endswith("/v1/traces")
+    finally:
+        sampler.shutdown()
+        tracing.set_exporter(None)
+    # opt-out restores unconditional per-span export
     exporter = setup_exporter_from_env(
         {"OTEL_EXPORTER_OTLP_ENDPOINT": "http://127.0.0.1:1",
-         "OTEL_SERVICE_NAME": "svc-x"})
+         "TRACE_TAIL_SAMPLING": "false"})
     try:
-        assert exporter is not None and exporter.service_name == "svc-x"
+        assert not isinstance(exporter, TailSampler)
         assert exporter.url.endswith("/v1/traces")
     finally:
         exporter.shutdown()
