@@ -1,27 +1,37 @@
 #!/usr/bin/env bash
-# Seeded chaos soak (tests/test_chaos.py::TestChaosSoak): N rounds of
-# random fault plans (kube/faults.py) against a TPU+auth notebook, driven
-# entirely on the FakeClock so wall time stays in seconds regardless of how
-# much backoff the injected faults provoke.
+# Seeded chaos soaks (tests/test_chaos.py::TestChaosSoak +
+# ::TestSliceRecoverySoak): N rounds of random fault plans
+# (kube/faults.py) against a TPU+auth notebook, plus the self-healing
+# recovery soak (seeded worker kills/crashloops under API faults; the
+# engine — not an annotation — must restore sliceHealth=Healthy with
+# slice-atomic restarts only, survive a mid-soak leader failover, and
+# exhaust exactly at the attempt cap on a permanently broken slice).
+# All driven on the FakeClock so wall time stays in seconds regardless of
+# how much backoff the injected faults provoke.
 #
 # The seed is printed up front and on failure — reproduce any run with
-#   CHAOS_SOAK_SEED=<seed> CHAOS_SOAK_ROUNDS=<n> ci/chaos_soak.sh
+#   CHAOS_SOAK_SEED=<seed> CHAOS_SOAK_ROUNDS=<n> \
+#     SELFHEAL_SOAK_ROUNDS=<m> ci/chaos_soak.sh
 # The default seed is date-stable (not time-derived) so CI is
 # deterministic; pass CHAOS_SOAK_SEED=random for an exploratory roll.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
+HEAL_ROUNDS="${SELFHEAL_SOAK_ROUNDS:-16}"
 SEED="${CHAOS_SOAK_SEED:-20260804}"
 if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
-    python -m pytest tests/test_chaos.py::TestChaosSoak -q "$@"; then
+    SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" \
+    python -m pytest tests/test_chaos.py::TestChaosSoak \
+      tests/test_chaos.py::TestSliceRecoverySoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
-  echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} ci/chaos_soak.sh" >&2
+  echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
+  echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} ci/chaos_soak.sh" >&2
   exit 1
 fi
-echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS})"
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS})"

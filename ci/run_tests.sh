@@ -62,9 +62,10 @@ if [[ -n "$LANE" ]]; then
 else
   python -m pytest tests/ -q ${ARGS+"${ARGS[@]}"}
 fi
-# seeded chaos soak at the CI round count (the in-suite run above already
-# did the default 20 rounds; this prints a reproducible seed line and runs
-# a deeper sweep — all FakeClock-driven, seconds of wall time)
+# seeded chaos soaks at the CI round counts (the in-suite run above
+# already did the default rounds; this prints a reproducible seed line
+# and runs a deeper sweep of both the fault soak and the self-healing
+# recovery soak — all FakeClock-driven, seconds of wall time)
 if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   bash ci/chaos_soak.sh
   # metric-family inventory vs the committed golden list — renames/removals

@@ -39,6 +39,10 @@ VERSIONS = ("v1alpha1", "v1beta1", "v1")
 CONDITION_RUNNING = "Running"
 CONDITION_WAITING = "Waiting"
 CONDITION_TERMINATED = "Terminated"
+# TPU extension: terminal verdict of the self-healing engine — the slice
+# spent its restart budget and the controller stopped recovering it
+# (core/selfheal.py); cleared when the slice reads Healthy again
+CONDITION_RECOVERY_EXHAUSTED = "RecoveryExhausted"
 
 
 @dataclass(frozen=True)
@@ -190,9 +194,16 @@ def notebook_status(
     container_state: dict,
     worker_states: Optional[list[dict]] = None,
     slice_health: Optional[str] = None,
+    slice_recovery: Optional[dict] = None,
 ) -> dict:
     """NotebookStatus shape: reference fields (conditions/readyReplicas/
-    containerState, api/v1/notebook_types.go:37-45) + TPU extensions."""
+    containerState, api/v1/notebook_types.go:37-45) + TPU extensions.
+
+    `slice_recovery` is the self-healing engine's crash-safe bookkeeping
+    (status.sliceRecovery, keyed by slice id: restart attempt timestamps,
+    backoff deadline, disruption stamp, exhaustion flag).  It lives on the
+    CR — not in controller memory — so a manager crash or leader failover
+    resumes the restart budget instead of resetting it."""
     status = {
         "conditions": conditions,
         "readyReplicas": ready_replicas,
@@ -202,4 +213,6 @@ def notebook_status(
         status["workerStates"] = worker_states
     if slice_health is not None:
         status["sliceHealth"] = slice_health
+    if slice_recovery:
+        status["sliceRecovery"] = copy.deepcopy(slice_recovery)
     return status

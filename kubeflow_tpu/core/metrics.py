@@ -59,6 +59,24 @@ class NotebookMetrics:
             buckets=(1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
                      1800.0, 3600.0),
         )
+        # self-healing (core/selfheal.py): slice-atomic restarts performed
+        # by the recovery engine, labeled by the disruption classification
+        # (a bounded set — see selfheal.REASON_*), and the
+        # disruption-detected -> slice-Healthy-again latency distribution
+        self.slice_restarts = self.registry.counter(
+            "notebook_slice_restarts_total",
+            "Slice-atomic worker restarts performed by the self-healing "
+            "engine",
+            labels=("namespace", "reason"),
+        )
+        self.disruption_recovery_seconds = self.registry.histogram(
+            "notebook_disruption_recovery_seconds",
+            "Latency from disruption detection to the slice reading "
+            "Healthy again",
+            labels=("namespace",),
+            buckets=(1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+                     1800.0),
+        )
         # workqueue / retry observability (controller-runtime exports the
         # same family: workqueue_depth, workqueue_retries_total) — scraped
         # from Manager.queue_stats() when a manager is attached.  The

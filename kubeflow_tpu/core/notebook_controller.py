@@ -9,10 +9,11 @@ name-collision hazard)."""
 
 from __future__ import annotations
 
+import copy
 import logging
 from typing import Optional
 
-from ..api.types import Notebook, notebook_status
+from ..api.types import CONDITION_RECOVERY_EXHAUSTED, Notebook, notebook_status
 from ..common import reconcilehelper as rh
 from ..kube import (
     ApiServer,
@@ -31,6 +32,7 @@ from ..utils.clock import Clock
 from ..utils.config import CoreConfig
 from . import constants as C
 from .metrics import NotebookMetrics
+from .selfheal import RecoveryEngine, SliceRestartError
 
 logger = logging.getLogger("kubeflow_tpu.core")
 
@@ -53,6 +55,10 @@ class NotebookReconciler:
         self.metrics = metrics
         self.recorder = recorder or EventRecorder(api, "notebook-controller")
         self.clock = clock or Clock()
+        # slice-atomic self-healing: budgeted recovery of disrupted TPU
+        # slices, bookkeeping persisted on the CR (core/selfheal.py)
+        self.recovery = RecoveryEngine(api, cfg, metrics, self.recorder,
+                                       clock=self.clock)
         # first-readiness tracking for the notebook_to_ready_seconds
         # histogram: first-seen clock time per live notebook (keyed by uid
         # so a delete+recreate measures afresh), dropped once observed
@@ -153,12 +159,28 @@ class NotebookReconciler:
         # notebooks restart is slice-atomic: delete every worker pod
         annotations = self.api.get("Notebook", req.namespace, req.name).metadata.annotations
         if annotations.get(C.ANNOTATION_NOTEBOOK_RESTART) == "true":
+            # _restart_pods raises after attempting the whole slice set if
+            # any delete failed — the annotation then survives for the
+            # retry, so a half-restarted slice is never reported restarted
             self._restart_pods(nb, live_names)
             def clear() -> None:
                 live = self.api.get("Notebook", req.namespace, req.name)
                 live.metadata.annotations.pop(C.ANNOTATION_NOTEBOOK_RESTART, None)
                 self.api.update(live)
             retry_on_conflict(clear)
+
+        # self-healing pass: disruption detection + budgeted slice-atomic
+        # recovery.  Runs after the status pass (it keys off the freshly
+        # written slice health and persists bookkeeping over it) and after
+        # the manual restart annotation (an operator-requested restart is
+        # not charged against the recovery budget).
+        requeue_s = self.recovery.maybe_recover(
+            nb, live_names,
+            pods_of=lambda name: self._pods_of(nb, name),
+            restart_slice=lambda name: self._restart_pods(nb, [name]),
+        )
+        if requeue_s > 0:
+            return Result(requeue_after=requeue_s)
         return Result()
 
     def _apply_workload(self, nb, obj, req, desired_sets, existing,
@@ -223,12 +245,25 @@ class NotebookReconciler:
         return self.api.list("Pod", namespace=nb.namespace, label_selector=selector)
 
     def _restart_pods(self, nb: Notebook, live_names: list[str]) -> None:
+        """Slice-atomic worker restart: delete EVERY pod of every named
+        slice, aggregating errors — a transient delete failure mid-loop
+        must not leave the slice partially restarted with the rest
+        untried.  Raises SliceRestartError after the full sweep so the
+        manager's backoff retries the whole set (the deletes are
+        idempotent: an already-gone pod is a NotFound no-op)."""
+        errors: list[Exception] = []
+        attempted = 0
         for live_name in live_names:
             for pod in self._pods_of(nb, live_name):
+                attempted += 1
                 try:
                     self.api.delete("Pod", nb.namespace, pod.name)
                 except NotFoundError:
                     pass
+                except Exception as err:  # noqa: BLE001 — aggregated below
+                    errors.append(err)
+        if errors:
+            raise SliceRestartError(errors, attempted)
 
     def _update_status(self, nb: Notebook, live_names: list[str]) -> None:
         with _TRACER.start_span("status", {"phase": "status"}) as span:
@@ -308,6 +343,16 @@ class NotebookReconciler:
                     container_state = cs.get("state", {})
                     break
 
+        # self-healing state rides the same status object: carry the
+        # RecoveryExhausted condition and the sliceRecovery bookkeeping
+        # forward — this writer rebuilds status from pod state, but the
+        # restart budget must survive every rewrite (the CR is its
+        # crash-safe store; core/selfheal.py owns the mutations)
+        for cond in (nb.status.get("conditions") or []):
+            if cond.get("type") == CONDITION_RECOVERY_EXHAUSTED:
+                conditions.append(copy.deepcopy(cond))
+        slice_recovery = copy.deepcopy(nb.status.get("sliceRecovery"))
+
         slice_health = None
         if tpu is not None:
             stopped = C.STOP_ANNOTATION in nb.metadata.annotations
@@ -331,6 +376,7 @@ class NotebookReconciler:
             container_state=container_state,
             worker_states=worker_states if tpu is not None else None,
             slice_health=slice_health,
+            slice_recovery=slice_recovery,
         )
 
         # transitions as span events: the trace timeline shows WHEN a slice
@@ -485,12 +531,24 @@ def setup_core_controllers(
         name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
         return [Request(pod.namespace, name)] if name else []
 
+    def node_to_requests(node: KubeObject) -> list[Request]:
+        # a node vanishing or flipping unready can strand any multi-host
+        # slice whose workers it carried; re-evaluate every TPU notebook so
+        # the self-healing engine sees node-driven disruption without
+        # waiting for a pod event or resync (cheap: cached list, rare event)
+        return [
+            Request(o.namespace, o.name)
+            for o in api.list("Notebook")
+            if o.spec.get("tpu")
+        ]
+
     mgr.register(
         "notebook",
         rec,
         for_kind="Notebook",
         owns=["StatefulSet", "Service", "VirtualService"],
-        watches=[WatchSpec(kind="Pod", mapper=pod_to_request)],
+        watches=[WatchSpec(kind="Pod", mapper=pod_to_request),
+                 WatchSpec(kind="Node", mapper=node_to_requests)],
     )
     reemit = EventReemitReconciler(api, recorder)
     mgr.register(

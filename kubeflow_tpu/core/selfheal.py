@@ -1,0 +1,460 @@
+"""Slice-atomic self-healing: disruption detection + budgeted recovery.
+
+The status computation has always *named* the failure mode — "partial
+readiness is a degraded slice: collectives hang"
+(notebook_controller._compute_and_write_status) — without acting on it: a
+crashed worker, a preempted TPU node, or a stuck-Pending pod left a
+multi-host notebook wedged until a human intervened.  This module closes
+the loop, in the shape NotebookOS (arXiv:2503.20591) and ElasticNotebook
+(arXiv:2309.11083) argue interactive platforms need:
+
+- `classify_worker` turns the pod state the reconciler already lists into
+  a disruption verdict: pod `Failed`, CrashLoopBackOff (container
+  `waiting.reason`), node-driven deletion/preemption (dangling or unready
+  `spec.nodeName`), or Pending beyond a configurable schedule deadline.
+  Healthy and transient states (Running-not-yet-Ready, a pod
+  mid-recreate, Pending within the deadline) must never trigger recovery.
+
+- `RecoveryEngine` restarts the *entire affected slice* — JAX collectives
+  cannot survive partial membership, so single-pod surgery is never
+  correct — under a restart budget: exponential backoff between attempts
+  (`RECOVERY_BACKOFF_*` knobs on CoreConfig), a capped attempt count
+  within a sliding window, and a terminal `RecoveryExhausted=True`
+  condition (+ Warning event) once the budget is spent, so the controller
+  stops churning a permanently broken slice.
+
+All bookkeeping (per-slice attempt timestamps, last-restart time, backoff
+deadline, disruption stamp, exhaustion flag) is persisted in
+`status.sliceRecovery` on the CR — controller memory holds nothing — so a
+manager crash or leader failover (kube/leader.py) resumes the budget
+instead of resetting it.  The bookkeeping write happens BEFORE the pod
+deletes (write-ahead): a crash mid-restart can lose the restart, never
+the attempt charge.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Callable, Optional
+
+from ..api.types import CONDITION_RECOVERY_EXHAUSTED, Notebook
+from ..kube import (
+    ApiServer,
+    EventRecorder,
+    KubeObject,
+    NotFoundError,
+    retry_on_conflict,
+)
+from ..utils import tracing
+from ..utils.clock import Clock, parse_iso
+from ..utils.config import CoreConfig
+from . import constants as C
+from .metrics import NotebookMetrics
+
+logger = logging.getLogger("kubeflow_tpu.selfheal")
+
+# recovery attempts open a `recover` phase span on the shared context
+# stack, parenting onto the manager's per-attempt reconcile root — the
+# flight recorder then shows recovery time per attempt (/debug/reconciles)
+_TRACER = tracing.get_tracer("kubeflow_tpu.core.selfheal")
+
+# Disruption classifications — a bounded set, because they label
+# notebook_slice_restarts_total{reason}.
+REASON_POD_FAILED = "pod-failed"
+REASON_CRASH_LOOP = "crash-loop"
+REASON_NODE_GONE = "node-gone"
+REASON_PENDING_TIMEOUT = "pending-timeout"
+# transient marker, not yet a disruption: a Pending worker becomes
+# REASON_PENDING_TIMEOUT only once the schedule deadline passes
+PENDING = "pending"
+
+# event reasons (kubectl describe notebook)
+EVENT_SLICE_RECOVERY = "SliceRecovery"
+EVENT_RECOVERY_EXHAUSTED = "RecoveryExhausted"
+EVENT_RECOVERY_RESTORED = "RecoveryRestored"
+
+
+class SliceRestartError(Exception):
+    """Aggregate of per-pod delete failures from a slice-atomic restart.
+
+    Raised only after EVERY pod of the slice has been attempted — a
+    transient error on one worker must not leave the rest of the slice
+    untried, which is exactly the partial-restart state slice-atomicity
+    forbids.  The reconcile fails with this and the manager's backoff
+    retries the whole slice; a half-restarted slice is therefore never
+    reported as recovered."""
+
+    def __init__(self, errors: list[Exception], attempted: int) -> None:
+        self.errors = errors
+        self.attempted = attempted
+        super().__init__(
+            f"slice restart: {len(errors)}/{attempted} pod deletes failed; "
+            f"first: {errors[0]}")
+
+
+def _pod_ready(pod: KubeObject) -> bool:
+    return any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in pod.body.get("status", {}).get("conditions", [])
+    )
+
+
+def classify_worker(pod: KubeObject, api: ApiServer,
+                    node_cache: Optional[dict] = None) -> Optional[str]:
+    """Classify one worker pod from the state the reconciler already sees.
+
+    Returns a REASON_* constant for a disrupted worker, PENDING for a pod
+    that is merely waiting to schedule/start (only the deadline makes that
+    a disruption), or None for healthy and transient states that must NOT
+    trigger recovery.  `node_cache` memoizes Node lookups across one
+    engine pass (a slice's workers usually share few nodes)."""
+    status = pod.body.get("status", {}) or {}
+    if status.get("phase") == "Failed":
+        return REASON_POD_FAILED
+    for cs in status.get("containerStatuses", []) or []:
+        waiting = (cs.get("state") or {}).get("waiting") or {}
+        if waiting.get("reason") == "CrashLoopBackOff":
+            return REASON_CRASH_LOOP
+    node_name = pod.spec.get("nodeName", "")
+    if node_name:
+        if node_cache is not None and node_name in node_cache:
+            node = node_cache[node_name]
+        else:
+            node = api.try_get("Node", "", node_name)
+            if node_cache is not None:
+                node_cache[node_name] = node
+        if node is None:
+            # the node object vanished under the pod: preemption or
+            # scale-down, before the node controller reaped the pod
+            return REASON_NODE_GONE
+        node_ready = any(
+            c.get("type") == "Ready" and c.get("status") == "True"
+            for c in node.body.get("status", {}).get("conditions", [])
+        )
+        if not node_ready:
+            return REASON_NODE_GONE
+    if status.get("phase") == "Pending":
+        return PENDING
+    return None
+
+
+class RecoveryEngine:
+    """Budgeted slice-atomic recovery, driven from the notebook reconcile.
+
+    `maybe_recover` runs after the status pass: it classifies every worker
+    of every slice, and for a disrupted slice either waits out the current
+    backoff (returning a requeue-after hint), restarts the whole slice
+    (write-ahead bookkeeping, then delete every pod), or — once the
+    sliding-window attempt budget is spent — escalates to the terminal
+    RecoveryExhausted condition and stops touching the slice until an
+    operator heals it (at which point the budget resets)."""
+
+    def __init__(
+        self,
+        api: ApiServer,
+        cfg: CoreConfig,
+        metrics: NotebookMetrics,
+        recorder: EventRecorder,
+        clock: Optional[Clock] = None,
+    ) -> None:
+        self.api = api
+        self.cfg = cfg
+        self.metrics = metrics
+        self.recorder = recorder
+        self.clock = clock or Clock()
+
+    # -- entry point ----------------------------------------------------------
+    def maybe_recover(
+        self,
+        nb: Notebook,
+        live_names: list[str],
+        pods_of: Callable[[str], list[KubeObject]],
+        restart_slice: Callable[[str], None],
+    ) -> float:
+        """One recovery pass; returns the requeue-after hint in seconds
+        (0.0 = nothing scheduled).  `live_names` is ordered slice 0 first,
+        as the reconciler builds it; `restart_slice` must delete every pod
+        of the named slice's StatefulSet, aggregating errors
+        (NotebookReconciler._restart_pods)."""
+        tpu = nb.tpu
+        if tpu is None or not self.cfg.enable_self_healing:
+            return 0.0
+        live = self.api.try_get("Notebook", nb.namespace, nb.name)
+        if live is None or live.metadata.deletion_timestamp is not None:
+            return 0.0
+        status = live.body.get("status", {}) or {}
+        recovery = copy.deepcopy(status.get("sliceRecovery") or {})
+        prev_recovery = copy.deepcopy(recovery)
+
+        # Culling precedence: a stop-annotated notebook (slice health
+        # Stopping/Stopped) is being parked on purpose — "recovering" it
+        # would fight the cull pod-for-pod.  Once fully Stopped, stale
+        # bookkeeping (including an exhaustion verdict) is dropped so an
+        # un-culled notebook starts with a fresh budget.
+        if C.STOP_ANNOTATION in live.metadata.annotations or \
+                status.get("sliceHealth") in ("Stopping", "Stopped"):
+            if recovery and status.get("sliceHealth") == "Stopped":
+                self._write_bookkeeping(nb, {})
+            return 0.0
+
+        # -- pass 1: pure detection (no span unless there is work) ------------
+        shape = tpu.shape
+        node_cache: dict[str, Optional[KubeObject]] = {}
+        detections: list[tuple[int, str, list[tuple[str, str]], bool, bool]] = []
+        for idx, live_name in enumerate(live_names):
+            pods = sorted(pods_of(live_name), key=lambda p: p.name)
+            reasons: list[tuple[str, str]] = []
+            pending = False
+            ready = 0
+            for pod in pods:
+                verdict = classify_worker(pod, self.api, node_cache)
+                if verdict == PENDING:
+                    pending = True
+                elif verdict is not None:
+                    reasons.append((pod.name, verdict))
+                if _pod_ready(pod):
+                    ready += 1
+            healthy = not reasons and not pending and ready >= shape.num_hosts
+            detections.append((idx, live_name, reasons, pending, healthy))
+
+        if not recovery and not any(
+                reasons or pending
+                for _, _, reasons, pending, _ in detections):
+            return 0.0
+
+        # -- pass 2: decisions, under the `recover` phase span ----------------
+        now = self.clock.now()
+        requeue = 0.0
+        restarts: list[tuple[int, str, str, str, int, float]] = []
+        events: list[tuple[str, str, str]] = []
+        with _TRACER.start_span(
+            "recover", {"phase": "recover", "namespace": nb.namespace,
+                        "notebook": nb.name}
+        ) as span:
+            for idx, live_name, reasons, pending, healthy in detections:
+                requeue = _merge_requeue(requeue, self._slice_pass(
+                    nb, idx, live_name, reasons, pending, healthy,
+                    recovery, restarts, events, span, now))
+
+            # per-slice passes mutate their state dicts in place; drop
+            # entries that emptied out so the persisted bookkeeping stays
+            # minimal (and the no-op status check stays meaningful)
+            for key in [k for k, s in recovery.items() if not s]:
+                recovery.pop(key)
+            exhausted = sorted(
+                k for k, s in recovery.items() if s.get("exhausted"))
+            if recovery != prev_recovery:
+                # write-ahead: the budget charge must survive a crash
+                # between here and the pod deletes below
+                self._write_bookkeeping(nb, recovery, exhausted)
+            for etype, reason, message in events:
+                self.recorder.event(nb.obj, etype, reason, message)
+
+            for idx, live_name, reason, pod_name, attempt_n, delay in restarts:
+                span.add_event("slice.restart", {
+                    "slice": idx, "sts": live_name, "reason": reason,
+                    "pod": pod_name, "attempt": attempt_n,
+                    "backoff_s": delay,
+                })
+                self.metrics.slice_restarts.labels(
+                    nb.namespace, reason).inc()
+                self.recorder.event(
+                    nb.obj, "Normal", EVENT_SLICE_RECOVERY,
+                    "restarting slice %d (%s): %s is %s (attempt %d/%d, "
+                    "next backoff %.0fs)" % (
+                        idx, live_name, pod_name or "workers", reason,
+                        attempt_n, self.cfg.recovery_max_attempts, delay))
+                restart_slice(live_name)
+        return requeue
+
+    # -- per-slice decision ---------------------------------------------------
+    def _slice_pass(self, nb, idx, live_name, reasons, pending, healthy,
+                    recovery, restarts, events, span, now) -> float:
+        key = str(idx)
+        state = recovery.get(key, {})
+
+        # resolve Pending into a disruption only past the schedule deadline
+        reason = reasons[0][1] if reasons else None
+        pod_name = reasons[0][0] if reasons else ""
+        if reason is None and pending:
+            since = state.get("pendingSince")
+            if not since:
+                state["pendingSince"] = self.clock.now_iso()
+                recovery[key] = state
+                return self.cfg.recovery_pending_deadline_s
+            waited = now - parse_iso(since)
+            if waited < self.cfg.recovery_pending_deadline_s:
+                return self.cfg.recovery_pending_deadline_s - waited
+            reason = REASON_PENDING_TIMEOUT
+        elif not pending:
+            state.pop("pendingSince", None)
+
+        if reason is None:
+            if healthy and state:
+                self._slice_recovered(nb, idx, state, events, span, now)
+                if state:
+                    recovery[key] = state
+                else:
+                    recovery.pop(key, None)
+            elif state:
+                recovery[key] = state  # pendingSince cleanup above
+            return 0.0
+
+        # -- disrupted --------------------------------------------------------
+        span.add_event("slice.disrupted", {
+            "slice": idx, "sts": live_name, "reason": reason,
+            "pod": pod_name,
+        })
+        if state.get("exhausted"):
+            # terminal: the budget is spent; an operator action that turns
+            # the slice Healthy again (e.g. the restart annotation after a
+            # fix) resets it via _slice_recovered
+            recovery[key] = state
+            return 0.0
+        state.setdefault("disruptedAt", self.clock.now_iso())
+        state["reason"] = reason
+        attempts = [t for t in state.get("attempts", [])
+                    if now - parse_iso(t) < self.cfg.recovery_window_s]
+        state["attempts"] = attempts
+
+        until = state.get("backoffUntil")
+        if until and now < parse_iso(until):
+            remaining = parse_iso(until) - now
+            span.add_event("recovery.backoff_wait", {
+                "slice": idx, "remaining_s": remaining})
+            recovery[key] = state
+            return remaining
+
+        if len(attempts) >= self.cfg.recovery_max_attempts:
+            state["exhausted"] = True
+            recovery[key] = state
+            span.add_event("recovery.exhausted", {
+                "slice": idx, "attempts": len(attempts), "reason": reason})
+            events.append((
+                "Warning", EVENT_RECOVERY_EXHAUSTED,
+                "slice %d (%s) spent its restart budget (%d restarts in "
+                "%.0fs) on %s; manual intervention required" % (
+                    idx, live_name, len(attempts),
+                    self.cfg.recovery_window_s, reason)))
+            logger.error(
+                "recovery exhausted for %s/%s slice %d after %d attempts "
+                "(%s)", nb.namespace, nb.name, idx, len(attempts), reason)
+            return 0.0
+
+        delay = min(
+            self.cfg.recovery_backoff_base_s * (2 ** len(attempts)),
+            self.cfg.recovery_backoff_max_s)
+        stamp = self.clock.now_iso()
+        attempts.append(stamp)
+        state["lastRestartTime"] = stamp
+        state["backoffUntil"] = _iso_at(now + delay)
+        recovery[key] = state
+        restarts.append((idx, live_name, reason, pod_name, len(attempts),
+                         delay))
+        return delay
+
+    def _slice_recovered(self, nb, idx, state, events, span, now) -> None:
+        """Disruption over: observe the detection→Healthy latency once and
+        drop the transient fields.  Attempt stamps stay and age out by the
+        sliding window (the flap guard) — except after exhaustion, where a
+        Healthy slice means an operator fixed it and earns a fresh
+        budget."""
+        if state.get("disruptedAt"):
+            duration = max(now - parse_iso(state["disruptedAt"]), 0.0)
+            tid = span.trace_id
+            self.metrics.disruption_recovery_seconds.labels(
+                nb.namespace).observe(
+                    duration, exemplar={"trace_id": tid} if tid else None)
+            span.add_event("recovery.healthy", {
+                "slice": idx, "seconds": duration})
+        if state.pop("exhausted", False):
+            state.pop("attempts", None)
+            state.pop("backoffUntil", None)
+            events.append((
+                "Normal", EVENT_RECOVERY_RESTORED,
+                "slice %d is Healthy again after exhaustion; restart "
+                "budget reset" % idx))
+        # backoffUntil deliberately survives healing: a slice that flaps
+        # (fail -> restart -> Healthy -> fail) must still wait out the
+        # armed backoff before the next restart, or flapping defeats the
+        # exponential spacing; it expires on its own
+        for field in ("disruptedAt", "reason", "pendingSince"):
+            state.pop(field, None)
+        if not state.get("attempts"):
+            state.pop("attempts", None)
+            state.pop("lastRestartTime", None)
+            state.pop("backoffUntil", None)
+
+    # -- persistence ----------------------------------------------------------
+    def _write_bookkeeping(self, nb: Notebook, recovery: dict,
+                           exhausted: Optional[list[str]] = None) -> None:
+        """Persist status.sliceRecovery (and the RecoveryExhausted
+        condition) with conflict retry.  Runs BEFORE any pod delete of the
+        same pass, so the attempt charge is crash-safe."""
+        exhausted = exhausted or []
+
+        def write() -> None:
+            try:
+                live = self.api.get("Notebook", nb.namespace, nb.name)
+            except NotFoundError:
+                return
+            st = live.body.setdefault("status", {})
+            if recovery:
+                st["sliceRecovery"] = copy.deepcopy(recovery)
+            else:
+                st.pop("sliceRecovery", None)
+            conds = list(st.get("conditions") or [])
+            existing = next(
+                (c for c in conds
+                 if c.get("type") == CONDITION_RECOVERY_EXHAUSTED), None)
+            if exhausted:
+                if existing is None or existing.get("status") != "True":
+                    conds = [c for c in conds
+                             if c.get("type") != CONDITION_RECOVERY_EXHAUSTED]
+                    conds.append({
+                        "type": CONDITION_RECOVERY_EXHAUSTED,
+                        "status": "True",
+                        "reason": "RestartBudgetSpent",
+                        "message": "slice(s) %s spent the restart budget "
+                                   "(%d attempts within %.0fs)" % (
+                                       ",".join(exhausted),
+                                       self.cfg.recovery_max_attempts,
+                                       self.cfg.recovery_window_s),
+                        "lastTransitionTime": self.clock.now_iso(),
+                    })
+            elif existing is not None:
+                conds = [c for c in conds
+                         if c.get("type") != CONDITION_RECOVERY_EXHAUSTED]
+            st["conditions"] = conds
+            self.api.update_status(live)
+
+        retry_on_conflict(write)
+
+
+def _merge_requeue(current: float, hint: float) -> float:
+    """Combine requeue-after hints: 0 means 'none'; otherwise soonest
+    wins."""
+    if hint <= 0:
+        return current
+    if current <= 0:
+        return hint
+    return min(current, hint)
+
+
+def _iso_at(t: float) -> str:
+    import time as _time
+
+    return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(t))
+
+
+__all__ = [
+    "PENDING",
+    "REASON_CRASH_LOOP",
+    "REASON_NODE_GONE",
+    "REASON_PENDING_TIMEOUT",
+    "REASON_POD_FAILED",
+    "RecoveryEngine",
+    "SliceRestartError",
+    "classify_worker",
+]

@@ -37,7 +37,14 @@ from .meta import (
     new_uid,
     set_controller_reference,
 )
-from .store import AdmissionDenied, AdmissionHook, ApiServer, EventType, WatchEvent
+from .store import (
+    AdmissionDenied,
+    AdmissionHook,
+    ApiServer,
+    AuditRecord,
+    EventType,
+    WatchEvent,
+)
 
 __all__ = [
     "AdmissionDenied",
@@ -45,6 +52,7 @@ __all__ = [
     "AlreadyExistsError",
     "ApiError",
     "ApiServer",
+    "AuditRecord",
     "BucketRateLimiter",
     "ConflictError",
     "EventRecorder",

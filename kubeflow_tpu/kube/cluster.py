@@ -50,6 +50,9 @@ class FakeCluster:
         self.auto_ready = auto_ready
         self._pod_ip_counter = 0
         self._failed_pods: set[tuple[str, str]] = set()
+        # (namespace, sts_name) -> failure reason: pods (re)created for a
+        # poisoned StatefulSet come up Failed (see poison_statefulset)
+        self._poisoned: dict[tuple[str, str], str] = {}
         api.watch(self._on_event)
 
     # -- node inventory --------------------------------------------------------
@@ -126,6 +129,67 @@ class FakeCluster:
         self._failed_pods.add((namespace, name))
         self.api.update_status(pod)
         self._sync_sts_status_for_pod(pod)
+
+    def crashloop_pod(self, namespace: str, name: str) -> None:
+        """Chaos hook: the pod's container is stuck in the kubelet's
+        CrashLoopBackOff — pod phase stays Running but the container
+        waits out restart backoffs forever and the pod never turns
+        Ready (the state core.selfheal classifies as crash-loop)."""
+        with self.api.fault_exempt():
+            pod = self.api.get("Pod", namespace, name)
+            pod.status = {
+                "phase": "Running",
+                "conditions": [
+                    {"type": "PodScheduled", "status": "True"},
+                    {"type": "Ready", "status": "False",
+                     "reason": "ContainersNotReady"},
+                ],
+                "containerStatuses": [
+                    {
+                        "name": c.get("name", "main"),
+                        "ready": False,
+                        "restartCount": 7,
+                        "state": {"waiting": {
+                            "reason": "CrashLoopBackOff",
+                            "message": "back-off 5m0s restarting failed "
+                                       "container",
+                        }},
+                    }
+                    for c in pod.spec.get("containers", [])
+                ],
+            }
+            self.api.update_status(pod)
+            self._sync_sts_status_for_pod(pod)
+
+    def delete_node(self, name: str) -> None:
+        """Chaos hook: node-driven disruption (preemption / pool
+        scale-down): the Node object vanishes while its pods linger with
+        a dangling nodeName — exactly what a TPU host preemption looks
+        like to a controller between node-controller sweeps."""
+        with self.api.fault_exempt():
+            try:
+                self.api.delete("Node", "", name)
+            except NotFoundError:
+                pass
+
+    def poison_statefulset(self, namespace: str, name: str,
+                           reason: str = "TPUUnhealthy") -> None:
+        """Chaos hook: every pod (re)created for this StatefulSet comes up
+        Failed — a permanently broken slice (bad host, torn interconnect).
+        Self-healing must exhaust its restart budget on it, not churn
+        forever.  Existing pods fail immediately."""
+        self._poisoned[(namespace, name)] = reason
+        with self.api.fault_exempt():
+            for pod in self.api.list("Pod", namespace=namespace):
+                ref = pod.metadata.controller_owner()
+                if ref is not None and ref.kind == "StatefulSet" \
+                        and ref.name == name:
+                    self._fail_pod(namespace, pod.name, reason)
+
+    def heal_statefulset(self, namespace: str, name: str) -> None:
+        """Undo poison_statefulset: the next slice restart comes up
+        clean (the operator replaced the broken hardware)."""
+        self._poisoned.pop((namespace, name), None)
 
     # -- event loop ------------------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
@@ -223,7 +287,10 @@ class FakeCluster:
             return
         pod.spec["nodeName"] = node.name
         pod = self.api.update(pod)
-        if self.auto_ready:
+        poison = self._poisoned.get((namespace, sts.name))
+        if poison is not None:
+            self._fail_pod(namespace, name, poison)
+        elif self.auto_ready:
             self._mark_running(pod)
 
     def _mark_running(self, pod: KubeObject) -> None:
@@ -288,7 +355,12 @@ class FakeCluster:
                 continue
             pod.spec["nodeName"] = node.name
             pod = self.api.update(pod)
-            if self.auto_ready:
+            ref = pod.metadata.controller_owner()
+            poison = self._poisoned.get((pod.namespace, ref.name)) \
+                if ref is not None and ref.kind == "StatefulSet" else None
+            if poison is not None:
+                self._fail_pod(pod.namespace, pod.name, poison)
+            elif self.auto_ready:
                 self._mark_running(pod)
             self._sync_sts_status_for_pod(pod)
 

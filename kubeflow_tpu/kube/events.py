@@ -7,6 +7,7 @@ controllers/notebook_controller.go:99-122).
 
 from __future__ import annotations
 
+from .errors import AlreadyExistsError
 from .meta import KubeObject, ObjectMeta
 from .store import ApiServer
 
@@ -33,27 +34,37 @@ class EventRecorder:
             ):
                 ev.body["count"] = int(ev.body.get("count", 1)) + 1
                 return self.api.update(ev)
-        self._seq += 1
-        ev = KubeObject(
-            api_version="v1",
-            kind="Event",
-            metadata=ObjectMeta(
-                name=f"{involved.name}.{self.component}.{self._seq:06d}",
-                namespace=involved.namespace or "default",
-            ),
-            body={
-                "involvedObject": {
-                    "apiVersion": involved.api_version,
-                    "kind": involved.kind,
-                    "namespace": involved.namespace,
-                    "name": involved.name,
-                    "uid": involved.metadata.uid,
-                },
-                "reason": reason,
-                "message": message,
-                "type": etype,
-                "count": 1,
-                "source": {"component": self.component},
+        body = {
+            "involvedObject": {
+                "apiVersion": involved.api_version,
+                "kind": involved.kind,
+                "namespace": involved.namespace,
+                "name": involved.name,
+                "uid": involved.metadata.uid,
             },
-        )
-        return self.api.create(ev)
+            "reason": reason,
+            "message": message,
+            "type": etype,
+            "count": 1,
+            "source": {"component": self.component},
+        }
+        # sequence names collide across recorder instances: a restarted
+        # manager (or the new leader after failover) starts its counter at
+        # zero while the previous holder's Events still exist.  Skip
+        # forward over occupied slots — the loop is bounded by the number
+        # of existing same-named Events.
+        while True:
+            self._seq += 1
+            ev = KubeObject(
+                api_version="v1",
+                kind="Event",
+                metadata=ObjectMeta(
+                    name=f"{involved.name}.{self.component}.{self._seq:06d}",
+                    namespace=involved.namespace or "default",
+                ),
+                body=dict(body),
+            )
+            try:
+                return self.api.create(ev)
+            except AlreadyExistsError:
+                continue

@@ -53,6 +53,31 @@ class AdmissionDenied(ForbiddenError):
 
 
 @dataclass
+class AuditRecord:
+    """One observed top-level client WRITE (create/update/patch/delete).
+
+    The audit log is the ground truth chaos tests assert invariants
+    against — e.g. that the self-healing engine only ever issues
+    whole-slice pod deletions, never partial-slice ones.  `ok` is False
+    when the verb raised (an injected fault or a genuine API error): the
+    client still *attempted* the write, which is what atomicity claims
+    are about.  Internal re-entry (GC cascades, admission, the
+    FakeCluster data plane, `fault_exempt` harness calls) is NOT audited:
+    the log captures controller traffic at the client↔apiserver boundary
+    only.  `name` is the name as the client sent it (empty for a
+    generateName create); `rv` is the cluster resourceVersion after the
+    verb, an ordering key across the log."""
+
+    verb: str
+    kind: str
+    namespace: str
+    name: str
+    ok: bool = True
+    error: str = ""
+    rv: int = 0
+
+
+@dataclass
 class AdmissionHook:
     """Registered admission webhook (mutating or validating).
 
@@ -99,6 +124,10 @@ class ApiServer:
         # and are exempt (thread-local so threaded managers stay correct)
         self._fault_plan = None
         self._fault_ctx = threading.local()
+        # bounded audit trail of top-level client writes (AuditRecord);
+        # shares the depth gate with fault injection, so only controller
+        # traffic is recorded — never the store's own re-entry
+        self._audit_log: deque[AuditRecord] = deque(maxlen=8192)
 
     # -- fault injection ------------------------------------------------------
     def install_fault_plan(self, plan) -> None:
@@ -134,6 +163,8 @@ class ApiServer:
         directives for the verb body (e.g. {"stale": True})."""
         depth = getattr(self._fault_ctx, "depth", 0)
         self._fault_ctx.depth = depth + 1
+        audited = depth == 0 and verb in ("create", "update", "patch",
+                                          "delete")
         try:
             directives = None
             if depth == 0 and self._fault_plan is not None:
@@ -142,8 +173,40 @@ class ApiServer:
                 directives = self._fault_plan.intercept(
                     self, verb, kind, namespace, name)
             yield directives
+        except BaseException as err:
+            if audited:
+                with self._lock:
+                    self._audit_log.append(AuditRecord(
+                        verb, kind, namespace, name, ok=False,
+                        error=str(err), rv=self._rv_counter))
+            raise
+        else:
+            if audited:
+                with self._lock:
+                    self._audit_log.append(AuditRecord(
+                        verb, kind, namespace, name, ok=True,
+                        rv=self._rv_counter))
         finally:
             self._fault_ctx.depth = depth
+
+    # -- audit trail ----------------------------------------------------------
+    def audit_log(self, verb: Optional[str] = None,
+                  kind: Optional[str] = None,
+                  ok: Optional[bool] = None) -> list[AuditRecord]:
+        """The recorded top-level client writes, oldest first, optionally
+        filtered.  Chaos tests read this to prove client-side invariants
+        (e.g. slice-atomicity of recovery restarts)."""
+        with self._lock:
+            return [
+                r for r in self._audit_log
+                if (verb is None or r.verb == verb)
+                and (kind is None or r.kind == kind)
+                and (ok is None or r.ok == ok)
+            ]
+
+    def clear_audit_log(self) -> None:
+        with self._lock:
+            self._audit_log.clear()
 
     def drop_watch_connections(self) -> int:
         """Disconnect every RESUMABLE watcher (one with an

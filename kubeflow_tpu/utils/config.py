@@ -81,6 +81,18 @@ class CoreConfig:
     workqueue_max_delay_s: float = 1000.0   # WORKQUEUE_MAX_DELAY_S
     workqueue_qps: float = 10.0             # WORKQUEUE_QPS
     workqueue_burst: int = 100              # WORKQUEUE_BURST
+    # slice-atomic self-healing (core.selfheal): budgeted recovery of
+    # disrupted TPU slices.  Backoff between slice restarts is exponential
+    # (base * 2^n, capped); at most recovery_max_attempts restarts within a
+    # sliding recovery_window_s before the slice is declared
+    # RecoveryExhausted; a worker Pending longer than
+    # recovery_pending_deadline_s counts as disrupted.
+    enable_self_healing: bool = True          # ENABLE_SELF_HEALING
+    recovery_backoff_base_s: float = 10.0     # RECOVERY_BACKOFF_BASE_S
+    recovery_backoff_max_s: float = 300.0     # RECOVERY_BACKOFF_MAX_S
+    recovery_max_attempts: int = 5            # RECOVERY_MAX_ATTEMPTS
+    recovery_window_s: float = 3600.0         # RECOVERY_WINDOW_S
+    recovery_pending_deadline_s: float = 300.0  # RECOVERY_PENDING_DEADLINE_S
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -102,6 +114,15 @@ class CoreConfig:
                 _int(env, "WORKQUEUE_MAX_DELAY_S", 1000)),
             workqueue_qps=float(_int(env, "WORKQUEUE_QPS", 10)),
             workqueue_burst=_int(env, "WORKQUEUE_BURST", 100),
+            enable_self_healing=_bool(env, "ENABLE_SELF_HEALING", True),
+            recovery_backoff_base_s=float(
+                _int(env, "RECOVERY_BACKOFF_BASE_S", 10)),
+            recovery_backoff_max_s=float(
+                _int(env, "RECOVERY_BACKOFF_MAX_S", 300)),
+            recovery_max_attempts=_int(env, "RECOVERY_MAX_ATTEMPTS", 5),
+            recovery_window_s=float(_int(env, "RECOVERY_WINDOW_S", 3600)),
+            recovery_pending_deadline_s=float(
+                _int(env, "RECOVERY_PENDING_DEADLINE_S", 300)),
         )
 
 

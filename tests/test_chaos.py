@@ -35,6 +35,7 @@ CENTRAL_NS = "opendatahub"
 
 SOAK_ROUNDS = int(os.environ.get("CHAOS_SOAK_ROUNDS", "20"))
 SOAK_SEED = int(os.environ.get("CHAOS_SOAK_SEED", "20260804"))
+SELFHEAL_SOAK_ROUNDS = int(os.environ.get("SELFHEAL_SOAK_ROUNDS", "12"))
 
 # the kinds the workbench controllers actually traffic in — the fault
 # plans draw their per-kind targeting from this pool
@@ -120,8 +121,16 @@ class TestFaultInjection:
         assert status["sliceHealth"] == "Healthy"
         assert status["readyReplicas"] == 4
 
-    def test_failed_worker_degrades_then_restart_recovers(self, env):
-        api, cluster, mgr = env
+    def test_failed_worker_degrades_then_restart_recovers(self):
+        # self-healing off: this drill pins the MANUAL recovery path (the
+        # restart annotation) — with healing on, the engine slice-restarts
+        # the failed worker before Degraded can be observed (that path is
+        # tests/test_selfheal.py + TestSliceRecoverySoak)
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        mgr = Manager(api, clock=FakeClock())
+        setup_core_controllers(mgr, CoreConfig(enable_self_healing=False))
         name = self._healthy_tpu_nb(api, mgr)
         cluster.fail_pod("user1", f"{name}-1")
         mgr.run_until_idle()
@@ -340,6 +349,152 @@ class TestChaosSoak:
                  r.drop_watch, r.reset_watch_history, r.probability,
                  r.max_matches, r.after)
                 for r in b.rules]
+
+
+class TestSliceRecoverySoak:
+    """ISSUE-4 acceptance: seeded worker kills + API faults against a
+    self-healing TPU notebook.  Every round must converge back to
+    sliceHealth == Healthy with NO manual restart annotation — the
+    recovery engine does the work — and with slice-atomic restarts only:
+    the fake ApiServer audit log must show pod-delete attempts arriving
+    exclusively in whole-slice groups.  Mid-soak the manager is replaced
+    (leader failover) and the persisted budget must carry over; a
+    permanently failing slice must land on RecoveryExhausted after
+    exactly the configured attempt cap instead of churning forever."""
+
+    HOSTS = 4  # v5e 4x4 single slice
+
+    CFG = dict(
+        recovery_backoff_base_s=1.0,
+        recovery_backoff_max_s=30.0,
+        recovery_max_attempts=4,
+        recovery_window_s=120.0,
+        recovery_pending_deadline_s=60.0,
+    )
+
+    def _env(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        cfg = CoreConfig(**self.CFG)
+        metrics = NotebookMetrics(api)
+        setup_core_controllers(mgr, cfg, metrics)
+        return api, cluster, mgr, clock, cfg, metrics
+
+    def _assert_slice_atomic(self, api, name):
+        """Every audited worker-pod delete attempt belongs to a
+        contiguous whole-slice group — a partial-slice restart would
+        break the grouping."""
+        recs = [r for r in api.audit_log(verb="delete", kind="Pod")
+                if r.name.startswith(name + "-")]
+        expected = {f"{name}-{i}" for i in range(self.HOSTS)}
+        for i in range(0, len(recs), self.HOSTS):
+            chunk = {r.name for r in recs[i:i + self.HOSTS]}
+            assert chunk == expected, (
+                "partial-slice pod deletion observed in the audit log",
+                [(r.name, r.ok) for r in recs])
+        return len(recs) // self.HOSTS
+
+    def _exhausted_cond(self, api, ns, name):
+        status = api.get("Notebook", ns, name).body.get("status", {})
+        return next((c for c in status.get("conditions", [])
+                     if c.get("type") == "RecoveryExhausted"), None)
+
+    def test_recovery_soak_with_failover(self):
+        api, cluster, mgr, clock, cfg, metrics = self._env()
+        nb = Notebook.new("healsoak", "user1", tpu=TPUSpec("v5e", "4x4"))
+        api.create(nb.obj)
+        mgr.run_until_idle()
+
+        print(f"\nrecovery soak: seed={SOAK_SEED} "
+              f"rounds={SELFHEAL_SOAK_ROUNDS} "
+              "(reproduce with CHAOS_SOAK_SEED/SELFHEAL_SOAK_ROUNDS)")
+        rng = random.Random(SOAK_SEED + 13)
+        failover_round = SELFHEAL_SOAK_ROUNDS // 2
+        for round_i in range(SELFHEAL_SOAK_ROUNDS):
+            if round_i == failover_round:
+                # leader failover mid-soak: a brand-new manager resumes
+                # from the CR-persisted bookkeeping alone.  The deposed
+                # manager stops being driven (its queue simply never
+                # runs again, as a deposed leader stops reconciling).
+                from kubeflow_tpu.core.metrics import NotebookMetrics
+
+                mgr = Manager(api, clock=clock)
+                setup_core_controllers(mgr, CoreConfig(**self.CFG),
+                                       NotebookMetrics(api))
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+
+            plan_seed = rng.randrange(2**31)
+            plan = random_fault_plan(plan_seed, kinds=FAULT_KINDS,
+                                     clock=mgr.clock)
+            api.install_fault_plan(plan)
+            # disrupt 1-2 workers; the recovery engine must do the rest
+            # (no restart annotation anywhere in this soak)
+            kind = rng.choice(
+                ["fail_one", "fail_two", "crashloop", "kill", "none"])
+            with api.fault_exempt():
+                if kind == "fail_one":
+                    cluster.fail_pod(
+                        "user1", f"healsoak-{rng.randrange(4)}")
+                elif kind == "fail_two":
+                    for i in rng.sample(range(4), 2):
+                        cluster.fail_pod("user1", f"healsoak-{i}")
+                elif kind == "crashloop":
+                    cluster.crashloop_pod(
+                        "user1", f"healsoak-{rng.randrange(4)}")
+                elif kind == "kill":
+                    api.delete("Pod", "user1",
+                               f"healsoak-{rng.randrange(4)}")
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+            api.clear_fault_plan()
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+
+            assert not mgr.dropped_errors, (
+                f"round {round_i} (plan_seed={plan_seed}, "
+                f"perturb={kind}): {mgr.dropped_errors}")
+            status = api.get("Notebook", "user1",
+                             "healsoak").body["status"]
+            assert status["sliceHealth"] == "Healthy", (round_i, kind)
+            assert status["readyReplicas"] == self.HOSTS
+            assert self._exhausted_cond(api, "user1", "healsoak") is None, \
+                (round_i, kind, status.get("sliceRecovery"))
+            self._assert_slice_atomic(api, "healsoak")
+            # age the sliding window out between rounds so each round
+            # gets a fresh budget (the exhaustion path is tested below)
+            mgr.advance(self.CFG["recovery_window_s"])
+
+        groups = self._assert_slice_atomic(api, "healsoak")
+        assert groups > 0, "soak never exercised a recovery restart"
+
+    def test_permanent_failure_exhausts_exactly_at_cap(self):
+        api, cluster, mgr, clock, cfg, metrics = self._env()
+        nb = Notebook.new("doomed", "user1", tpu=TPUSpec("v5e", "4x4"))
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        cluster.poison_statefulset("user1", "doomed")
+        with api.fault_exempt():
+            mgr.enqueue_all()
+        mgr.settle(max_seconds=float(
+            cfg.recovery_window_s + 10 * cfg.recovery_backoff_max_s))
+        groups = self._assert_slice_atomic(api, "doomed")
+        assert groups == cfg.recovery_max_attempts, groups
+        cond = self._exhausted_cond(api, "user1", "doomed")
+        assert cond is not None and cond["status"] == "True"
+        # terminal: a long quiet period adds zero restarts
+        mgr.advance(3600)
+        assert self._assert_slice_atomic(api, "doomed") == \
+            cfg.recovery_max_attempts
+        assert not mgr.dropped_errors
 
 
 class TestFlightRecorderDebugSoak:

@@ -281,9 +281,17 @@ class TestTpuPath:
         nb = api.get("Notebook", "user1", "maxtext")
         assert nb.status["sliceHealth"] == "Stopped"
 
-    def test_degraded_slice_health(self, env):
-        api, cluster, mgr, _, _ = env
+    def test_degraded_slice_health(self):
+        # self-healing off: this test pins the STATUS classification of a
+        # partially failed slice (with healing on, the failed worker is
+        # slice-restarted before the Degraded state can be observed —
+        # that path is covered in tests/test_selfheal.py)
+        api = ApiServer()
+        cluster = FakeCluster(api)
         cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        mgr = Manager(api, clock=FakeClock())
+        setup_core_controllers(
+            mgr, CoreConfig(enable_self_healing=False), NotebookMetrics(api))
         create_nb(api, mgr, name="maxtext", tpu=TPUSpec("v5e", "4x4"))
         cluster.fail_pod("user1", "maxtext-2")
         mgr.run_until_idle()

@@ -276,6 +276,83 @@ class TestSliceAtomicCulling:
         )
 
 
+class TestCullingRecoveryPrecedence:
+    """Satellite regression (ISSUE 4): the culler and the self-healing
+    engine can race on the same pods — a notebook that is being stopped
+    (stop annotation set, slice_health Stopping/Stopped) must NEVER be
+    'recovered', or the cull and the recovery fight pod-for-pod."""
+
+    def test_stopping_notebook_is_never_recovered(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        api.clear_audit_log()
+        nb = api.get("Notebook", "u1", "tnb")
+        culler.set_stop_annotation(nb.metadata, clock)
+        api.update(nb)
+        mgr.run_until_idle()
+        assert api.list("Pod", namespace="u1") == []
+        status = api.get("Notebook", "u1", "tnb").body["status"]
+        assert status["sliceHealth"] == "Stopped"
+        # no recovery fired: no audited pod deletes (the scale-to-zero
+        # deletions are the fake kubelet's, which is not audited), no
+        # restart metric, no SliceRecovery event, no bookkeeping
+        assert api.audit_log(verb="delete", kind="Pod") == []
+        assert "SliceRecovery" not in [
+            e.body.get("reason") for e in api.list("Event", namespace="u1")]
+        assert "sliceRecovery" not in status
+
+    def test_failed_worker_plus_stop_annotation_parks_cleanly(self, env):
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        # grab the fake cluster the fixture built: fail a worker without
+        # letting the manager react, then stop the notebook — the failed
+        # pod must be culled away, never slice-restarted
+        cluster = env_cluster(api)
+        cluster.fail_pod("u1", "tnb-2")
+        nb = api.get("Notebook", "u1", "tnb")
+        culler.set_stop_annotation(nb.metadata, clock)
+        api.update(nb)
+        api.clear_audit_log()
+        mgr.run_until_idle()
+        assert api.list("Pod", namespace="u1") == []
+        status = api.get("Notebook", "u1", "tnb").body["status"]
+        assert status["sliceHealth"] == "Stopped"
+        assert api.audit_log(verb="delete", kind="Pod") == []
+        assert metrics.slice_restarts.value("u1", "pod-failed") == 0.0
+
+    def test_stale_bookkeeping_cleared_once_stopped(self, env):
+        """A notebook culled mid-recovery drops its bookkeeping when it
+        parks: an un-culled notebook starts with a fresh budget."""
+        api, mgr, clock, jupyter, metrics = env
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        cluster = env_cluster(api)
+        cluster.fail_pod("u1", "tnb-1")
+        mgr.run_until_idle()  # self-healing restarts the slice once
+        status = api.get("Notebook", "u1", "tnb").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert status.get("sliceRecovery"), "expected live bookkeeping"
+        nb = api.get("Notebook", "u1", "tnb")
+        culler.set_stop_annotation(nb.metadata, clock)
+        api.update(nb)
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "tnb").body["status"]
+        assert status["sliceHealth"] == "Stopped"
+        assert "sliceRecovery" not in status
+
+
+def env_cluster(api) -> FakeCluster:
+    """The env fixture's FakeCluster is reachable through the ApiServer's
+    watcher list — the fixture does not return it."""
+    for w in api._watchers:  # noqa: SLF001 — test-only introspection
+        owner = getattr(w, "__self__", None)
+        if isinstance(owner, FakeCluster):
+            return owner
+    raise AssertionError("no FakeCluster attached to this ApiServer")
+
+
 class TestCullingDisabled:
     def test_setup_returns_none_when_disabled(self):
         mgr = Manager(ApiServer(), clock=FakeClock())

@@ -1,0 +1,395 @@
+"""Slice-atomic self-healing tests (core/selfheal.py): disruption
+classification, budgeted slice-atomic recovery, crash-safe restart
+bookkeeping, and the terminal RecoveryExhausted escalation.
+
+The suite leans on the ApiServer audit log: a recovery restart must show
+up as a CONTIGUOUS group of pod-delete attempts covering every ordinal of
+the slice — anything else is a partial-slice restart, the state
+slice-atomicity forbids (JAX collectives cannot survive partial
+membership)."""
+
+import pytest
+
+from kubeflow_tpu.api.types import (
+    CONDITION_RECOVERY_EXHAUSTED,
+    Notebook,
+    TPUSpec,
+)
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.core.selfheal import (
+    PENDING,
+    REASON_CRASH_LOOP,
+    REASON_NODE_GONE,
+    REASON_PENDING_TIMEOUT,
+    REASON_POD_FAILED,
+    classify_worker,
+)
+from kubeflow_tpu.kube import (
+    ApiServer,
+    FakeCluster,
+    FaultPlan,
+    FaultRule,
+    KubeObject,
+    Manager,
+    ObjectMeta,
+)
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+HOSTS = 4  # v5e 4x4 single slice
+
+
+# -- harness -------------------------------------------------------------------
+def make_env(cfg=None, tpu_nodes=HOSTS):
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    if tpu_nodes:
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    tpu_nodes, 4)
+    clock = FakeClock()
+    mgr = Manager(api, clock=clock)
+    metrics = NotebookMetrics(api)
+    cfg = cfg or CoreConfig()
+    setup_core_controllers(mgr, cfg, metrics)
+    return api, cluster, mgr, clock, metrics
+
+
+def create_tpu_nb(api, mgr, name="heal", ns="u1"):
+    nb = Notebook.new(name, ns, tpu=TPUSpec("v5e", "4x4"))
+    api.create(nb.obj)
+    mgr.run_until_idle()
+    return nb
+
+
+def pod_delete_groups(api, name, hosts=HOSTS):
+    """Partition the audited worker-pod delete ATTEMPTS (ok or not) into
+    consecutive groups; assert every group covers the full ordinal set —
+    i.e. the controller only ever issued whole-slice restarts — and
+    return the group count."""
+    recs = [r for r in api.audit_log(verb="delete", kind="Pod")
+            if r.name.startswith(name + "-")]
+    expected = {f"{name}-{i}" for i in range(hosts)}
+    groups = 0
+    for i in range(0, len(recs), hosts):
+        chunk = {r.name for r in recs[i:i + hosts]}
+        assert chunk == expected, (
+            "partial-slice pod deletion observed in the audit log",
+            [(r.name, r.ok) for r in recs])
+        groups += 1
+    return groups
+
+
+def recovery_state(api, ns="u1", name="heal", slice_id="0"):
+    status = api.get("Notebook", ns, name).body.get("status", {})
+    return (status.get("sliceRecovery") or {}).get(slice_id)
+
+
+def exhausted_condition(api, ns="u1", name="heal"):
+    status = api.get("Notebook", ns, name).body.get("status", {})
+    return next((c for c in status.get("conditions", [])
+                 if c.get("type") == CONDITION_RECOVERY_EXHAUSTED), None)
+
+
+def event_reasons(api, ns="u1"):
+    return [e.body.get("reason") for e in api.list("Event", namespace=ns)]
+
+
+# -- disruption classification -------------------------------------------------
+def _mk_pod(api, phase="Running", ready=True, waiting_reason=None,
+            node=None):
+    status = {
+        "phase": phase,
+        "conditions": [
+            {"type": "Ready", "status": "True" if ready else "False"},
+        ],
+        "containerStatuses": [{
+            "name": "main",
+            "ready": ready,
+            "state": ({"waiting": {"reason": waiting_reason}}
+                      if waiting_reason else
+                      {"running": {"startedAt": "2023-01-01T00:00:00Z"}}),
+        }],
+    }
+    spec = {"containers": [{"name": "main"}]}
+    if node:
+        spec["nodeName"] = node
+    return KubeObject("v1", "Pod", ObjectMeta(name="w-0", namespace="u1"),
+                      body={"spec": spec, "status": status})
+
+
+class TestDisruptionClassification:
+    """Table-driven: the disruptions that MUST trigger recovery, and the
+    healthy/transient states that must NOT."""
+
+    @pytest.mark.parametrize("label,pod_kwargs,node_ready,want", [
+        ("pod-failed", dict(phase="Failed", ready=False), True,
+         REASON_POD_FAILED),
+        ("crash-loop", dict(ready=False,
+                            waiting_reason="CrashLoopBackOff"), True,
+         REASON_CRASH_LOOP),
+        ("node-deleted", dict(node="ghost-node"), True, REASON_NODE_GONE),
+        ("node-unready", dict(node="sick-node"), False, REASON_NODE_GONE),
+        ("pending-unscheduled", dict(phase="Pending", ready=False), True,
+         PENDING),
+        ("image-pull-backoff", dict(phase="Pending", ready=False,
+                                    waiting_reason="ImagePullBackOff"),
+         True, PENDING),
+        ("container-creating", dict(phase="Pending", ready=False,
+                                    waiting_reason="ContainerCreating",
+                                    node="ok-node"), True, PENDING),
+        ("healthy", dict(node="ok-node"), True, None),
+        ("running-not-ready", dict(ready=False, node="ok-node"), True,
+         None),
+    ])
+    def test_classification(self, label, pod_kwargs, node_ready, want):
+        api = ApiServer()
+        for name in ("ok-node", "sick-node"):
+            api.create(KubeObject(
+                "v1", "Node", ObjectMeta(name=name),
+                body={"status": {"conditions": [
+                    {"type": "Ready",
+                     "status": "True" if (node_ready
+                                          or name == "ok-node") else
+                     "False"},
+                ]}}))
+        pod = _mk_pod(api, **pod_kwargs)
+        assert classify_worker(pod, api) == want, label
+
+    def test_crashloop_beats_pending(self):
+        """A scheduled pod crash-looping reads crash-loop, not pending —
+        restarting it can actually help, so no deadline wait applies."""
+        api = ApiServer()
+        pod = _mk_pod(api, phase="Running", ready=False,
+                      waiting_reason="CrashLoopBackOff")
+        assert classify_worker(pod, api) == REASON_CRASH_LOOP
+
+
+# -- the recovery engine -------------------------------------------------------
+class TestSliceRecovery:
+    def test_failed_worker_restarts_whole_slice(self):
+        api, cluster, mgr, clock, metrics = make_env()
+        create_tpu_nb(api, mgr)
+        uids_before = {p.name: p.metadata.uid
+                       for p in api.list("Pod", namespace="u1")}
+        cluster.fail_pod("u1", "heal-1")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        # slice-atomic: ALL four workers were replaced, not just heal-1
+        uids_after = {p.name: p.metadata.uid
+                      for p in api.list("Pod", namespace="u1")}
+        assert set(uids_after) == set(uids_before)
+        assert all(uids_after[n] != uids_before[n] for n in uids_before)
+        assert pod_delete_groups(api, "heal") == 1
+        assert metrics.slice_restarts.value("u1", REASON_POD_FAILED) == 1
+        assert "SliceRecovery" in event_reasons(api)
+        # bookkeeping persisted on the CR: one attempt, backoff armed
+        state = recovery_state(api)
+        assert len(state["attempts"]) == 1
+        assert "backoffUntil" in state
+        # disruption fully healed: transient fields cleared, latency
+        # observed into the recovery histogram
+        assert "disruptedAt" not in state
+        assert metrics.disruption_recovery_seconds.count_value("u1") == 1
+
+    def test_crashloop_worker_recovers(self):
+        api, cluster, mgr, clock, metrics = make_env()
+        create_tpu_nb(api, mgr)
+        cluster.crashloop_pod("u1", "heal-2")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert pod_delete_groups(api, "heal") == 1
+        assert metrics.slice_restarts.value("u1", REASON_CRASH_LOOP) == 1
+
+    def test_node_deletion_recovers_on_spare_capacity(self):
+        # one spare TPU node: after the preempted node vanishes the
+        # restarted slice can land fully on the survivors
+        api, cluster, mgr, clock, metrics = make_env(tpu_nodes=HOSTS + 1)
+        create_tpu_nb(api, mgr)
+        victim = api.get("Pod", "u1", "heal-2").spec["nodeName"]
+        cluster.delete_node(victim)
+        # the manager watches Nodes: the deletion alone re-enqueues the
+        # notebook — no pod event or resync needed
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert pod_delete_groups(api, "heal") == 1
+        assert metrics.slice_restarts.value("u1", REASON_NODE_GONE) == 1
+        for pod in api.list("Pod", namespace="u1"):
+            assert pod.spec["nodeName"] != victim
+
+    def test_pending_within_deadline_is_not_disruption(self):
+        # no TPU nodes at all: every worker parks in Pending
+        api, cluster, mgr, clock, metrics = make_env(tpu_nodes=0)
+        create_tpu_nb(api, mgr)
+        state = recovery_state(api)
+        assert "pendingSince" in state and "attempts" not in state
+        mgr.advance(100)  # well inside the 300s default deadline
+        assert pod_delete_groups(api, "heal") == 0
+        assert exhausted_condition(api) is None
+
+    def test_pending_past_deadline_restarts_then_exhausts(self):
+        cfg = CoreConfig(recovery_backoff_base_s=10.0,
+                         recovery_backoff_max_s=300.0,
+                         recovery_max_attempts=3,
+                         recovery_window_s=100000.0,
+                         recovery_pending_deadline_s=60.0)
+        api, cluster, mgr, clock, metrics = make_env(cfg, tpu_nodes=0)
+        create_tpu_nb(api, mgr)
+        # ride the requeue-after schedule to the deadline and through
+        # every backoff until the budget is spent
+        for _ in range(12):
+            mgr.advance(120)
+        assert pod_delete_groups(api, "heal") == 3  # exactly the cap
+        assert metrics.slice_restarts.value(
+            "u1", REASON_PENDING_TIMEOUT) == 3
+        cond = exhausted_condition(api)
+        assert cond is not None and cond["status"] == "True"
+        assert "RecoveryExhausted" in event_reasons(api)
+        assert recovery_state(api)["exhausted"] is True
+        # terminal: no further churn, ever
+        mgr.advance(10000)
+        assert pod_delete_groups(api, "heal") == 3
+
+    def test_budget_survives_manager_failover(self):
+        """Crash-safe bookkeeping: a new manager (leader failover /
+        crash-restart) resumes the persisted budget — the attempt cap
+        holds EXACTLY across the handoff, and the in-flight backoff
+        deadline is honored, not reset."""
+        cfg = CoreConfig(recovery_backoff_base_s=10.0,
+                         recovery_backoff_max_s=300.0,
+                         recovery_max_attempts=4,
+                         recovery_window_s=100000.0)
+        api, cluster, mgr_a, clock, metrics_a = make_env(cfg)
+        create_tpu_nb(api, mgr_a)
+        cluster.poison_statefulset("u1", "heal")  # permanently broken
+        mgr_a.enqueue_all()
+        mgr_a.run_until_idle()    # attempt 1 (immediate)
+        mgr_a.advance(10)         # attempt 2 after base backoff
+        assert len(recovery_state(api)["attempts"]) == 2
+        assert pod_delete_groups(api, "heal") == 2
+
+        # leader failover mid-recovery: fresh manager, fresh metrics,
+        # fresh everything EXCEPT the CR — same cluster clock
+        mgr_b = Manager(api, clock=clock)
+        metrics_b = NotebookMetrics(api)
+        setup_core_controllers(mgr_b, cfg, metrics_b)
+        mgr_b.enqueue_all()
+        mgr_b.run_until_idle()
+        # B must honor A's backoff deadline: no immediate third restart
+        assert pod_delete_groups(api, "heal") == 2
+        mgr_b.advance(20)    # attempt 3
+        mgr_b.advance(40)    # attempt 4 == cap
+        mgr_b.advance(300)   # next detection -> exhausted
+        assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+        cond = exhausted_condition(api)
+        assert cond is not None and cond["status"] == "True"
+        mgr_b.advance(10000)  # budget NOT reset by the failover
+        assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+
+    def test_operator_fix_after_exhaustion_resets_budget(self):
+        cfg = CoreConfig(recovery_backoff_base_s=5.0,
+                         recovery_max_attempts=2,
+                         recovery_window_s=100000.0)
+        api, cluster, mgr, clock, metrics = make_env(cfg)
+        create_tpu_nb(api, mgr)
+        cluster.poison_statefulset("u1", "heal")
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        for _ in range(4):
+            mgr.advance(50)
+        assert recovery_state(api)["exhausted"] is True
+        assert pod_delete_groups(api, "heal") == 2
+
+        # the operator replaces the hardware and requests a restart
+        cluster.heal_statefulset("u1", "heal")
+        live = api.get("Notebook", "u1", "heal")
+        live.metadata.annotations[
+            "notebooks.opendatahub.io/notebook-restart"] = "true"
+        api.update(live)
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        # exhaustion cleared, bookkeeping dropped, budget fresh
+        assert exhausted_condition(api) is None
+        assert recovery_state(api) is None
+        assert "RecoveryRestored" in event_reasons(api)
+        before = pod_delete_groups(api, "heal")
+        cluster.fail_pod("u1", "heal-0")
+        mgr.run_until_idle()
+        assert pod_delete_groups(api, "heal") == before + 1
+        assert api.get("Notebook", "u1",
+                       "heal").body["status"]["sliceHealth"] == "Healthy"
+
+    def test_transient_not_ready_never_triggers_recovery(self):
+        api, cluster, mgr, clock, metrics = make_env()
+        create_tpu_nb(api, mgr)
+        api.clear_audit_log()
+        # a worker flaps not-Ready while Running (kubelet probe blip):
+        # Degraded status, but NOT a disruption — no restart
+        with api.fault_exempt():
+            pod = api.get("Pod", "u1", "heal-3")
+            for cond in pod.body["status"]["conditions"]:
+                if cond["type"] == "Ready":
+                    cond["status"] = "False"
+            api.update_status(pod)
+        mgr.run_until_idle()
+        assert api.audit_log(verb="delete", kind="Pod") == []
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert "sliceRecovery" not in status
+
+    def test_disabled_by_config(self):
+        api, cluster, mgr, clock, metrics = make_env(
+            CoreConfig(enable_self_healing=False))
+        create_tpu_nb(api, mgr)
+        cluster.fail_pod("u1", "heal-1")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Degraded"
+        assert api.audit_log(verb="delete", kind="Pod") == []
+
+
+class TestRestartAggregation:
+    """Satellite regression: _restart_pods must attempt EVERY pod of the
+    slice even when a delete errors mid-loop, and must not report the
+    restart done (annotation cleared) until the whole slice went."""
+
+    def test_error_mid_slice_still_attempts_all_then_retries(self):
+        api, cluster, mgr, clock, metrics = make_env()
+        create_tpu_nb(api, mgr)
+        api.clear_audit_log()
+        # first pod delete 503s; the sweep must still attempt the rest
+        plan = FaultPlan([FaultRule(verbs=("delete",), kinds=("Pod",),
+                                    error="unavailable", max_matches=1,
+                                    name="first-delete")], clock=clock)
+        api.install_fault_plan(plan)
+        with api.fault_exempt():
+            live = api.get("Notebook", "u1", "heal")
+            live.metadata.annotations[
+                "notebooks.opendatahub.io/notebook-restart"] = "true"
+            api.update(live)
+        mgr.run_until_idle()
+        api.clear_fault_plan()
+        assert plan.exhausted()
+        # the faulted sweep covered the whole slice: 4 attempts, exactly
+        # one of them failed — never a short-circuited partial loop
+        recs = [r for r in api.audit_log(verb="delete", kind="Pod")
+                if r.name.startswith("heal-")]
+        first_sweep = recs[:HOSTS]
+        assert {r.name for r in first_sweep} == \
+            {f"heal-{i}" for i in range(HOSTS)}
+        assert [r.ok for r in first_sweep].count(False) == 1
+        # the retry finished the job: annotation cleared, slice healthy
+        live = api.get("Notebook", "u1", "heal")
+        assert "notebooks.opendatahub.io/notebook-restart" not in \
+            live.metadata.annotations
+        assert live.body["status"]["sliceHealth"] == "Healthy"
+
+    def test_new_metric_families_registered(self):
+        _, _, _, _, metrics = make_env()
+        fams = dict(metrics.families())
+        assert fams["notebook_slice_restarts_total"] == "counter"
+        assert fams["notebook_disruption_recovery_seconds"] == "histogram"
