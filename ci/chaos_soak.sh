@@ -20,18 +20,23 @@ cd "$(dirname "$0")/.."
 ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
 HEAL_ROUNDS="${SELFHEAL_SOAK_ROUNDS:-16}"
 SEED="${CHAOS_SOAK_SEED:-20260804}"
+# the CI soak runs the manager with a parallel worker pool: the invariants
+# (steady state restored, slice-atomic restarts, fault<->span pairing) must
+# hold identically in threaded mode, and the soaks additionally assert no
+# per-key concurrent reconcile via the flight recorder's overlap check
+WORKERS="${WORKQUEUE_WORKERS:-8}"
 if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} workers=${WORKERS} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
-    SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" \
+    SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" WORKQUEUE_WORKERS="$WORKERS" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
       tests/test_chaos.py::TestSliceRecoverySoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
   echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
-  echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} ci/chaos_soak.sh" >&2
+  echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} WORKQUEUE_WORKERS=${WORKERS} ci/chaos_soak.sh" >&2
   exit 1
 fi
-echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS})"
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, workers=${WORKERS})"

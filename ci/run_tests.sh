@@ -75,4 +75,12 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   # (/debug/reconciles, /debug/workqueue, OpenMetrics negotiation) over
   # real HTTP — the flight-recorder path users actually hit
   bash ci/debug_endpoints_smoke.sh
+  # perf smoke: deterministic convergence benchmark — 200 notebooks on the
+  # FakeClock must converge within the committed API-verb/reconcile budget
+  # (>10% regression in calls-per-notebook fails), reach a zero-write
+  # steady state, and produce the identical final cluster state with 1 and
+  # 8 workers (per-key serialization proven via the flight recorder)
+  echo "== loadtest convergence smoke =="
+  python loadtest/convergence.py --count 200 --compare-workers 8 \
+    --check-budget ci/apiserver_call_budget.json
 fi

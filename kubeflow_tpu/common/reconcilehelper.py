@@ -92,11 +92,21 @@ def reconcile_object(
     api: ApiServer,
     desired: KubeObject,
     copy_fn: Optional[CopyFn] = None,
+    cache=None,
 ) -> KubeObject:
     """Create-if-missing / update-if-drifted (util.go Deployment()/Service()
-    pattern).  Returns the live object."""
+    pattern).  Returns the live object.
+
+    With `cache` (kube.InformerCache) the no-op check reads the informer
+    cache instead of the apiserver — zero API calls when nothing drifted,
+    which is the steady-state common case.  A stale cached RV surfaces as
+    a ConflictError and the manager's backoff retries against the fresher
+    cache, exactly the controller-runtime cached-client contract."""
     copy_fn = copy_fn or copy_spec
-    found = api.try_get(desired.kind, desired.namespace, desired.name)
+    if cache is not None:
+        found = cache.get(desired.kind, desired.namespace, desired.name)
+    else:
+        found = api.try_get(desired.kind, desired.namespace, desired.name)
     if found is None:
         logger.info("creating %s %s/%s", desired.kind, desired.namespace, desired.name)
         return api.create(desired)

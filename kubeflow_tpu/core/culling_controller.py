@@ -40,12 +40,16 @@ class CullingReconciler:
         jupyter: JupyterAPI,
         metrics: NotebookMetrics,
         clock: Optional[Clock] = None,
+        cache=None,
     ):
         self.api = api
         self.cfg = cfg
         self.jupyter = jupyter
         self.metrics = metrics
         self.clock = clock or Clock()
+        # informer cache for probe-path reads (pod-0 existence, period
+        # gate); annotation writes still read-modify-write the live object
+        self.cache = cache
 
     def _requeue(self) -> Result:
         return Result(requeue_after=self.cfg.idleness_check_period_min * 60)
@@ -65,7 +69,8 @@ class CullingReconciler:
         # is nothing to probe (:121-136)
         num_slices = nb.tpu.slices if nb.tpu else 1
         sts0 = tpuenv.statefulset_name(nb.name, 0, num_slices)
-        pod0 = self.api.try_get("Pod", req.namespace, f"{sts0}-0")
+        reader = self.cache if self.cache is not None else self.api
+        pod0 = reader.try_get("Pod", req.namespace, f"{sts0}-0")
         if pod0 is None:
             self._mutate(req, culler.remove_activity_annotations)
             return Result()
@@ -76,8 +81,11 @@ class CullingReconciler:
                 req, lambda meta: culler.initialize_annotations(meta, self.clock)
             )
 
-        # period gate (:157-160)
-        live = self.api.get("Notebook", req.namespace, req.name)
+        # period gate (:157-160) — cache read: the common case is "period
+        # not passed yet", which must not cost an API round trip
+        live = reader.try_get("Notebook", req.namespace, req.name)
+        if live is None:
+            return Result()
         if not culler.culling_check_period_has_passed(
             live.metadata, self.clock, self.cfg.idleness_check_period_min
         ):
@@ -172,6 +180,12 @@ def setup_culling(
 
         jupyter = HttpJupyterClient(cfg.cluster_domain, cfg.dev)
     metrics = metrics or NotebookMetrics(mgr.api)
-    rec = CullingReconciler(mgr.api, cfg, jupyter, metrics, clock=mgr.clock)
-    mgr.register("culling", rec, for_kind="Notebook")
+    rec = CullingReconciler(mgr.api, cfg, jupyter, metrics, clock=mgr.clock,
+                            cache=mgr.cache)
+    from ..kube import suppress_status_only
+
+    # the culler keys off annotations + pod liveness, never Notebook
+    # status: the notebook controller's status writes must not wake it
+    mgr.register("culling", rec, for_kind="Notebook",
+                 for_predicate=suppress_status_only)
     return rec

@@ -103,6 +103,12 @@ class NotebookMetrics:
             "Most recent backoff delay handed out per controller",
             labels=("controller",),
         )
+        self.workqueue_longest_running = self.registry.gauge(
+            "workqueue_longest_running_processor_seconds",
+            "Age of the oldest reconcile currently being processed per "
+            "controller (0 when idle)",
+            labels=("controller",),
+        )
         self.reconcile_errors_total = self.registry.counter(
             "reconcile_errors_total",
             "Reconcile requests dropped after exhausting their retry budget",
@@ -131,7 +137,10 @@ class NotebookMetrics:
         live StatefulSet set, then render."""
         running_notebooks: dict[str, set[str]] = {}  # ns -> notebook names
         per_ns_chips: dict[str, float] = {}
-        for sts in self.api.list("StatefulSet"):
+        cache = getattr(self.manager, "cache", None)
+        statefulsets = cache.list("StatefulSet") if cache is not None \
+            else self.api.list("StatefulSet")
+        for sts in statefulsets:
             nb_name = (
                 sts.spec.get("template", {})
                 .get("metadata", {})
@@ -169,6 +178,8 @@ class NotebookMetrics:
                                    stats["retries_total"].get(name, 0))
                 self.workqueue_last_backoff_seconds.labels(name).set(
                     stats["last_backoff_s"].get(name, 0.0))
+                self.workqueue_longest_running.labels(name).set(
+                    stats.get("longest_running_s", {}).get(name, 0.0))
                 self._feed_counter(self.reconcile_errors_total, name,
                                    stats["errors_total"].get(name, 0))
         return self.render(openmetrics=openmetrics)

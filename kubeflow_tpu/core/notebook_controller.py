@@ -49,16 +49,22 @@ class NotebookReconciler:
         metrics: NotebookMetrics,
         recorder: Optional[EventRecorder] = None,
         clock: Optional[Clock] = None,
+        cache=None,
     ):
         self.api = api
         self.cfg = cfg
         self.metrics = metrics
         self.recorder = recorder or EventRecorder(api, "notebook-controller")
         self.clock = clock or Clock()
+        # indexed informer cache (kube.InformerCache): the hot-path read
+        # surface — owned-StatefulSet lookup by owner uid, worker pods by
+        # label index — replacing O(all-objects) api.list scans.  None
+        # falls back to live reads (direct-construction unit tests).
+        self.cache = cache
         # slice-atomic self-healing: budgeted recovery of disrupted TPU
         # slices, bookkeeping persisted on the CR (core/selfheal.py)
         self.recovery = RecoveryEngine(api, cfg, metrics, self.recorder,
-                                       clock=self.clock)
+                                       clock=self.clock, cache=cache)
         # first-readiness tracking for the notebook_to_ready_seconds
         # histogram: first-seen clock time per live notebook (keyed by uid
         # so a delete+recreate measures afresh), dropped once observed
@@ -67,7 +73,12 @@ class NotebookReconciler:
 
     # -- main loop (reference Reconcile, notebook_controller.go:94-294) -------
     def reconcile(self, req: Request) -> Result:
-        obj = self.api.try_get("Notebook", req.namespace, req.name)
+        # primary read off the informer cache (controller-runtime's cached
+        # client): the event that enqueued this request already updated it
+        if self.cache is not None:
+            obj = self.cache.get("Notebook", req.namespace, req.name)
+        else:
+            obj = self.api.try_get("Notebook", req.namespace, req.name)
         if obj is None:
             return Result()
         nb = Notebook(obj)
@@ -88,13 +99,19 @@ class NotebookReconciler:
                                 {"phase": "render"}) as render_span:
             desired_sets = generate_statefulsets(nb, self.cfg)
             render_span.set_attribute("statefulsets", len(desired_sets))
-        existing = [
-            s
-            for s in self.api.list("StatefulSet", namespace=req.namespace)
-            if (ref := s.metadata.controller_owner()) is not None
-            and ref.kind == "Notebook"
-            and ref.uid == obj.metadata.uid
-        ]
+        if self.cache is not None:
+            # owner-uid index: O(this notebook's StatefulSets) instead of a
+            # live list scan over every StatefulSet in the namespace
+            existing = self.cache.by_index(
+                "StatefulSet", "owner-uid", obj.metadata.uid)
+        else:
+            existing = [
+                s
+                for s in self.api.list("StatefulSet", namespace=req.namespace)
+                if (ref := s.metadata.controller_owner()) is not None
+                and ref.kind == "Notebook"
+                and ref.uid == obj.metadata.uid
+            ]
         existing_by_name = {s.name: s for s in existing}
 
         def slice_of(sts: KubeObject) -> Optional[str]:
@@ -138,26 +155,34 @@ class NotebookReconciler:
                     pass
                 raise errors[0]
 
-            # Services
+            # Services (no-op detection against the informer cache: a
+            # converged notebook costs zero Service API calls per pass)
             svc = generate_service(nb)
             set_controller_reference(obj, svc)
-            rh.reconcile_object(self.api, svc, rh.copy_service_fields)
+            rh.reconcile_object(self.api, svc, rh.copy_service_fields,
+                                cache=self.cache)
             if nb.tpu is not None:
                 headless = generate_headless_service(nb)
                 set_controller_reference(obj, headless)
-                rh.reconcile_object(self.api, headless, rh.copy_service_fields)
+                rh.reconcile_object(self.api, headless,
+                                    rh.copy_service_fields, cache=self.cache)
 
             if self.cfg.use_istio:
                 vs = generate_virtual_service(nb, self.cfg)
                 set_controller_reference(obj, vs)
-                rh.reconcile_object(self.api, vs, rh.copy_spec)
+                rh.reconcile_object(self.api, vs, rh.copy_spec,
+                                    cache=self.cache)
 
         # status from live STS + pods
         self._update_status(nb, live_names)
 
         # restart annotation (notebook_controller.go:259-294); for TPU
         # notebooks restart is slice-atomic: delete every worker pod
-        annotations = self.api.get("Notebook", req.namespace, req.name).metadata.annotations
+        if self.cache is not None:
+            fresh = self.cache.get("Notebook", req.namespace, req.name)
+        else:
+            fresh = self.api.try_get("Notebook", req.namespace, req.name)
+        annotations = fresh.metadata.annotations if fresh is not None else {}
         if annotations.get(C.ANNOTATION_NOTEBOOK_RESTART) == "true":
             # _restart_pods raises after attempting the whole slice set if
             # any delete failed — the annotation then survives for the
@@ -235,13 +260,20 @@ class NotebookReconciler:
     def _pods_of(self, nb: Notebook, live_sts_name: str) -> list[KubeObject]:
         """Pods of a live StatefulSet, selected via its own selector — the
         pod labels carry the *rendered* statefulset name, which differs from
-        the live object name when generateName kicked in (long CR names)."""
-        sts = self.api.try_get("StatefulSet", nb.namespace, live_sts_name)
+        the live object name when generateName kicked in (long CR names).
+        With a cache the selector lookup is served by the Pod label index
+        (setup_core_controllers registers it for the STS selector label)."""
+        if self.cache is not None:
+            sts = self.cache.get("StatefulSet", nb.namespace, live_sts_name)
+        else:
+            sts = self.api.try_get("StatefulSet", nb.namespace, live_sts_name)
         if sts is None:
             return []
         selector = sts.spec.get("selector", {}).get("matchLabels", {})
         if not selector:
             return []
+        if self.cache is not None:
+            return self.cache.select("Pod", nb.namespace, selector)
         return self.api.list("Pod", namespace=nb.namespace, label_selector=selector)
 
     def _restart_pods(self, nb: Notebook, live_names: list[str]) -> None:
@@ -287,7 +319,10 @@ class NotebookReconciler:
 
         first_sts_name = live_names[0] if live_names else nb.name
         for live_name in live_names:
-            sts = self.api.try_get("StatefulSet", nb.namespace, live_name)
+            if self.cache is not None:
+                sts = self.cache.get("StatefulSet", nb.namespace, live_name)
+            else:
+                sts = self.api.try_get("StatefulSet", nb.namespace, live_name)
             if sts is not None:
                 ready += int(sts.status.get("readyReplicas", 0) or 0)
             if tpu is not None:
@@ -302,7 +337,10 @@ class NotebookReconciler:
                     )
 
         # conditions + containerState mirror worker 0 (the Jupyter server)
-        pod0 = self.api.try_get("Pod", nb.namespace, f"{first_sts_name}-0")
+        if self.cache is not None:
+            pod0 = self.cache.get("Pod", nb.namespace, f"{first_sts_name}-0")
+        else:
+            pod0 = self.api.try_get("Pod", nb.namespace, f"{first_sts_name}-0")
         if pod0 is not None and pod0.body.get("status"):
             pstatus = pod0.body["status"]
             now = self.clock.now_iso()
@@ -426,6 +464,16 @@ class NotebookReconciler:
         if len(self._first_seen) > 8192:
             self._first_seen.clear()
 
+        # status dedup, cache-first: when the cached live object already
+        # carries exactly this status, skip the read-modify-write entirely
+        # — the converged steady state issues ZERO status API calls.  A
+        # stale cache merely delays the write until the next event-driven
+        # pass (level-triggered correctness).
+        if self.cache is not None:
+            cached = self.cache.get("Notebook", nb.namespace, nb.name)
+            if cached is not None and cached.body.get("status") == status:
+                return
+
         def write() -> None:
             live = self.api.get("Notebook", nb.namespace, nb.name)
             if live.body.get("status") == status:
@@ -509,7 +557,7 @@ def setup_core_controllers(
     cfg = cfg or CoreConfig.from_env()
     api = mgr.api
     from ..api.validation import install_notebook_schema
-    from ..kube import default_rate_limiter
+    from ..kube import default_rate_limiter, suppress_status_only
 
     install_notebook_schema(api)
     # workqueue rate limiting from config (WORKQUEUE_* env vars): per-item
@@ -521,11 +569,24 @@ def setup_core_controllers(
         qps=cfg.workqueue_qps,
         burst=cfg.workqueue_burst,
     ))
+    # parallel reconcile workers (WORKQUEUE_WORKERS): only widen — an
+    # explicit Manager(workers=N) stays authoritative over the default
+    if cfg.workqueue_workers > mgr.workers:
+        mgr.workers = cfg.workqueue_workers
+    # hot-path read indexes (controller-runtime FieldIndexer analog):
+    # owned StatefulSets by controller-owner uid, worker Pods by the STS
+    # selector label, Notebook fleet sweeps by namespace
+    cache = mgr.cache
+    if cache is not None:
+        cache.add_owner_uid_index("StatefulSet")
+        cache.add_label_index("Pod", C.STATEFULSET_LABEL)
+        cache.add_namespace_index("Notebook")
     metrics = metrics or NotebookMetrics(api, manager=mgr)
     if metrics.manager is None:
         metrics.attach_manager(mgr)
     recorder = EventRecorder(api, "notebook-controller")
-    rec = NotebookReconciler(api, cfg, metrics, recorder, clock=mgr.clock)
+    rec = NotebookReconciler(api, cfg, metrics, recorder, clock=mgr.clock,
+                             cache=cache)
 
     def pod_to_request(pod: KubeObject) -> list[Request]:
         name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
@@ -535,10 +596,13 @@ def setup_core_controllers(
         # a node vanishing or flipping unready can strand any multi-host
         # slice whose workers it carried; re-evaluate every TPU notebook so
         # the self-healing engine sees node-driven disruption without
-        # waiting for a pod event or resync (cheap: cached list, rare event)
+        # waiting for a pod event or resync (cheap: cached sweep, rare
+        # event — and never an api.list scan when the cache is wired)
+        notebooks = cache.list("Notebook") if cache is not None \
+            else api.list("Notebook")
         return [
             Request(o.namespace, o.name)
-            for o in api.list("Notebook")
+            for o in notebooks
             if o.spec.get("tpu")
         ]
 
@@ -549,6 +613,10 @@ def setup_core_controllers(
         owns=["StatefulSet", "Service", "VirtualService"],
         watches=[WatchSpec(kind="Pod", mapper=pod_to_request),
                  WatchSpec(kind="Node", mapper=node_to_requests)],
+        # the notebook controller is the sole writer of Notebook status;
+        # its own status writes must not re-trigger it (or the fleet never
+        # reaches a zero-reconcile steady state)
+        for_predicate=suppress_status_only,
     )
     reemit = EventReemitReconciler(api, recorder)
     mgr.register(

@@ -157,12 +157,16 @@ class RecoveryEngine:
         metrics: NotebookMetrics,
         recorder: EventRecorder,
         clock: Optional[Clock] = None,
+        cache=None,
     ) -> None:
         self.api = api
         self.cfg = cfg
         self.metrics = metrics
         self.recorder = recorder
         self.clock = clock or Clock()
+        # informer cache for detection-path reads (Notebook freshness,
+        # Node health in classify_worker); writes always go live
+        self.cache = cache
 
     # -- entry point ----------------------------------------------------------
     def maybe_recover(
@@ -180,7 +184,8 @@ class RecoveryEngine:
         tpu = nb.tpu
         if tpu is None or not self.cfg.enable_self_healing:
             return 0.0
-        live = self.api.try_get("Notebook", nb.namespace, nb.name)
+        reader = self.cache if self.cache is not None else self.api
+        live = reader.try_get("Notebook", nb.namespace, nb.name)
         if live is None or live.metadata.deletion_timestamp is not None:
             return 0.0
         status = live.body.get("status", {}) or {}
@@ -208,7 +213,7 @@ class RecoveryEngine:
             pending = False
             ready = 0
             for pod in pods:
-                verdict = classify_worker(pod, self.api, node_cache)
+                verdict = classify_worker(pod, reader, node_cache)
                 if verdict == PENDING:
                     pending = True
                 elif verdict is not None:

@@ -1,6 +1,7 @@
 """In-memory Kubernetes control-plane substrate (apiserver + controller
 runtime + fake data plane) that the TPU notebook controllers run against."""
 
+from .cache import InformerCache
 from .cluster import FakeCluster, parse_quantity
 from .controller import (
     BucketRateLimiter,
@@ -12,6 +13,8 @@ from .controller import (
     Result,
     WatchSpec,
     default_rate_limiter,
+    is_status_only_update,
+    suppress_status_only,
 )
 from .faults import FaultPlan, FaultRecord, FaultRule, random_fault_plan
 from .errors import (
@@ -63,6 +66,7 @@ __all__ = [
     "FaultRule",
     "ForbiddenError",
     "GoneError",
+    "InformerCache",
     "InvalidError",
     "ItemExponentialBackoff",
     "KubeObject",
@@ -83,7 +87,9 @@ __all__ = [
     "is_already_exists",
     "is_conflict",
     "is_not_found",
+    "is_status_only_update",
     "new_uid",
+    "suppress_status_only",
     "parse_quantity",
     "retry_on_conflict",
     "set_controller_reference",

@@ -1,0 +1,379 @@
+"""Indexed informer cache: shared read-optimized state for reconcilers.
+
+Controller-runtime reconcilers never list the apiserver on their hot path —
+they read an informer-fed cache with registrable indexers (client-go
+cache.Indexer; FieldIndexer in controller-runtime terms), so a reconcile of
+one Notebook costs O(its objects), not O(all objects).  Until now every
+reconcile here did live `api.list()` scans (`_pods_of`, the owned
+StatefulSet lookup, whole-fleet Notebook sweeps), which is O(cluster) work
+per event — the exact shape Podracer (arXiv:2104.06272) identifies as the
+throughput ceiling: workers must share a read-optimized store instead of
+re-materializing state per task.
+
+`InformerCache` subscribes to the same watch stream the Manager consumes
+(kube/store.py fan-out in-memory; the reflector informers of
+kube/client.py on a real cluster) and maintains:
+
+  - per-kind object maps, primed lazily with a consistent
+    `list_with_rv` snapshot and kept fresh by watch events (stale replays
+    are dropped by resourceVersion comparison; deletions observed during a
+    prime are tombstoned so the snapshot cannot resurrect them);
+  - registrable indexers: `add_namespace_index`, `add_owner_uid_index`
+    (controller ownerReference uid), and `add_label_index(kind, *keys)`
+    for exact-label-selector lookups (the TPU worker pods are selected by
+    their StatefulSet label);
+  - `cache_index_lookups_total{index,result}` hit/miss accounting, so a
+    dashboard shows when a hot path silently degraded to a brute scan.
+
+Resume semantics mirror the Manager's `_WatchSession`: an injected watch
+drop (kube/faults.py `drop_watch`) disconnects the cache too, and
+reconnect resumes from the newest resourceVersion seen — or, when the
+history window was compacted away (410 Gone), relists every primed kind
+against the live store.  Priming and relists are recovery machinery, not
+client traffic, and run fault-exempt.
+
+All query results are deepcopies — callers may mutate them freely, exactly
+as with `ApiServer.list()`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .errors import GoneError
+from .meta import KubeObject
+from .store import EventType, WatchEvent, match_labels
+
+IndexFn = Callable[[KubeObject], list]
+
+
+def _rv_int(obj: KubeObject) -> int:
+    rv = obj.metadata.resource_version
+    if isinstance(rv, int):
+        return rv
+    try:
+        return int(rv or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+class InformerCache:
+    """Watch-fed object cache with registrable indexers (see module doc)."""
+
+    def __init__(self, api, registry=None) -> None:
+        self.api = api
+        self._lock = threading.Lock()
+        # kind -> (namespace, name) -> KubeObject
+        self._objects: dict[str, dict[tuple[str, str], KubeObject]] = {}
+        self._primed: set[str] = set()
+        # kinds mid-sync: deletions seen while the list snapshot is in
+        # flight, so the merge cannot resurrect an object deleted after
+        # the snapshot was taken
+        self._tombstones: dict[str, set[tuple[str, str]]] = {}
+        self._indexers: dict[str, dict[str, IndexFn]] = {}
+        # (kind, index name) -> index key -> set of object keys
+        self._indexes: dict[tuple[str, str], dict[str, set[tuple[str, str]]]] = {}
+        self.lookups = None
+        if registry is not None:
+            self.lookups = registry.counter(
+                "cache_index_lookups_total",
+                "Indexed cache lookups by index and hit/miss outcome "
+                "(miss = the read fell back to a brute-force scan)",
+                labels=("index", "result"))
+        # watch-resume state (in-memory backend only; the KubeClient's
+        # reflector informers own their drop/relist recovery and never
+        # disconnect this plain-callback watcher)
+        self.connected = True
+        self.drops = 0
+        self.relists = 0
+        self.last_rv = 0
+        self._conn_lock = threading.Lock()
+        if hasattr(api, "subscribe"):
+            api.subscribe(self)
+        else:
+            api.watch(self)
+
+    # -- watch feed -----------------------------------------------------------
+    def __call__(self, ev: WatchEvent) -> None:
+        rv = _rv_int(ev.obj)
+        with self._lock:
+            if rv > self.last_rv:
+                self.last_rv = rv
+            kind = ev.obj.kind
+            key = (ev.obj.namespace, ev.obj.name)
+            store = self._objects.setdefault(kind, {})
+            old = store.get(key)
+            if ev.type is EventType.DELETED:
+                if kind in self._tombstones:
+                    self._tombstones[kind].add(key)
+                if old is not None:
+                    if _rv_int(old) > rv:
+                        # the stored object is a NEWER incarnation: a
+                        # recreate raced ahead of this DELETED in the
+                        # fan-out (a data-plane watcher recreating pods
+                        # reacts inside the same notify pass) — evicting
+                        # it would blind every indexed read until relist
+                        return
+                    del store[key]
+                    self._deindex(kind, key, old)
+            else:
+                if old is not None and _rv_int(old) > rv:
+                    return  # stale replay (resume overlap); keep the newer
+                self._reindex(kind, key, old, ev.obj)
+                store[key] = ev.obj
+
+    def on_watch_dropped(self) -> None:
+        self.drops += 1
+        self.connected = False
+
+    def ensure_connected(self) -> None:
+        """Reconnect after an injected watch drop — resume from the last
+        seen resourceVersion, or relist every primed kind on 410 Gone."""
+        if self.connected:
+            return
+        with self._conn_lock:
+            if self.connected:
+                return
+            try:
+                self.api.subscribe(self, since_rv=self.last_rv)
+            except GoneError:
+                self.api.subscribe(self)
+                self.relists += 1
+                with self._lock:
+                    kinds = sorted(self._primed)
+                for kind in kinds:
+                    self._sync_kind(kind, prune=True)
+            self.connected = True
+
+    # -- indexer registration -------------------------------------------------
+    def add_indexer(self, kind: str, name: str, fn: IndexFn) -> None:
+        """Register an index over `kind`; `fn(obj)` returns the index keys
+        the object files under.  Idempotent by (kind, name): a second
+        registration under the same name is a no-op, so setup functions may
+        register shared indexes without coordinating."""
+        with self._lock:
+            per_kind = self._indexers.setdefault(kind, {})
+            if name in per_kind:
+                return
+            per_kind[name] = fn
+            idx: dict[str, set[tuple[str, str]]] = {}
+            for key, obj in self._objects.get(kind, {}).items():
+                for k in fn(obj):
+                    idx.setdefault(k, set()).add(key)
+            self._indexes[(kind, name)] = idx
+
+    def add_namespace_index(self, kind: str) -> str:
+        self.add_indexer(kind, "namespace", lambda o: [o.namespace])
+        return "namespace"
+
+    def add_owner_uid_index(self, kind: str) -> str:
+        def fn(obj: KubeObject) -> list:
+            ref = obj.metadata.controller_owner()
+            return [ref.uid] if ref is not None else []
+
+        self.add_indexer(kind, "owner-uid", fn)
+        return "owner-uid"
+
+    def add_label_index(self, kind: str, *keys: str) -> str:
+        """Exact-match label index over a fixed key set; `select()` with a
+        selector over exactly these keys is served from it."""
+        key_tuple = tuple(sorted(keys))
+        name = "label:" + ",".join(key_tuple)
+
+        def fn(obj: KubeObject) -> list:
+            labels = obj.metadata.labels
+            if not all(k in labels for k in key_tuple):
+                return []
+            return [",".join(f"{k}={labels[k]}" for k in key_tuple)]
+
+        self.add_indexer(kind, name, fn)
+        return name
+
+    # -- reads (all deepcopied) -----------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> Optional[KubeObject]:
+        self._ensure_primed(kind)
+        with self._lock:
+            obj = self._objects.get(kind, {}).get((namespace, name))
+            return obj.deepcopy() if obj is not None else None
+
+    # ApiServer-read-surface alias, so cache-or-api call sites stay uniform
+    try_get = get
+
+    def keys(self, kind: str,
+             namespace: Optional[str] = None) -> list[tuple[str, str]]:
+        """(namespace, name) keys of a kind — enqueue_all resyncs from this
+        instead of materializing every object through the apiserver."""
+        self._ensure_primed(kind)
+        with self._lock:
+            return sorted(k for k in self._objects.get(kind, {})
+                          if namespace is None or k[0] == namespace)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[dict[str, str]] = None
+             ) -> list[KubeObject]:
+        """Cache-backed list; namespace-scoped listings go through the
+        namespace index when one is registered (hit), else scan the kind
+        map (miss)."""
+        self._ensure_primed(kind)
+        with self._lock:
+            store = self._objects.get(kind, {})
+            if namespace is None:
+                objs = list(store.values())
+            elif "namespace" in self._indexers.get(kind, {}):
+                hits = self._indexes.get((kind, "namespace"), {}) \
+                    .get(namespace, set())
+                objs = [store[k] for k in hits if k in store]
+                self._count("namespace", "hit")
+            else:
+                objs = [o for k, o in store.items() if k[0] == namespace]
+                self._count("namespace", "miss")
+            if label_selector:
+                objs = [o for o in objs
+                        if match_labels(o.metadata.labels, label_selector)]
+            return sorted((o.deepcopy() for o in objs),
+                          key=lambda o: (o.namespace, o.name))
+
+    def select(self, kind: str, namespace: Optional[str],
+               label_selector: Optional[dict[str, str]]) -> list[KubeObject]:
+        """Label-selector lookup.  Served from the exact-key-set label
+        index when one is registered for the selector's keys (hit), else a
+        brute-force filtered scan (miss)."""
+        if not label_selector:
+            return self.list(kind, namespace)
+        key_tuple = tuple(sorted(label_selector))
+        name = "label:" + ",".join(key_tuple)
+        self._ensure_primed(kind)
+        with self._lock:
+            store = self._objects.get(kind, {})
+            if name in self._indexers.get(kind, {}):
+                ikey = ",".join(f"{k}={label_selector[k]}" for k in key_tuple)
+                hits = self._indexes.get((kind, name), {}).get(ikey, set())
+                objs = [store[k] for k in hits
+                        if k in store and (namespace is None
+                                           or k[0] == namespace)]
+                self._count(name, "hit")
+            else:
+                objs = [o for k, o in store.items()
+                        if (namespace is None or k[0] == namespace)
+                        and match_labels(o.metadata.labels, label_selector)]
+                self._count(name, "miss")
+            return sorted((o.deepcopy() for o in objs),
+                          key=lambda o: (o.namespace, o.name))
+
+    def by_index(self, kind: str, index: str, key: str) -> list[KubeObject]:
+        """Objects filed under `key` in a registered index.  Raises
+        KeyError for an unregistered index — a silent brute-scan fallback
+        here would hide a missing setup-time registration forever."""
+        self._ensure_primed(kind)
+        with self._lock:
+            if index not in self._indexers.get(kind, {}):
+                raise KeyError(f"no index {index!r} registered for {kind}")
+            store = self._objects.get(kind, {})
+            hits = self._indexes.get((kind, index), {}).get(key, set())
+            self._count(index, "hit")
+            return sorted((store[k].deepcopy() for k in hits if k in store),
+                          key=lambda o: (o.namespace, o.name))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "primed_kinds": sorted(self._primed),
+                "objects": {k: len(v) for k, v in self._objects.items()},
+                "indexes": {f"{kind}/{name}": len(idx)
+                            for (kind, name), idx in self._indexes.items()},
+                "drops": self.drops,
+                "relists": self.relists,
+                "connected": self.connected,
+            }
+
+    # -- internals ------------------------------------------------------------
+    def _count(self, index: str, result: str) -> None:
+        if self.lookups is not None:
+            self.lookups.labels(index, result).inc()
+
+    def _reindex(self, kind: str, key: tuple[str, str],
+                 old: Optional[KubeObject], new: KubeObject) -> None:
+        for name, fn in self._indexers.get(kind, {}).items():
+            idx = self._indexes.setdefault((kind, name), {})
+            if old is not None:
+                for k in fn(old):
+                    bucket = idx.get(k)
+                    if bucket is not None:
+                        bucket.discard(key)
+                        if not bucket:
+                            del idx[k]
+            for k in fn(new):
+                idx.setdefault(k, set()).add(key)
+
+    def _deindex(self, kind: str, key: tuple[str, str],
+                 old: KubeObject) -> None:
+        for name, fn in self._indexers.get(kind, {}).items():
+            idx = self._indexes.get((kind, name), {})
+            for k in fn(old):
+                bucket = idx.get(k)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del idx[k]
+
+    def _ensure_primed(self, kind: str) -> None:
+        with self._lock:
+            if kind in self._primed:
+                return
+        self._sync_kind(kind, prune=False)
+        with self._lock:
+            self._primed.add(kind)
+
+    def _list_live(self, kind: str) -> tuple[list[KubeObject], int]:
+        """Consistent snapshot from the backing store, fault-exempt (this
+        is cache machinery, not client traffic under test)."""
+        def do() -> tuple[list[KubeObject], int]:
+            lister = getattr(self.api, "list_with_rv", None)
+            if lister is not None:
+                return lister(kind)
+            return self.api.list(kind), 0
+
+        exempt = getattr(self.api, "fault_exempt", None)
+        if exempt is not None:
+            with exempt():
+                return do()
+        return do()
+
+    def _sync_kind(self, kind: str, prune: bool) -> None:
+        """Merge a live list snapshot into the kind map.  Watch events keep
+        flowing while the list is in flight: newer stored versions win by
+        resourceVersion, and deletions observed mid-sync are tombstoned so
+        the snapshot cannot resurrect them.  `prune=True` (relist after
+        410) additionally drops entries absent from the snapshot, unless
+        they are provably newer than it."""
+        with self._lock:
+            self._tombstones.setdefault(kind, set())
+        try:
+            objs, snapshot_rv = self._list_live(kind)
+        except Exception:
+            with self._lock:
+                self._tombstones.pop(kind, None)
+            raise
+        fresh = {(o.namespace, o.name): o for o in objs}
+        with self._lock:
+            tombstones = self._tombstones.pop(kind, set())
+            store = self._objects.setdefault(kind, {})
+            if prune:
+                for key in [k for k in store if k not in fresh]:
+                    cur = store[key]
+                    if snapshot_rv and _rv_int(cur) > snapshot_rv:
+                        continue  # created after the snapshot; event is live
+                    del store[key]
+                    self._deindex(kind, key, cur)
+            for key, obj in fresh.items():
+                if key in tombstones:
+                    continue  # deleted while the snapshot was in flight
+                cur = store.get(key)
+                if cur is not None and _rv_int(cur) >= _rv_int(obj):
+                    continue
+                self._reindex(kind, key, cur, obj)
+                store[key] = obj
+
+
+__all__ = ["InformerCache"]

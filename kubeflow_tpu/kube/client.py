@@ -516,9 +516,16 @@ class KubeClient:
                     pass  # opaque RV (a real apiserver may send one): keep last
                 if etype is EventType.DELETED:
                     inf.known.pop((obj.namespace, obj.name), None)
+                    self._dispatch(WatchEvent(etype, obj))
                 else:
+                    # last-known state rides along as `prev` (the in-memory
+                    # watch cache provides the same), so event predicates
+                    # like suppress_status_only work on a real cluster too
+                    prev = inf.known.get((obj.namespace, obj.name))
                     inf.known[(obj.namespace, obj.name)] = obj
-                self._dispatch(WatchEvent(etype, obj))
+                    self._dispatch(WatchEvent(
+                        etype, obj,
+                        prev=prev if etype is EventType.MODIFIED else None))
             return rv
         finally:
             inf.conn = None

@@ -7,16 +7,24 @@ wiring with predicates and request mappers
 manager that runs every registered controller
 (notebook-controller/main.go:58-148).  Execution is deterministic and
 single-threaded by default (`run_until_idle`), which replaces envtest's
-eventually-consistent goroutine loop with exact test semantics; a threaded
-mode serves standalone operation.
+eventually-consistent goroutine loop with exact test semantics; standalone
+operation runs a pool of WORKQUEUE_WORKERS worker threads with strict
+per-key serialization (controller-runtime workqueue semantics — an
+in-flight key parks instead of double-dispatching), and `run_until_idle`
+drives the same pool batch-wise so threaded soaks stay FakeClock-exact.
+Reconcilers read through the manager's indexed informer cache
+(kube/cache.py) rather than live api.list scans.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
+import time
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
@@ -24,9 +32,10 @@ from ..utils import tracing
 from ..utils.clock import Clock
 from ..utils.flightrecorder import FlightRecorder
 from ..utils.metrics import Registry
+from .cache import InformerCache
 from .errors import GoneError
 from .meta import KubeObject
-from .store import ApiServer, WatchEvent
+from .store import ApiServer, EventType, WatchEvent
 
 logger = logging.getLogger("kubeflow_tpu.kube")
 
@@ -54,6 +63,42 @@ class Reconciler(Protocol):
 Predicate = Callable[[WatchEvent], bool]
 Mapper = Callable[[KubeObject], list[Request]]
 
+# metadata keys the server rewrites on every store commit; a delta confined
+# to these (plus status) is a self-inflicted status write, not user intent
+_SERVER_META_KEYS = ("resourceVersion", "managedFields", "generation")
+
+
+def is_status_only_update(ev: WatchEvent) -> bool:
+    """True for MODIFIED events whose old→new delta is confined to `status`
+    and server-managed metadata.  Only decidable when the event carries its
+    pre-update state (`prev` — the in-memory watch cache provides it; a
+    real-cluster informer does not, and the predicate then passes
+    everything, which is merely chatty, never incorrect)."""
+    if ev.type is not EventType.MODIFIED or ev.prev is None:
+        return False
+
+    def strip(obj: KubeObject) -> dict:
+        d = obj.to_dict()
+        d.pop("status", None)
+        meta = d.get("metadata")
+        if isinstance(meta, dict):
+            for k in _SERVER_META_KEYS:
+                meta.pop(k, None)
+        return d
+
+    return strip(ev.prev) == strip(ev.obj)
+
+
+def suppress_status_only(ev: WatchEvent) -> bool:
+    """for_predicate that drops self-inflicted status-only updates: a
+    controller that writes its primary's status must not be re-triggered by
+    that very write, or a converged fleet never reaches a zero-reconcile
+    steady state.  Only correct on kinds whose status THIS manager's
+    controllers write (the Notebook CR) — an owned workload's status
+    (StatefulSet readyReplicas) is data-plane truth the reconciler needs,
+    and those arrive via Owns/Watches wiring, not the for_kind path."""
+    return not is_status_only_update(ev)
+
 
 @dataclass
 class WatchSpec:
@@ -70,6 +115,9 @@ class _Registration:
     owns: list[str] = field(default_factory=list)
     watches: list[WatchSpec] = field(default_factory=list)
     max_retries: int = 5
+    # event filter on the primary kind (controller-runtime WithEventFilter
+    # scoped to For); suppress_status_only is the canonical instance
+    for_predicate: Optional[Predicate] = None
 
 
 @dataclass(order=True)
@@ -255,22 +303,49 @@ class Manager:
 
     Tests drive it with `run_until_idle()` (drains the workqueue, honoring
     requeue-after via the injected clock when `advance_clock=True`);
-    standalone mode uses `start()` which spins a worker thread.
+    standalone mode uses `start()` which spins `workers` worker threads.
+
+    Parallelism follows controller-runtime's workqueue contract: up to
+    `workers` requests process concurrently, but never two for the same
+    (controller, request) key — an event for an in-flight key parks in the
+    dirty set and re-queues when the running reconcile completes.  Popping
+    is round-robin across controllers so one hot controller cannot starve
+    the rest.  `workers` defaults to the WORKQUEUE_WORKERS env var (1 when
+    unset); `run_until_idle` uses the same pool, processing per-batch with
+    a barrier so FakeClock advancement stays single-threaded.
     """
 
     def __init__(self, api: ApiServer, clock: Optional[Clock] = None,
                  rate_limiter=None, registry: Optional[Registry] = None,
-                 flight_recorder: Optional[FlightRecorder] = None) -> None:
+                 flight_recorder: Optional[FlightRecorder] = None,
+                 workers: Optional[int] = None,
+                 cache: Optional[InformerCache] = None) -> None:
         self.api = api
         self.clock = clock or Clock()
+        if workers is None:
+            try:
+                workers = int(os.environ.get("WORKQUEUE_WORKERS", "") or 1)
+            except ValueError:
+                workers = 1
+        self.workers = max(1, workers)
         # bounded in-process history of completed reconcile attempts, fed
         # with each attempt's finished root span (/debug/reconciles reads it)
         self.flight_recorder = flight_recorder or FlightRecorder()
         self._limiter = rate_limiter or default_rate_limiter(self.clock)
         self._registrations: list[_Registration] = []
         self._lock = threading.Lock()
-        self._queue: list[tuple[str, Request]] = []
+        # per-controller FIFO deques, popped round-robin (fairness across
+        # registrations); _queued is the dirty set — the single source of
+        # truth for "this key has pending work"
+        self._queues: dict[str, deque[tuple[str, Request]]] = {}
         self._queued: set[tuple[str, Request]] = set()
+        # keys currently being reconciled (per-key serialization): an
+        # event for one of these parks in _queued and re-queues on _done
+        self._processing: set[tuple[str, Request]] = set()
+        # clock time each in-flight key started processing, feeding
+        # workqueue_longest_running_processor_seconds
+        self._inflight_started: dict[tuple[str, Request], float] = {}
+        self._rr_cursor = 0  # round-robin position over registrations
         self._delayed: list[_Delayed] = []
         self._retries: dict[tuple[str, Request], int] = {}
         self._errors: list[tuple[str, Request, BaseException]] = []
@@ -300,6 +375,13 @@ class Manager:
             "workqueue_work_duration_seconds",
             "How long processing a request from the workqueue takes",
             labels=("controller",))
+        # indexed informer cache: the reconcilers' read path (hot-path
+        # lookups go through registered indexes instead of api.list scans);
+        # subscribes to the same watch stream as the manager, BEFORE the
+        # manager's own session so an event's cache update is visible by
+        # the time its reconcile request can possibly run
+        self.cache = cache if cache is not None else \
+            InformerCache(api, registry=self.metrics_registry)
         # enqueue timestamps feeding workqueue_queue_duration_seconds
         self._enqueued_at: dict[tuple[str, Request], float] = {}
         # one trace per retry chain: trace id held until the request
@@ -309,7 +391,7 @@ class Manager:
         self._attempt_seq: dict[tuple[str, Request], int] = {}
         self._stop = threading.Event()
         self._started = False
-        self._thread: Optional[threading.Thread] = None
+        self._threads: list[threading.Thread] = []
         if hasattr(api, "subscribe"):
             # in-memory ApiServer: a resumable session that survives
             # injected watch-stream drops (kube.faults)
@@ -334,6 +416,7 @@ class Manager:
         owns: Optional[list[str]] = None,
         watches: Optional[list[WatchSpec]] = None,
         max_retries: int = 5,
+        for_predicate: Optional[Predicate] = None,
     ) -> None:
         self._registrations.append(
             _Registration(
@@ -343,8 +426,11 @@ class Manager:
                 owns=owns or [],
                 watches=watches or [],
                 max_retries=max_retries,
+                for_predicate=for_predicate,
             )
         )
+        with self._lock:
+            self._queues.setdefault(name, deque())
 
     def unregister(self, name: str) -> None:
         """Remove a controller and drop its queued/delayed work.  An
@@ -353,7 +439,7 @@ class Manager:
         with self._lock:
             self._registrations = [
                 r for r in self._registrations if r.name != name]
-            self._queue = [k for k in self._queue if k[0] != name]
+            self._queues.pop(name, None)
             self._queued = {k for k in self._queued if k[0] != name}
             self._delayed = [d for d in self._delayed if d.reg_name != name]
             # retry budgets AND rate-limiter history die with the controller
@@ -377,6 +463,8 @@ class Manager:
     def _requests_for(self, reg: _Registration, ev: WatchEvent) -> list[Request]:
         obj = ev.obj
         if obj.kind == reg.for_kind:
+            if reg.for_predicate is not None and not reg.for_predicate(ev):
+                return []
             return [Request(obj.namespace, obj.name)]
         if obj.kind in reg.owns:
             ref = obj.metadata.controller_owner()
@@ -396,12 +484,20 @@ class Manager:
                  enqueued_at: Optional[float] = None) -> None:
         with self._lock:
             key = (reg_name, req)
-            if key not in self._queued:
-                self._queued.add(key)
-                self._queue.append(key)
-                self._enqueued_at.setdefault(
-                    key,
-                    self.clock.now() if enqueued_at is None else enqueued_at)
+            if key in self._queued:
+                return
+            queue = self._queues.get(reg_name)
+            if queue is None:
+                return  # controller unregistered; drop
+            self._queued.add(key)
+            # per-key serialization: a key being processed is PARKED (dirty
+            # only) — _done re-queues it when the running reconcile ends,
+            # so no worker ever processes the same key concurrently
+            if key not in self._processing:
+                queue.append(key)
+            self._enqueued_at.setdefault(
+                key,
+                self.clock.now() if enqueued_at is None else enqueued_at)
 
     def enqueue(self, reg_name: str, req: Request) -> None:
         """Manual enqueue (tests, resync ticks)."""
@@ -419,30 +515,65 @@ class Manager:
         return sorted(kinds)
 
     def enqueue_all(self, reg_name: Optional[str] = None) -> None:
-        """Resync: enqueue every existing primary object (informer re-list)."""
+        """Resync: enqueue every existing primary object (informer
+        re-list).  Reads the informer cache — key materialization only,
+        no apiserver round trip, no per-object deepcopy — and the dirty
+        set dedupes against work already queued or in flight."""
+        if self.cache is not None:
+            self.cache.ensure_connected()
         for reg in self._registrations:
             if reg_name is not None and reg.name != reg_name:
                 continue
-            for obj in self.api.list(reg.for_kind):
-                self._enqueue(reg.name, Request(obj.namespace, obj.name))
+            if self.cache is not None:
+                keys = self.cache.keys(reg.for_kind)
+            else:
+                keys = [(o.namespace, o.name)
+                        for o in self.api.list(reg.for_kind)]
+            for ns, name in keys:
+                self._enqueue(reg.name, Request(ns, name))
 
     # -- execution ------------------------------------------------------------
     def _pop(self) -> Optional[tuple[str, Request]]:
         with self._lock:
-            if not self._queue:
+            # fairness: rotate over registrations so one chatty controller
+            # cannot starve the others' queues
+            names = [r.name for r in self._registrations]
+            key = None
+            for off in range(len(names)):
+                name = names[(self._rr_cursor + off) % len(names)]
+                queue = self._queues.get(name)
+                if queue:
+                    key = queue.popleft()
+                    self._rr_cursor = (self._rr_cursor + off + 1) % len(names)
+                    break
+            if key is None:
                 return None
-            key = self._queue.pop(0)
             self._queued.discard(key)
+            self._processing.add(key)
+            self._inflight_started[key] = self.clock.now()
             enqueued_at = self._enqueued_at.pop(key, None)
+            tid = self._trace_ids.get(key, "")
         if enqueued_at is not None:
             # a retry's queue wait belongs to its live retry chain: exemplar
             # the observation with that trace so a fat queue-duration bucket
             # links straight to the backoff timeline that caused it
-            tid = self._trace_ids.get(key, "")
             self.queue_duration.labels(key[0]).observe(
                 max(self.clock.now() - enqueued_at, 0.0),
                 exemplar={"trace_id": tid} if tid else None)
         return key
+
+    def _done(self, key: tuple[str, Request]) -> None:
+        """Finish processing `key`: release the per-key slot and re-queue
+        it when events parked on it while it ran."""
+        with self._lock:
+            self._processing.discard(key)
+            self._inflight_started.pop(key, None)
+            if key in self._queued:
+                queue = self._queues.get(key[0])
+                if queue is not None:
+                    queue.append(key)
+                else:
+                    self._queued.discard(key)
 
     def _promote_delayed(self) -> None:
         now = self.clock.now()
@@ -453,19 +584,35 @@ class Manager:
             self._enqueue(d.reg_name, d.request,
                           enqueued_at=d.enqueued_at or None)
 
-    def _process_one(self) -> bool:
+    def _ensure_sources(self) -> None:
+        """Lazily reconnect dropped watch sessions (the cache FIRST, so a
+        reconcile popped right after never reads state older than the event
+        stream that will re-trigger it)."""
+        if self.cache is not None:
+            self.cache.ensure_connected()
         if self._watch_session is not None and \
                 not self._watch_session.connected:
             self._watch_session.reconnect()
+
+    def _process_one(self) -> bool:
+        self._ensure_sources()
         self._promote_delayed()
         item = self._pop()
         if item is None:
             return False
+        try:
+            self._process_item(item)
+        finally:
+            self._done(item)
+        return True
+
+    def _process_item(self, item: tuple[str, Request]) -> None:
+        """Reconcile one popped request (the caller owns _pop/_done)."""
         reg_name, req = item
         reg = next((r for r in self._registrations if r.name == reg_name),
                    None)
         if reg is None:
-            return True  # unregistered while queued: drop the item
+            return  # unregistered while queued: drop the item
 
         def alive() -> bool:
             # unregister() may run DURING the reconcile; its queue/retry
@@ -477,9 +624,15 @@ class Manager:
         # attempt numbering + trace identity: every attempt of one retry
         # chain (error backoff / requeue=True) shares a trace id, so a
         # chaos-soak trace shows which injected fault hit which attempt
-        attempt = self._attempt_seq.get(item, 0) + 1
-        self._attempt_seq[item] = attempt
+        with self._lock:
+            attempt = self._attempt_seq.get(item, 0) + 1
+            self._attempt_seq[item] = attempt
         start = self.clock.now()
+        # monotonic wall-time stamps ride the root span into the flight
+        # recorder: under a FakeClock every attempt collapses to the same
+        # instant, so per-key serialization (attempt windows never
+        # overlapping for one key) is only checkable against real time
+        mono_start = time.monotonic()
         outcome = "error"
         root_span: Optional[tracing.Span] = None
         try:
@@ -505,10 +658,11 @@ class Manager:
                     else:
                         outcome = "success"
                     span.set_attribute("reconcile.result", outcome)
-                    self._retries.pop(item, None)
+                    with self._lock:
+                        self._retries.pop(item, None)
                     if not alive():
                         self._clear_request_trace(item)
-                        return True
+                        return
                     if result.requeue_after > 0:
                         # explicit schedule: Forget (controller-runtime does
                         # on RequeueAfter) and wait out the caller's delay
@@ -536,9 +690,10 @@ class Manager:
                     })
                     if not alive():
                         self._clear_request_trace(item)
-                        return True
-                    count = self._retries.get(item, 0) + 1
-                    self._retries[item] = count
+                        return
+                    with self._lock:
+                        count = self._retries.get(item, 0) + 1
+                        self._retries[item] = count
                     if count <= reg.max_retries:
                         delay = self._requeue_rate_limited(item)
                         logger.warning(
@@ -551,9 +706,10 @@ class Manager:
                             "reconcile %s %s dropped after %d attempts:\n%s",
                             reg_name, req, count, traceback.format_exc(),
                         )
-                        self._errors.append((reg_name, req, err))
-                        # fresh budget for future events
-                        self._retries.pop(item, None)
+                        with self._lock:
+                            self._errors.append((reg_name, req, err))
+                            # fresh budget for future events
+                            self._retries.pop(item, None)
                         self._limiter.forget(item)
                         self._clear_request_trace(item)
         finally:
@@ -569,19 +725,24 @@ class Manager:
                                                         exemplar=ex)
             self.reconcile_total.labels(reg_name, outcome).inc()
             if root_span is not None:
+                # real-time execution window for the flight recorder's
+                # per-key overlap check (set after export on purpose:
+                # diagnostic bookkeeping, not trace payload)
+                root_span.set_attribute("mono_start", mono_start)
+                root_span.set_attribute("mono_end", time.monotonic())
                 try:
                     self.flight_recorder.record(root_span)
                 except Exception:  # noqa: BLE001 — observability must
                     # never take the reconcile loop down with it
                     logger.exception("flight recorder rejected a span")
-        return True
 
     def _clear_request_trace(self, item: tuple[str, Request]) -> None:
         """The retry chain for this request is over (success, scheduled
         requeue_after, drop, or unregister): the next event starts a fresh
         trace with attempt 1."""
-        self._trace_ids.pop(item, None)
-        self._attempt_seq.pop(item, None)
+        with self._lock:
+            self._trace_ids.pop(item, None)
+            self._attempt_seq.pop(item, None)
 
     def _requeue_rate_limited(self, item: tuple[str, Request]) -> float:
         """Re-enqueue through the workqueue rate limiter: per-item
@@ -600,6 +761,50 @@ class Manager:
                          enqueued_at=self.clock.now()))
         return delay
 
+    def _drain_step(self) -> int:
+        """One drain step: process up to `workers` distinct-key requests —
+        concurrently when workers > 1 — and return how many ran.  The
+        per-batch barrier keeps clock advancement (run_until_idle/settle)
+        single-threaded: no worker is mid-reconcile while the FakeClock
+        jumps over a backoff window."""
+        self._ensure_sources()
+        self._promote_delayed()
+        batch: list[tuple[str, Request]] = []
+        while len(batch) < self.workers:
+            item = self._pop()
+            if item is None:
+                break
+            batch.append(item)
+        if not batch:
+            return 0
+        if len(batch) == 1:
+            item = batch[0]
+            try:
+                self._process_item(item)
+            finally:
+                self._done(item)
+            return 1
+        threads = [
+            threading.Thread(target=self._run_item, args=(it,),
+                             name=f"kube-worker-{i}", daemon=True)
+            for i, it in enumerate(batch)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(batch)
+
+    def _run_item(self, item: tuple[str, Request]) -> None:
+        try:
+            self._process_item(item)
+        except Exception:  # noqa: BLE001 — same contract as the start()
+            # loop: a bookkeeping bug must not strand the batch barrier
+            logger.exception("worker failed outside the reconcile handler; "
+                             "continuing")
+        finally:
+            self._done(item)
+
     def run_until_idle(self, max_iterations: int = 10_000,
                        advance_clock: bool = True) -> int:
         """Drain the workqueue; returns number of reconciles executed.
@@ -610,12 +815,14 @@ class Manager:
         stays real AND deterministic.  requeue_after schedules are NOT
         auto-advanced — use `advance(seconds)` to move the clock and
         re-drain those, or pass advance_clock=False to observe pending
-        backoff state."""
+        backoff state.  With `workers > 1` each step runs a batch of
+        distinct-key requests concurrently (see _drain_step)."""
         n = 0
         adv = getattr(self.clock, "advance", None)
         while True:
-            if self._process_one():
-                n += 1
+            ran = self._drain_step()
+            if ran:
+                n += ran
                 if n >= max_iterations:
                     raise RuntimeError(
                         "run_until_idle: reconcile loop did not settle")
@@ -629,7 +836,7 @@ class Manager:
             delta = min(retry_due) - self.clock.now()
             if delta > 0:
                 adv(delta)
-            # loop: _process_one promotes the now-due retries
+            # loop: the next drain step promotes the now-due retries
         return n
 
     def advance(self, seconds: float) -> int:
@@ -676,9 +883,8 @@ class Manager:
         pending backoff count, scheduled-retry totals, last backoff delay,
         and dropped-error counts."""
         with self._lock:
-            depth: dict[str, int] = {}
-            for reg_name, _ in self._queue:
-                depth[reg_name] = depth.get(reg_name, 0) + 1
+            depth: dict[str, int] = {
+                name: len(q) for name, q in self._queues.items() if q}
             backoff_pending: dict[str, int] = {}
             for d in self._delayed:
                 if d.retry:
@@ -687,12 +893,19 @@ class Manager:
             errors: dict[str, int] = {}
             for reg_name, _, _ in self._errors:
                 errors[reg_name] = errors.get(reg_name, 0) + 1
+            now = self.clock.now()
+            longest: dict[str, float] = {}
+            for (reg_name, _), started in self._inflight_started.items():
+                age = max(now - started, 0.0)
+                if age > longest.get(reg_name, -1.0):
+                    longest[reg_name] = age
             return {
                 "depth": depth,
                 "backoff_pending": backoff_pending,
                 "retries_total": dict(self._retry_totals),
                 "last_backoff_s": dict(self._last_backoff),
                 "errors_total": errors,
+                "longest_running_s": longest,
                 "controllers": [r.name for r in self._registrations],
             }
 
@@ -714,7 +927,12 @@ class Manager:
                     {"controller": k[0], "object": obj(k[1]),
                      "queued_for_s": max(
                          now - self._enqueued_at.get(k, now), 0.0)}
-                    for k in self._queue
+                    for q in self._queues.values() for k in q
+                ],
+                "processing": [
+                    {"controller": k[0], "object": obj(k[1]),
+                     "running_for_s": max(now - started, 0.0)}
+                    for k, started in sorted(self._inflight_started.items())
                 ],
                 "delayed": [
                     {"controller": d.reg_name, "object": obj(d.request),
@@ -727,7 +945,7 @@ class Manager:
                     for k, v in sorted(self._retries.items(),
                                        key=lambda kv: -kv[1])
                 ],
-                "depth": len(self._queue),
+                "depth": sum(len(q) for q in self._queues.values()),
                 "backoff_pending": sum(1 for d in self._delayed if d.retry),
             }
 
@@ -756,6 +974,11 @@ class Manager:
 
     # -- standalone threaded mode ---------------------------------------------
     def start(self, poll_interval_s: float = 0.05) -> None:
+        """Spawn `workers` worker threads, each popping from the shared
+        workqueue.  Per-key serialization holds across workers (an
+        in-flight key parks instead of double-dispatching), so raising
+        WORKQUEUE_WORKERS scales throughput without relaxing the
+        one-reconcile-per-object invariant."""
         def loop() -> None:
             while not self._stop.is_set():
                 try:
@@ -770,8 +993,13 @@ class Manager:
                 if not busy:
                     self._stop.wait(poll_interval_s)
 
-        self._thread = threading.Thread(target=loop, daemon=True, name="kube-manager")
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=loop, daemon=True,
+                             name=f"kube-manager-{i}")
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
         self._started = True
 
     def stop(self) -> None:
@@ -779,9 +1007,11 @@ class Manager:
         # a reconciler may request shutdown from the worker thread itself
         # (e.g. the TLS-profile watcher); joining the current thread would
         # raise, and the loop exits on the event anyway
-        if self._thread is not None and self._thread is not threading.current_thread():
-            self._thread.join(timeout=5)
-            self._thread = None
+        me = threading.current_thread()
+        for t in self._threads:
+            if t is not me:
+                t.join(timeout=5)
+        self._threads = []
 
     @property
     def stopped(self) -> bool:

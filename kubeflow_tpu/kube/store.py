@@ -128,6 +128,10 @@ class ApiServer:
         # shares the depth gate with fault injection, so only controller
         # traffic is recorded — never the store's own re-entry
         self._audit_log: deque[AuditRecord] = deque(maxlen=8192)
+        # per-(verb, kind) counters over ALL top-level client verbs, reads
+        # included (the audit log keeps write detail; these stay O(verbs x
+        # kinds) so a load test can budget total API traffic cheaply)
+        self._verb_counts: dict[tuple[str, str], int] = {}
 
     # -- fault injection ------------------------------------------------------
     def install_fault_plan(self, plan) -> None:
@@ -165,6 +169,10 @@ class ApiServer:
         self._fault_ctx.depth = depth + 1
         audited = depth == 0 and verb in ("create", "update", "patch",
                                           "delete")
+        if depth == 0:
+            with self._lock:
+                key = (verb, kind)
+                self._verb_counts[key] = self._verb_counts.get(key, 0) + 1
         try:
             directives = None
             if depth == 0 and self._fault_plan is not None:
@@ -207,6 +215,18 @@ class ApiServer:
     def clear_audit_log(self) -> None:
         with self._lock:
             self._audit_log.clear()
+
+    def verb_counts(self) -> dict[tuple[str, str], int]:
+        """Cumulative (verb, kind) -> count over every top-level client
+        call, reads included.  The loadtest convergence benchmark budgets
+        API traffic against this; `fault_exempt` harness calls and internal
+        re-entry are never counted."""
+        with self._lock:
+            return dict(self._verb_counts)
+
+    def clear_verb_counts(self) -> None:
+        with self._lock:
+            self._verb_counts.clear()
 
     def drop_watch_connections(self) -> int:
         """Disconnect every RESUMABLE watcher (one with an
@@ -656,6 +676,13 @@ class ApiServer:
             if view_in is not None:
                 merged = view_in(merged)
             merged.metadata.resource_version = current.metadata.resource_version
+            if merged.to_dict() == current.to_dict():
+                # semantic no-op apply (apply_update preserved the
+                # managedFields timestamp for the unchanged field set):
+                # skip the write path entirely — no admission callout, no
+                # RV bump, no watch wakeup.  A GitOps loop re-applying the
+                # same config on a timer costs the cluster nothing.
+                return (current, False) if return_created else current
             try:
                 updated = self.update(merged)
                 return (updated, False) if return_created else updated

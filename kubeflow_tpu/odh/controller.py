@@ -24,6 +24,7 @@ from ..kube import (
     Result,
     WatchSpec,
     retry_on_conflict,
+    suppress_status_only,
 )
 from ..utils import tracing
 from ..utils.config import OdhConfig
@@ -255,6 +256,17 @@ def setup_odh_controllers(
     api.register_admission(NotebookMutatingWebhook(api, cfg).hook())
     api.register_admission(NotebookValidatingWebhook(api, cfg).hook())
 
+    # fleet sweeps in the fan-out mappers below read the informer cache's
+    # namespace index instead of live-listing every Notebook per event
+    cache = mgr.cache
+    if cache is not None:
+        cache.add_namespace_index("Notebook")
+
+    def list_notebooks(namespace: str) -> list[KubeObject]:
+        if cache is not None:
+            return cache.list("Notebook", namespace=namespace)
+        return api.list("Notebook", namespace=namespace)
+
     def httproute_to_request(route: KubeObject) -> list[Request]:
         name = route.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
         namespace = route.metadata.labels.get(C.NOTEBOOK_NAMESPACE_LABEL)
@@ -278,13 +290,13 @@ def setup_odh_controllers(
             return []
         return [
             Request(n.namespace, n.name)
-            for n in api.list("Notebook", namespace=cm.namespace)
+            for n in list_notebooks(cm.namespace)
         ]
 
     def referencegrant_to_requests(grant: KubeObject) -> list[Request]:
         if grant.name != C.REFERENCEGRANT_NAME:
             return []
-        notebooks = api.list("Notebook", namespace=grant.namespace)
+        notebooks = list_notebooks(grant.namespace)
         return [Request(n.namespace, n.name) for n in notebooks[:1]]
 
     mgr.register(
@@ -303,5 +315,8 @@ def setup_odh_controllers(
             WatchSpec(kind="ReferenceGrant", mapper=referencegrant_to_requests),
             WatchSpec(kind="ConfigMap", mapper=configmap_to_requests),
         ],
+        # the odh reconciler never reads Notebook status; the core
+        # controller's status writes must not re-run the routing/auth pass
+        for_predicate=suppress_status_only,
     )
     return rec

@@ -81,6 +81,9 @@ class CoreConfig:
     workqueue_max_delay_s: float = 1000.0   # WORKQUEUE_MAX_DELAY_S
     workqueue_qps: float = 10.0             # WORKQUEUE_QPS
     workqueue_burst: int = 100              # WORKQUEUE_BURST
+    # parallel reconcile workers (controller-runtime MaxConcurrentReconciles
+    # analog, shared across controllers): per-key serialization always holds
+    workqueue_workers: int = 1              # WORKQUEUE_WORKERS
     # slice-atomic self-healing (core.selfheal): budgeted recovery of
     # disrupted TPU slices.  Backoff between slice restarts is exponential
     # (base * 2^n, capped); at most recovery_max_attempts restarts within a
@@ -114,6 +117,7 @@ class CoreConfig:
                 _int(env, "WORKQUEUE_MAX_DELAY_S", 1000)),
             workqueue_qps=float(_int(env, "WORKQUEUE_QPS", 10)),
             workqueue_burst=_int(env, "WORKQUEUE_BURST", 100),
+            workqueue_workers=max(1, _int(env, "WORKQUEUE_WORKERS", 1)),
             enable_self_healing=_bool(env, "ENABLE_SELF_HEALING", True),
             recovery_backoff_base_s=float(
                 _int(env, "RECOVERY_BACKOFF_BASE_S", 10)),

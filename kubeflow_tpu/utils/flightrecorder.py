@@ -95,6 +95,11 @@ class AttemptRecord:
     span_id: str = ""
     error: str = ""           # "ExceptionType: message" for errored attempts
     faults: list[dict] = field(default_factory=list)  # fault.injected events
+    # real (monotonic) execution window stamped by the Manager: span times
+    # follow the injected clock, which stands still during a FakeClock run,
+    # so per-key serialization can only be audited against wall time
+    mono_start: float = 0.0
+    mono_end: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -175,6 +180,8 @@ class FlightRecorder:
             span_id=root_span.span_id,
             error=error,
             faults=faults,
+            mono_start=float(attrs.get("mono_start", 0.0) or 0.0),
+            mono_end=float(attrs.get("mono_end", 0.0) or 0.0),
         )
         tree = span_to_dict(root_span)
         with self._lock:
@@ -232,6 +239,30 @@ class FlightRecorder:
         """Object keys with recorded history -> attempt count retained."""
         with self._lock:
             return {k: len(v) for k, v in self._by_object.items()}
+
+    def overlapping_attempts(self) -> list[tuple[AttemptRecord,
+                                                 AttemptRecord]]:
+        """Pairs of recorded attempts for the SAME (controller, object)
+        whose real-time execution windows overlap — each pair is a per-key
+        serialization violation (two workers reconciled one key at once).
+        Checked over per-object histories (bounded by per_object), using
+        the monotonic stamps the Manager rides on every root span; attempts
+        without stamps (records from before the Manager stamped them) are
+        skipped."""
+        with self._lock:
+            histories = {k: list(v) for k, v in self._by_object.items()}
+        violations: list[tuple[AttemptRecord, AttemptRecord]] = []
+        for records in histories.values():
+            per_ctrl: dict[str, list[AttemptRecord]] = {}
+            for r in records:
+                if r.mono_end > r.mono_start > 0.0:
+                    per_ctrl.setdefault(r.controller, []).append(r)
+            for runs in per_ctrl.values():
+                runs.sort(key=lambda r: r.mono_start)
+                for prev, cur in zip(runs, runs[1:]):
+                    if cur.mono_start < prev.mono_end:
+                        violations.append((prev, cur))
+        return violations
 
     def snapshot(self, object_key: Optional[str] = None) -> dict:
         """The /debug/reconciles body: bounds, totals, and the requested

@@ -1,0 +1,295 @@
+"""Convergence benchmark: N notebooks -> all Ready, deterministically.
+
+`start_notebooks.py` measures wall-clock readiness latency — useful, but
+noisy and machine-dependent, so CI cannot assert on it.  This benchmark
+measures what IS deterministic on the FakeClock: how much work the control
+plane does to converge a fleet, and whether it then goes quiet.
+
+    python loadtest/convergence.py --count 200 --compare-workers 8 \
+        --check-budget ci/apiserver_call_budget.json
+
+Per run it reports:
+  - wall time (informational only — never asserted);
+  - reconciles per notebook, per controller (Manager reconcile counters);
+  - API verbs by (verb, kind) from the ApiServer's top-level verb counters
+    (reads included; the fault-exempt FakeCluster data plane is excluded);
+  - steady-state probe: after convergence, a full resync (`enqueue_all`)
+    must complete with ZERO write verbs in the audit log — proving the
+    no-op write suppression end to end — and at most one reconcile per
+    (controller, object);
+  - per-key serialization: the flight recorder's attempt-overlap check
+    must come back empty (no two concurrent reconciles of one key).
+
+`--compare-workers W` runs the same fleet again with W parallel workers
+and asserts the normalized final cluster state (resourceVersions, uids,
+timestamps, pod IPs scrubbed; uids rewritten to stable object references)
+is identical to the single-worker run.
+
+`--check-budget FILE` compares writes-per-notebook and
+reconciles-per-notebook against the committed budget and fails on >
+`tolerance` regression — the deterministic CI perf gate.  Regenerate an
+intentionally-changed budget with `--write-budget FILE`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec  # noqa: E402
+from kubeflow_tpu.core.notebook_controller import (  # noqa: E402
+    setup_core_controllers,
+)
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager  # noqa: E402
+from kubeflow_tpu.utils.clock import FakeClock  # noqa: E402
+from kubeflow_tpu.utils.config import CoreConfig  # noqa: E402
+from kubeflow_tpu.utils.flightrecorder import FlightRecorder  # noqa: E402
+
+NAMESPACE = "loadtest"
+
+# non-deterministic or server-managed fields scrubbed before comparing the
+# final cluster state of two runs (uids are MAPPED, not dropped — ownership
+# topology must still match)
+_SCRUB_KEYS = frozenset({
+    "resourceVersion", "creationTimestamp", "managedFields",
+    "lastTransitionTime", "lastProbeTime", "startedAt", "startTime",
+    "time", "podIP",
+})
+
+
+def normalized_state(api: ApiServer) -> dict:
+    """api.dump() with volatile fields scrubbed and every uid replaced by
+    the stable identity of the object it names, so two runs of the same
+    fleet compare equal iff they converged to the same semantic state."""
+    dump = api.dump()
+    uid_names: dict[str, str] = {}
+    for kind, objs in dump.items():
+        for o in objs:
+            meta = o.get("metadata", {})
+            if meta.get("uid"):
+                uid_names[meta["uid"]] = "%s/%s/%s" % (
+                    kind, meta.get("namespace", ""), meta.get("name", ""))
+
+    def scrub(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k in _SCRUB_KEYS:
+                    continue
+                if k == "uid" and isinstance(v, str):
+                    out[k] = uid_names.get(v, v)
+                else:
+                    out[k] = scrub(v)
+            return out
+        if isinstance(node, list):
+            return [scrub(x) for x in node]
+        return node
+
+    out = {}
+    for kind, objs in sorted(dump.items()):
+        if kind == "Event":
+            continue  # event names/counts are sequencing artifacts
+        out[kind] = sorted(
+            (scrub(o) for o in objs),
+            key=lambda o: (o["metadata"].get("namespace", ""),
+                           o["metadata"]["name"]))
+    return out
+
+
+def _reconciles_per_controller(mgr: Manager) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for key, v in mgr.reconcile_total.collect().items():
+        out[key[0]] = out.get(key[0], 0) + int(v)
+    return out
+
+
+def run_fleet(count: int, workers: int, tpu: str = "") -> dict:
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    clock = FakeClock()
+    recorder = FlightRecorder(capacity=max(4096, count * 8),
+                              max_objects=max(2048, count * 4))
+    mgr = Manager(api, clock=clock, workers=workers,
+                  flight_recorder=recorder)
+    cfg = CoreConfig.from_env({})  # hermetic: culling off, defaults only
+    setup_core_controllers(mgr, cfg)
+
+    spec = None
+    if tpu:
+        accel, topology = tpu.split(":")
+        spec = TPUSpec(accel, topology)
+        shape = spec.validate()
+        cluster.add_tpu_slice_nodes(
+            shape.accelerator.gke_label, shape.topology,
+            shape.num_hosts * count, shape.chips_per_host)
+    cluster.add_node("cpu-node", allocatable={"cpu": str(count * 8),
+                                              "memory": "8192Gi"})
+    expected_ready = spec.shape.num_hosts if spec else 1
+
+    api.clear_audit_log()
+    api.clear_verb_counts()
+    t0 = time.perf_counter()
+    for i in range(count):
+        api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE, tpu=spec).obj)
+    rollout_reconciles_total = mgr.settle(max_seconds=7200.0)
+    wall_s = time.perf_counter() - t0
+
+    not_ready = []
+    for i in range(count):
+        status = api.get("Notebook", NAMESPACE,
+                         f"nb-{i:04d}").body.get("status") or {}
+        if status.get("readyReplicas") != expected_ready:
+            not_ready.append(f"nb-{i:04d}")
+    if not_ready:
+        raise AssertionError(
+            f"{len(not_ready)} notebooks never converged "
+            f"(first: {not_ready[:3]})")
+    if mgr.dropped_errors:
+        raise AssertionError(f"retry budget exhausted: {mgr.dropped_errors}")
+
+    rollout_reconciles = _reconciles_per_controller(mgr)
+    rollout_verbs = {f"{verb}:{kind}": n
+                     for (verb, kind), n in sorted(api.verb_counts().items())}
+    rollout_writes: dict[str, int] = {}
+    for rec in api.audit_log(ok=True):
+        rollout_writes[rec.kind] = rollout_writes.get(rec.kind, 0) + 1
+
+    # steady-state probe: a full resync of a converged fleet must be
+    # all-reads — zero write verbs (audit log is the proof) — and at most
+    # one reconcile per (controller, object) since nothing re-triggers
+    audit_before = len(api.audit_log())
+    api.clear_verb_counts()
+    before = _reconciles_per_controller(mgr)
+    mgr.enqueue_all()
+    mgr.settle(max_seconds=7200.0)
+    after = _reconciles_per_controller(mgr)
+    steady_writes = api.audit_log()[audit_before:]
+    if steady_writes:
+        first = steady_writes[0]
+        raise AssertionError(
+            f"{len(steady_writes)} write verbs issued by a converged fleet "
+            f"(first: {first.verb} {first.kind} "
+            f"{first.namespace}/{first.name})")
+    steady_reconciles = {c: after.get(c, 0) - before.get(c, 0) for c in after}
+    for controller, n in steady_reconciles.items():
+        if n > count:
+            raise AssertionError(
+                f"steady-state resync re-reconciled {controller} {n} times "
+                f"for {count} objects — the fleet is not quiet")
+
+    overlaps = recorder.overlapping_attempts()
+    if overlaps:
+        a, b = overlaps[0]
+        raise AssertionError(
+            f"per-key serialization violated: {len(overlaps)} overlapping "
+            f"attempt pairs (first: {a.controller} {a.object_key})")
+
+    state = normalized_state(api)
+    mgr.stop()
+    return {
+        "count": count,
+        "workers": workers,
+        "tpu": tpu or "cpu",
+        "wall_s": round(wall_s, 3),
+        "rollout_reconciles_total": rollout_reconciles_total,
+        "reconciles_per_notebook": {
+            c: round(n / count, 3) for c, n in rollout_reconciles.items()},
+        "writes_per_notebook": {
+            k: round(n / count, 3) for k, n in sorted(rollout_writes.items())},
+        "api_verbs": rollout_verbs,
+        "steady_reconciles": steady_reconciles,
+        "steady_write_verbs": 0,
+        "cache": mgr.cache.stats() if mgr.cache is not None else {},
+        "_state": state,
+    }
+
+
+def check_budget(result: dict, budget: dict) -> list[str]:
+    """Failures (empty = within budget).  A measurement may regress at
+    most `tolerance` (fraction) over the committed per-notebook budget."""
+    tol = 1.0 + float(budget.get("tolerance", 0.10))
+    failures = []
+    for kind, allowed in budget.get("writes_per_notebook", {}).items():
+        got = result["writes_per_notebook"].get(kind, 0.0)
+        if got > allowed * tol:
+            failures.append(
+                f"writes/notebook[{kind}]: {got} > {allowed} (+{tol - 1:.0%})")
+    for ctrl, allowed in budget.get("reconciles_per_notebook", {}).items():
+        got = result["reconciles_per_notebook"].get(ctrl, 0.0)
+        if got > allowed * tol:
+            failures.append(
+                f"reconciles/notebook[{ctrl}]: {got} > {allowed} "
+                f"(+{tol - 1:.0%})")
+    hard_cap = budget.get("max_reconciles_per_notebook")
+    if hard_cap is not None:
+        got = result["reconciles_per_notebook"].get("notebook", 0.0)
+        if got > hard_cap:
+            failures.append(
+                f"reconciles/notebook[notebook]: {got} > hard cap {hard_cap}")
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-l", "--count", type=int, default=200)
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--compare-workers", type=int, default=0,
+                        help="re-run with N workers and require an "
+                        "identical normalized final state")
+    parser.add_argument("--tpu", default="",
+                        help="accelerator:topology, e.g. v5e:2x4 "
+                        "(default CPU)")
+    parser.add_argument("--check-budget", default="",
+                        help="budget JSON; fail on >tolerance regression")
+    parser.add_argument("--write-budget", default="",
+                        help="write the measured result as the new budget")
+    args = parser.parse_args(argv)
+
+    result = run_fleet(args.count, args.workers, tpu=args.tpu)
+    state = result.pop("_state")
+    rc = 0
+
+    if args.compare_workers:
+        other = run_fleet(args.count, args.compare_workers, tpu=args.tpu)
+        other_state = other.pop("_state")
+        result["compare"] = {
+            "workers": other["workers"],
+            "wall_s": other["wall_s"],
+            "reconciles_per_notebook": other["reconciles_per_notebook"],
+            "state_identical": other_state == state,
+        }
+        if other_state != state:
+            print("FAIL: final cluster state differs between "
+                  f"{args.workers}-worker and {args.compare_workers}-worker "
+                  "runs", file=sys.stderr)
+            rc = 1
+
+    if args.check_budget:
+        budget = json.loads(Path(args.check_budget).read_text())
+        failures = check_budget(result, budget)
+        result["budget_ok"] = not failures
+        if failures:
+            for f in failures:
+                print(f"BUDGET FAIL: {f}", file=sys.stderr)
+            rc = 1
+
+    if args.write_budget:
+        Path(args.write_budget).write_text(json.dumps({
+            "notebooks": args.count,
+            "tolerance": 0.10,
+            "max_reconciles_per_notebook": 3.0,
+            "reconciles_per_notebook": result["reconciles_per_notebook"],
+            "writes_per_notebook": result["writes_per_notebook"],
+        }, indent=2, sort_keys=True) + "\n")
+
+    print(json.dumps(result))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,225 @@
+"""InformerCache correctness (kube/cache.py): index maintenance across
+add/update/delete, watch relist (410 Gone), leader failover, and the
+label-selector index vs brute-force equivalence on randomized fixtures."""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.kube import (
+    ApiServer,
+    InformerCache,
+    KubeObject,
+    ObjectMeta,
+    set_controller_reference,
+)
+from kubeflow_tpu.utils.metrics import Registry
+
+
+def mk(kind, ns, name, labels=None, spec=None):
+    return KubeObject(
+        "v1", kind,
+        ObjectMeta(name=name, namespace=ns, labels=dict(labels or {})),
+        body={"spec": dict(spec or {})})
+
+
+def fresh(api=None, registry=None):
+    api = api or ApiServer()
+    cache = InformerCache(api, registry=registry)
+    cache.add_namespace_index("ConfigMap")
+    cache.add_label_index("Pod", "app")
+    cache.add_owner_uid_index("Pod")
+    return api, cache
+
+
+class TestIndexMaintenance:
+    def test_add_update_delete_consistency(self):
+        api, cache = fresh()
+        api.create(mk("Pod", "ns1", "p1", labels={"app": "a"}))
+        api.create(mk("Pod", "ns1", "p2", labels={"app": "b"}))
+        assert [p.name for p in cache.select("Pod", "ns1", {"app": "a"})] \
+            == ["p1"]
+        # update moves the object between index buckets
+        live = api.get("Pod", "ns1", "p1")
+        live.metadata.labels["app"] = "b"
+        api.update(live)
+        assert cache.select("Pod", "ns1", {"app": "a"}) == []
+        assert sorted(p.name for p in cache.select(
+            "Pod", "ns1", {"app": "b"})) == ["p1", "p2"]
+        # delete drops it from every index
+        api.delete("Pod", "ns1", "p2")
+        assert [p.name for p in cache.select("Pod", "ns1", {"app": "b"})] \
+            == ["p1"]
+        assert cache.get("Pod", "ns1", "p2") is None
+
+    def test_owner_uid_index_tracks_owner(self):
+        api, cache = fresh()
+        owner = api.create(mk("Notebook", "ns1", "nb"))
+        pod = mk("Pod", "ns1", "w-0")
+        set_controller_reference(owner, pod)
+        api.create(pod)
+        api.create(mk("Pod", "ns1", "loner"))
+        got = cache.by_index("Pod", "owner-uid", owner.metadata.uid)
+        assert [p.name for p in got] == ["w-0"]
+
+    def test_by_index_unregistered_raises(self):
+        api, cache = fresh()
+        api.create(mk("Pod", "ns1", "p1"))
+        with pytest.raises(KeyError):
+            cache.by_index("Pod", "nope", "x")
+
+    def test_returns_deepcopies(self):
+        api, cache = fresh()
+        api.create(mk("Pod", "ns1", "p1", labels={"app": "a"}))
+        got = cache.get("Pod", "ns1", "p1")
+        got.metadata.labels["app"] = "mutated"
+        assert cache.get("Pod", "ns1", "p1").metadata.labels["app"] == "a"
+
+    def test_priming_sees_objects_created_before_cache(self):
+        api = ApiServer()
+        api.create(mk("Pod", "ns1", "pre", labels={"app": "a"}))
+        _, cache = fresh(api)
+        assert cache.get("Pod", "ns1", "pre") is not None
+        assert cache.keys("Pod") == [("ns1", "pre")]
+
+    def test_delete_then_recreate_inside_fanout_keeps_new_incarnation(self):
+        """A watcher registered BEFORE the cache may recreate an object
+        while the DELETED event is still fanning out (the FakeCluster
+        kubelet does exactly this for StatefulSet pods); the stale DELETED
+        must not evict the newer incarnation."""
+        api = ApiServer()
+
+        recreated = []
+
+        def recreator(ev):
+            from kubeflow_tpu.kube.store import EventType
+
+            if ev.type is EventType.DELETED and ev.obj.kind == "Pod" \
+                    and not recreated:
+                recreated.append(True)
+                api.create(mk("Pod", "ns1", ev.obj.name,
+                              labels={"app": "a"}))
+
+        api.watch(recreator)
+        _, cache = fresh(api)
+        api.create(mk("Pod", "ns1", "p1", labels={"app": "a"}))
+        api.delete("Pod", "ns1", "p1")
+        assert recreated
+        got = cache.get("Pod", "ns1", "p1")
+        assert got is not None
+        assert [p.name for p in cache.select("Pod", "ns1", {"app": "a"})] \
+            == ["p1"]
+
+
+class TestResume:
+    def test_watch_drop_resumes_from_rv(self):
+        api, cache = fresh()
+        api.create(mk("Pod", "ns1", "p1", labels={"app": "a"}))
+        assert api.drop_watch_connections() >= 1
+        assert not cache.connected
+        # events while disconnected
+        api.create(mk("Pod", "ns1", "p2", labels={"app": "a"}))
+        api.delete("Pod", "ns1", "p1")
+        cache.ensure_connected()
+        assert cache.connected and cache.relists == 0
+        assert [p.name for p in cache.select("Pod", "ns1", {"app": "a"})] \
+            == ["p2"]
+
+    def test_410_relist_rebuilds_every_primed_kind(self):
+        api, cache = fresh()
+        api.create(mk("Pod", "ns1", "p1", labels={"app": "a"}))
+        api.create(mk("ConfigMap", "ns1", "cm1"))
+        assert cache.keys("ConfigMap")  # prime both kinds
+        api.drop_watch_connections()
+        api.create(mk("Pod", "ns1", "p2", labels={"app": "b"}))
+        api.delete("ConfigMap", "ns1", "cm1")
+        api.reset_watch_history()  # compaction: resume impossible -> 410
+        cache.ensure_connected()
+        assert cache.relists == 1
+        assert cache.keys("Pod") == [("ns1", "p1"), ("ns1", "p2")]
+        assert cache.keys("ConfigMap") == []
+        assert [p.name for p in cache.select("Pod", "ns1", {"app": "b"})] \
+            == ["p2"]
+
+    def test_leader_failover_cache_matches_store(self):
+        """A new leader's freshly-built cache (prime-from-list) answers
+        identically to the deposed leader's event-fed one."""
+        api, old = fresh()
+        rng = random.Random(7)
+        for i in range(30):
+            api.create(mk("Pod", f"ns{rng.randrange(3)}", f"p{i:02d}",
+                          labels={"app": rng.choice("abc")}))
+        for i in rng.sample(range(30), 10):
+            pods = [k for k in old.keys("Pod") if k[1] == f"p{i:02d}"]
+            if pods:
+                api.delete("Pod", pods[0][0], pods[0][1])
+        _, new = fresh(api)
+        assert new.keys("Pod") == old.keys("Pod")
+        for app in "abc":
+            for ns in ("ns0", "ns1", "ns2"):
+                assert [p.name for p in new.select("Pod", ns, {"app": app})] \
+                    == [p.name for p in old.select("Pod", ns, {"app": app})]
+
+
+class TestSelectorEquivalence:
+    def test_label_index_equals_brute_force_on_random_fleet(self):
+        """The label-selector index must answer exactly what a live
+        api.list() with the same selector answers, across randomized
+        create/update/delete churn."""
+        api, cache = fresh()
+        rng = random.Random(20260804)
+        names = []
+        for step in range(300):
+            op = rng.random()
+            if op < 0.5 or not names:
+                name = f"pod-{step:03d}"
+                names.append(name)
+                api.create(mk("Pod", f"ns{rng.randrange(2)}", name,
+                              labels={"app": rng.choice("abcd"),
+                                      "tier": rng.choice("xy")}))
+            elif op < 0.8:
+                name = rng.choice(names)
+                for ns in ("ns0", "ns1"):
+                    live = api.try_get("Pod", ns, name)
+                    if live is not None:
+                        live.metadata.labels["app"] = rng.choice("abcd")
+                        api.update(live)
+                        break
+            else:
+                name = names.pop(rng.randrange(len(names)))
+                for ns in ("ns0", "ns1"):
+                    try:
+                        api.delete("Pod", ns, name)
+                        break
+                    except Exception:
+                        continue
+        for app in "abcd":
+            for ns in (None, "ns0", "ns1"):
+                want = [p.name for p in api.list(
+                    "Pod", namespace=ns, label_selector={"app": app})]
+                got = [p.name for p in cache.select(
+                    "Pod", ns, {"app": app})]
+                assert got == want, (app, ns)
+        # multi-key selector has no exact index -> brute scan, same answer
+        want = [p.name for p in api.list(
+            "Pod", namespace="ns0",
+            label_selector={"app": "a", "tier": "x"})]
+        got = [p.name for p in cache.select(
+            "Pod", "ns0", {"app": "a", "tier": "x"})]
+        assert got == want
+
+
+class TestLookupAccounting:
+    def test_hit_and_miss_counted(self):
+        registry = Registry()
+        api, cache = fresh(registry=registry)
+        api.create(mk("Pod", "ns1", "p1", labels={"app": "a"}))
+        cache.select("Pod", "ns1", {"app": "a"})            # indexed: hit
+        cache.select("Pod", "ns1", {"unindexed": "z"})      # no index: miss
+        cache.list("ConfigMap", namespace="ns1")            # ns index: hit
+        cache.list("Pod", namespace="ns1")                  # no ns index: miss
+        counter = registry.get("cache_index_lookups_total")
+        assert counter.value("label:app", "hit") == 1
+        assert counter.value("label:unindexed", "miss") == 1
+        assert counter.value("namespace", "hit") == 1
+        assert counter.value("namespace", "miss") == 1

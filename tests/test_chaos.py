@@ -51,10 +51,29 @@ def env():
     cluster = FakeCluster(api)
     cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
     cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
-    mgr = Manager(api, clock=FakeClock())
+    # roomy per-object history: the soaks audit per-key serialization over
+    # every recorded attempt (WORKQUEUE_WORKERS from the env — the CI soak
+    # runs the full suite with a parallel worker pool)
+    from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+
+    mgr = Manager(api, clock=FakeClock(),
+                  flight_recorder=FlightRecorder(capacity=16384,
+                                                 per_object=4096))
     setup_core_controllers(mgr, CoreConfig())
     setup_odh_controllers(mgr, OdhConfig(controller_namespace=CENTRAL_NS))
     return api, cluster, mgr
+
+
+def assert_no_concurrent_per_key_reconciles(mgr):
+    """No two recorded attempts of one (controller, object) key may have
+    overlapping real-time execution windows — the per-key serialization
+    invariant the parallel worker pool must uphold."""
+    overlaps = mgr.flight_recorder.overlapping_attempts()
+    assert not overlaps, (
+        f"{len(overlaps)} overlapping attempt pairs; first: "
+        f"{overlaps[0][0].controller} {overlaps[0][0].object_key} "
+        f"[{overlaps[0][0].mono_start:.6f},{overlaps[0][0].mono_end:.6f}] vs "
+        f"[{overlaps[0][1].mono_start:.6f},{overlaps[0][1].mono_end:.6f}]")
 
 
 def knowledge():
@@ -257,6 +276,9 @@ class TestChaosSoak:
 
         # the soak must actually have injected chaos to mean anything
         assert total_faults > SOAK_ROUNDS, total_faults
+        # and in threaded mode (WORKQUEUE_WORKERS > 1) the worker pool must
+        # never have run two reconciles of one key concurrently
+        assert_no_concurrent_per_key_reconciles(mgr)
 
     def test_trace_integrity_under_faults(self, env):
         """Observability acceptance: run soak rounds with a span exporter
@@ -374,6 +396,7 @@ class TestSliceRecoverySoak:
 
     def _env(self):
         from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.utils.flightrecorder import FlightRecorder
 
         api = ApiServer()
         cluster = FakeCluster(api)
@@ -381,7 +404,9 @@ class TestSliceRecoverySoak:
                          allocatable={"cpu": "64", "memory": "256Gi"})
         cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
         clock = FakeClock()
-        mgr = Manager(api, clock=clock)
+        mgr = Manager(api, clock=clock,
+                      flight_recorder=FlightRecorder(capacity=16384,
+                                                     per_object=4096))
         cfg = CoreConfig(**self.CFG)
         metrics = NotebookMetrics(api)
         setup_core_controllers(mgr, cfg, metrics)
@@ -475,6 +500,7 @@ class TestSliceRecoverySoak:
 
         groups = self._assert_slice_atomic(api, "healsoak")
         assert groups > 0, "soak never exercised a recovery restart"
+        assert_no_concurrent_per_key_reconciles(mgr)
 
     def test_permanent_failure_exhausts_exactly_at_cap(self):
         api, cluster, mgr, clock, cfg, metrics = self._env()
@@ -553,12 +579,18 @@ class TestFlightRecorderDebugSoak:
             api.create(nb.obj)
             mgr.run_until_idle()
 
-            # phase A: two injected 503s on the notebook controller's
-            # StatefulSet list -> two errored attempts, then recovery
+            # a converged fleet's reconciles are all-cache-reads (indexed
+            # informer cache + no-op write suppression), so faults must
+            # target a verb real drift provokes: delete the notebook's
+            # Service and fault its re-creation.
+            # phase A: two injected 503s on the Service create -> two
+            # errored notebook attempts, then recovery
             plan_err = FaultPlan([FaultRule(
-                verbs=("list",), kinds=("StatefulSet",),
+                verbs=("create",), kinds=("Service",),
                 error="unavailable", max_matches=2, name="err")],
                 clock=clock)
+            with api.fault_exempt():
+                api.delete("Service", "user1", "fr")
             api.install_fault_plan(plan_err)
             with api.fault_exempt():
                 mgr.enqueue_all()
@@ -566,11 +598,13 @@ class TestFlightRecorderDebugSoak:
             api.clear_fault_plan()
             assert plan_err.exhausted() and len(plan_err.log) == 2
 
-            # phase B: one 0.5s latency on the Notebook get -> one SLOW
+            # phase B: one 0.5s latency on the Service create -> one SLOW
             # (but successful) attempt, above the 0.2s tail threshold
             plan_lag = FaultPlan([FaultRule(
-                verbs=("get",), kinds=("Notebook",),
+                verbs=("create",), kinds=("Service",),
                 latency_s=0.5, max_matches=1, name="lag")], clock=clock)
+            with api.fault_exempt():
+                api.delete("Service", "user1", "fr")
             api.install_fault_plan(plan_lag)
             with api.fault_exempt():
                 mgr.enqueue_all()
