@@ -1,13 +1,19 @@
 #!/usr/bin/env bash
 # Seeded chaos soaks (tests/test_chaos.py::TestChaosSoak +
-# ::TestSliceRecoverySoak): N rounds of random fault plans
-# (kube/faults.py) against a TPU+auth notebook, plus the self-healing
-# recovery soak (seeded worker kills/crashloops under API faults; the
-# engine — not an annotation — must restore sliceHealth=Healthy with
-# slice-atomic restarts only, survive a mid-soak leader failover, and
-# exhaust exactly at the attempt cap on a permanently broken slice).
-# All driven on the FakeClock so wall time stays in seconds regardless of
-# how much backoff the injected faults provoke.
+# ::TestSliceRecoverySoak + ::TestMigrationRecoverySoak): N rounds of
+# random fault plans (kube/faults.py) against a TPU+auth notebook, plus
+# the self-healing recovery soak (seeded worker kills/crashloops under
+# API faults; the engine — not an annotation — must restore
+# sliceHealth=Healthy with slice-atomic restarts only, survive a
+# mid-soak leader failover, and exhaust exactly at the attempt cap on a
+# permanently broken slice), plus the checkpoint/migrate drill
+# (self-healing on, session checkpoints enabled: fresh checkpoints must
+# recover via the migrate verb with restored-state equivalence asserted
+# byte-for-byte, stale ones must fall back to the bare restart, and a
+# manager failover mid-migration must resume from status.sessionState
+# without double-restoring).  All driven on the FakeClock so wall time
+# stays in seconds regardless of how much backoff the injected faults
+# provoke.
 #
 # The seed is printed up front and on failure — reproduce any run with
 #   CHAOS_SOAK_SEED=<seed> CHAOS_SOAK_ROUNDS=<n> \
@@ -19,6 +25,7 @@ cd "$(dirname "$0")/.."
 
 ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
 HEAL_ROUNDS="${SELFHEAL_SOAK_ROUNDS:-16}"
+MIGRATE_ROUNDS="${MIGRATE_SOAK_ROUNDS:-12}"
 SEED="${CHAOS_SOAK_SEED:-20260804}"
 # the CI soak runs the manager with a parallel worker pool: the invariants
 # (steady state restored, slice-atomic restarts, fault<->span pairing) must
@@ -29,14 +36,17 @@ if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} workers=${WORKERS} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} workers=${WORKERS} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
-    SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" WORKQUEUE_WORKERS="$WORKERS" \
+    SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" MIGRATE_SOAK_ROUNDS="$MIGRATE_ROUNDS" \
+    WORKQUEUE_WORKERS="$WORKERS" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
-      tests/test_chaos.py::TestSliceRecoverySoak -q "$@"; then
+      tests/test_chaos.py::TestSliceRecoverySoak \
+      tests/test_chaos.py::TestMigrationRecoverySoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
   echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
-  echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} WORKQUEUE_WORKERS=${WORKERS} ci/chaos_soak.sh" >&2
+  echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} MIGRATE_SOAK_ROUNDS=${MIGRATE_ROUNDS} \\" >&2
+  echo "    WORKQUEUE_WORKERS=${WORKERS} ci/chaos_soak.sh" >&2
   exit 1
 fi
-echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, workers=${WORKERS})"
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, workers=${WORKERS})"

@@ -195,6 +195,7 @@ def notebook_status(
     worker_states: Optional[list[dict]] = None,
     slice_health: Optional[str] = None,
     slice_recovery: Optional[dict] = None,
+    session_state: Optional[dict] = None,
 ) -> dict:
     """NotebookStatus shape: reference fields (conditions/readyReplicas/
     containerState, api/v1/notebook_types.go:37-45) + TPU extensions.
@@ -203,7 +204,13 @@ def notebook_status(
     (status.sliceRecovery, keyed by slice id: restart attempt timestamps,
     backoff deadline, disruption stamp, exhaustion flag).  It lives on the
     CR — not in controller memory — so a manager crash or leader failover
-    resumes the restart budget instead of resetting it."""
+    resumes the restart budget instead of resetting it.
+
+    `session_state` (status.sessionState, keyed by slice id) is the
+    migrate verb's write-ahead restore intent: which checkpoint generation
+    the recreated slice must restore, stamped BEFORE the restart so a
+    manager failover mid-migration resumes the restore instead of
+    forgetting it (core/selfheal.py owns the mutations)."""
     status = {
         "conditions": conditions,
         "readyReplicas": ready_replicas,
@@ -215,4 +222,6 @@ def notebook_status(
         status["sliceHealth"] = slice_health
     if slice_recovery:
         status["sliceRecovery"] = copy.deepcopy(slice_recovery)
+    if session_state:
+        status["sessionState"] = copy.deepcopy(session_state)
     return status

@@ -24,6 +24,24 @@ LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
 # in-notebook runtime acknowledges with checkpoint-complete
 ANNOTATION_CHECKPOINT_REQUESTED = "notebooks.kubeflow.org/checkpoint-requested"
 ANNOTATION_CHECKPOINT_COMPLETE = "notebooks.kubeflow.org/checkpoint-complete"
+# voluntary migration request (drain/defrag): the RecoveryEngine runs the
+# same snapshot -> slice restart -> restore verb it uses for disruption,
+# no failure required, and clears the annotation once handled.  Value is
+# the trigger ("drain" or "defrag"; anything else reads as "drain").
+ANNOTATION_MIGRATE = "notebooks.kubeflow.org/migrate"
+# stamped onto a worker pod by the kubelet-side runtime after it restored
+# the session checkpoint named by the pod's CHECKPOINT_RESTORE_* env —
+# the audit trail restored-state-equivalence drills assert against
+ANNOTATION_RESTORED_GENERATION = "notebooks.kubeflow.org/restored-generation"
+ANNOTATION_RESTORED_DIGEST = "notebooks.kubeflow.org/restored-digest"
+
+# checkpoint-sidecar contract: env rendered into every TPU worker when
+# CHECKPOINT_STORE_URI is configured (consumed by runtime/checkpoint.py)
+ENV_CHECKPOINT_STORE_URI = "CHECKPOINT_STORE_URI"
+ENV_CHECKPOINT_INTERVAL_S = "CHECKPOINT_INTERVAL_S"
+# restore stamping: written into the recreated pods of a migrated slice
+ENV_CHECKPOINT_RESTORE_URI = "CHECKPOINT_RESTORE_URI"
+ENV_CHECKPOINT_RESTORE_GENERATION = "CHECKPOINT_RESTORE_GENERATION"
 
 # labels
 WORKBENCH_LABEL = "opendatahub.io/workbenches"

@@ -112,12 +112,14 @@ class CullingReconciler:
                     # activity resumed: reset the checkpoint handshake so the
                     # next idle period gets a fresh request + grace window
                     culler.remove_checkpoint_annotations(meta)
+                    self._clear_cull_signal(nb)
                 else:
                     if self._should_wait_for_checkpoint(nb, meta):
                         span.add_event("culling.checkpoint_wait")
                         return
                     logger.info("culling notebook %s/%s", req.namespace, req.name)
                     span.add_event("notebook.culled")
+                    self._clear_cull_signal(nb)
                     culler.set_stop_annotation(meta, self.clock)
                     self.metrics.culling.labels(req.namespace, req.name).inc()
                     self.metrics.last_culling_timestamp.labels(
@@ -129,9 +131,14 @@ class CullingReconciler:
 
     def _should_wait_for_checkpoint(self, nb: Notebook, meta) -> bool:
         """Checkpoint-before-cull handshake (TPU extension, off by default):
-        on the first idle verdict, stamp checkpoint-requested and hold the
-        cull until the runtime acknowledges with checkpoint-complete or the
-        grace window (one idleness period) expires."""
+        on the first idle verdict, stamp checkpoint-requested — and, when a
+        signal root is configured (CHECKPOINT_SIGNAL_ROOT), write the
+        actual cull-signal request file the in-pod CullSignalWatcher
+        polls, so checkpoint-on-cull genuinely fires.  The cull then holds
+        until the runtime acknowledges (ack file or checkpoint-complete
+        annotation) or the grace window (one idleness period) expires —
+        only after that does the stop annotation land and the slice
+        transition toward Stopping."""
         if not (self.cfg.checkpoint_before_cull and nb.tpu is not None):
             return False
         requested = meta.annotations.get(C.ANNOTATION_CHECKPOINT_REQUESTED)
@@ -139,8 +146,9 @@ class CullingReconciler:
             meta.annotations[C.ANNOTATION_CHECKPOINT_REQUESTED] = (
                 self.clock.now_iso()
             )
+            self._write_cull_signal(nb)
             return True
-        if C.ANNOTATION_CHECKPOINT_COMPLETE in meta.annotations:
+        if self._checkpoint_acknowledged(nb, meta):
             return False
         from ..utils.clock import parse_iso
 
@@ -149,6 +157,63 @@ class CullingReconciler:
         except ValueError:
             return False
         return self.clock.now() < grace_end
+
+    # -- cull-signal file transport (runtime/checkpoint.py contract) -----------
+    def _signal_dir(self, nb: Notebook):
+        if not self.cfg.checkpoint_signal_root:
+            return None
+        from pathlib import Path
+
+        return Path(self.cfg.checkpoint_signal_root) / nb.namespace / nb.name
+
+    def _write_cull_signal(self, nb: Notebook) -> None:
+        d = self._signal_dir(nb)
+        if d is None:
+            return
+        from ..runtime.checkpoint import REQUEST_FILE
+
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+            (d / REQUEST_FILE).write_text("true")
+        except OSError:
+            logger.warning("could not write cull signal under %s", d)
+
+    def _checkpoint_acknowledged(self, nb: Notebook, meta) -> bool:
+        """Either side of the transport counts: the checkpoint-complete
+        annotation (downward-API-less runtimes PATCH it directly) or the
+        ack file next to the signal request."""
+        if C.ANNOTATION_CHECKPOINT_COMPLETE in meta.annotations:
+            return True
+        d = self._signal_dir(nb)
+        if d is None:
+            return False
+        from ..runtime.checkpoint import ACK_FILE
+
+        if not (d / ACK_FILE).exists():
+            return False
+        # mirror the ack into the annotation so the decision is visible on
+        # the CR (and survives signal-dir cleanup), and account the
+        # snapshot exactly once
+        meta.annotations[C.ANNOTATION_CHECKPOINT_COMPLETE] = \
+            self.clock.now_iso()
+        self.metrics.checkpoint_snapshots.labels(
+            nb.namespace, "cull").inc()
+        return True
+
+    def _clear_cull_signal(self, nb: Notebook) -> None:
+        """Activity resumed (or the cull completed): retire both signal
+        files so a stale request/ack never leaks into the next idle
+        cycle — the file-transport twin of remove_checkpoint_annotations."""
+        d = self._signal_dir(nb)
+        if d is None:
+            return
+        from ..runtime.checkpoint import ACK_FILE, REQUEST_FILE
+
+        for name in (REQUEST_FILE, ACK_FILE):
+            try:
+                (d / name).unlink()
+            except OSError:
+                pass
 
     def _mutate(self, req: Request, fn) -> None:
         """Read-modify-write on the CR metadata with conflict retry — the

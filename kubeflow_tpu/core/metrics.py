@@ -77,6 +77,29 @@ class NotebookMetrics:
             buckets=(1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
                      1800.0),
         )
+        # session-state tier (core/sessionstate.py + selfheal migrate verb):
+        # snapshots the control plane recorded/confirmed (trigger: final |
+        # cull), the checkpoint age observed at each migrate decision, and
+        # the migrate-verb outcomes.  trigger/result are bounded sets —
+        # selfheal.MIGRATE_* constants.
+        self.checkpoint_snapshots = self.registry.counter(
+            "notebook_checkpoint_snapshots_total",
+            "Session checkpoints recorded or confirmed by the controllers",
+            labels=("namespace", "trigger"),
+        )
+        self.checkpoint_age_seconds = self.registry.histogram(
+            "notebook_checkpoint_age_seconds",
+            "Age of the freshest session checkpoint at migrate-decision "
+            "time",
+            labels=("namespace",),
+            buckets=(1.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0,
+                     3600.0),
+        )
+        self.migrations = self.registry.counter(
+            "notebook_migrations_total",
+            "Checkpoint/migrate recoveries by trigger and outcome",
+            labels=("trigger", "result"),
+        )
         # workqueue / retry observability (controller-runtime exports the
         # same family: workqueue_depth, workqueue_retries_total) — scraped
         # from Manager.queue_stats() when a manager is attached.  The

@@ -50,6 +50,7 @@ class NotebookReconciler:
         recorder: Optional[EventRecorder] = None,
         clock: Optional[Clock] = None,
         cache=None,
+        session=None,
     ):
         self.api = api
         self.cfg = cfg
@@ -61,10 +62,19 @@ class NotebookReconciler:
         # label index — replacing O(all-objects) api.list scans.  None
         # falls back to live reads (direct-construction unit tests).
         self.cache = cache
+        # session-state store (core/sessionstate.py): when wired (an
+        # explicit store, or CHECKPOINT_STORE_URI in config), the recovery
+        # engine prefers checkpoint/migrate over bare slice restarts
+        if session is None and cfg.checkpoint_store_uri:
+            from .sessionstate import open_store
+
+            session = open_store(cfg.checkpoint_store_uri, clock=self.clock)
+        self.session = session
         # slice-atomic self-healing: budgeted recovery of disrupted TPU
         # slices, bookkeeping persisted on the CR (core/selfheal.py)
         self.recovery = RecoveryEngine(api, cfg, metrics, self.recorder,
-                                       clock=self.clock, cache=cache)
+                                       clock=self.clock, cache=cache,
+                                       session=session)
         # first-readiness tracking for the notebook_to_ready_seconds
         # histogram: first-seen clock time per live notebook (keyed by uid
         # so a delete+recreate measures afresh), dropped once observed
@@ -203,6 +213,8 @@ class NotebookReconciler:
             nb, live_names,
             pods_of=lambda name: self._pods_of(nb, name),
             restart_slice=lambda name: self._restart_pods(nb, [name]),
+            stamp_restore=lambda name, idx: self._stamp_restore(
+                nb, name, idx),
         )
         if requeue_s > 0:
             return Result(requeue_after=requeue_s)
@@ -297,6 +309,32 @@ class NotebookReconciler:
         if errors:
             raise SliceRestartError(errors, attempted)
 
+    def _stamp_restore(self, nb: Notebook, live_name: str,
+                       slice_idx: int) -> None:
+        """Sync one live slice StatefulSet with the restore intent the
+        recovery engine just wrote into status.sessionState: re-render the
+        slice template (workload._render_checkpoint_contract injects
+        CHECKPOINT_RESTORE_URI/_GENERATION from the LIVE status) and copy
+        the owned fields onto the live object, so the pods the restart
+        recreates boot with the restore env.  Reads the apiserver, not the
+        cache — the write-ahead status update this stamps from may be
+        younger than the informer stream."""
+        from .workload import generate_statefulsets
+
+        fresh = self.api.try_get("Notebook", nb.namespace, nb.name)
+        if fresh is None:
+            return
+        desired_sets = generate_statefulsets(Notebook(fresh), self.cfg)
+        if slice_idx >= len(desired_sets):
+            return
+        desired = desired_sets[slice_idx]
+        set_controller_reference(fresh, desired)
+        live = self.api.try_get("StatefulSet", nb.namespace, live_name)
+        if live is None:
+            return
+        if rh.copy_statefulset_fields(desired, live):
+            self.api.update(live)
+
     def _update_status(self, nb: Notebook, live_names: list[str]) -> None:
         with _TRACER.start_span("status", {"phase": "status"}) as span:
             self._compute_and_write_status(nb, live_names, span)
@@ -390,6 +428,9 @@ class NotebookReconciler:
             if cond.get("type") == CONDITION_RECOVERY_EXHAUSTED:
                 conditions.append(copy.deepcopy(cond))
         slice_recovery = copy.deepcopy(nb.status.get("sliceRecovery"))
+        # the migrate verb's write-ahead restore intent rides along too —
+        # losing it on a status rewrite would orphan an in-flight restore
+        session_state = copy.deepcopy(nb.status.get("sessionState"))
 
         slice_health = None
         if tpu is not None:
@@ -415,6 +456,7 @@ class NotebookReconciler:
             worker_states=worker_states if tpu is not None else None,
             slice_health=slice_health,
             slice_recovery=slice_recovery,
+            session_state=session_state,
         )
 
         # transitions as span events: the trace timeline shows WHEN a slice
@@ -550,6 +592,7 @@ def setup_core_controllers(
     mgr: Manager,
     cfg: Optional[CoreConfig] = None,
     metrics: Optional[NotebookMetrics] = None,
+    session=None,
 ) -> NotebookReconciler:
     """Wire the core controllers into a manager (main.go:58-148 analog;
     culling registration is separate, gated on ENABLE_CULLING —
@@ -586,7 +629,7 @@ def setup_core_controllers(
         metrics.attach_manager(mgr)
     recorder = EventRecorder(api, "notebook-controller")
     rec = NotebookReconciler(api, cfg, metrics, recorder, clock=mgr.clock,
-                             cache=cache)
+                             cache=cache, session=session)
 
     def pod_to_request(pod: KubeObject) -> list[Request]:
         name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)

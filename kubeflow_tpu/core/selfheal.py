@@ -23,13 +23,29 @@ the loop, in the shape NotebookOS (arXiv:2503.20591) and ElasticNotebook
   condition (+ Warning event) once the budget is spent, so the controller
   stops churning a permanently broken slice.
 
+- With a session-state store wired (core/sessionstate.py, CHECKPOINT_*
+  knobs), the engine prefers a `migrate` verb over the bare restart:
+  request/confirm a final snapshot while the slice is still reachable —
+  else fall back to the freshest stored checkpoint within
+  CHECKPOINT_MAX_AGE_S — then write the restore intent into
+  `status.sessionState` (write-ahead), re-stamp the slice StatefulSet so
+  the recreated pods carry CHECKPOINT_RESTORE_URI/_GENERATION, and only
+  then delete the pods.  A stale/absent checkpoint degrades to the bare
+  restart; migrate and restart share ONE attempt budget.  The same verb
+  serves *voluntary* migration — a drain/defrag annotation
+  (constants.ANNOTATION_MIGRATE) or a worker parked on a cordoned
+  (unschedulable) Node — with the guard that a healthy session is never
+  torn down without a secured checkpoint.  Verb precedence:
+  cull > migrate > restart.
+
 All bookkeeping (per-slice attempt timestamps, last-restart time, backoff
-deadline, disruption stamp, exhaustion flag) is persisted in
-`status.sliceRecovery` on the CR — controller memory holds nothing — so a
-manager crash or leader failover (kube/leader.py) resumes the budget
-instead of resetting it.  The bookkeeping write happens BEFORE the pod
-deletes (write-ahead): a crash mid-restart can lose the restart, never
-the attempt charge.
+deadline, disruption stamp, exhaustion flag — and the migrate verb's
+restore intent) is persisted in `status.sliceRecovery` /
+`status.sessionState` on the CR — controller memory holds nothing — so a
+manager crash or leader failover resumes the budget AND any in-flight
+migration instead of resetting them.  The bookkeeping write happens
+BEFORE the pod deletes (write-ahead): a crash mid-restart can lose the
+restart, never the attempt charge, and never the restore instructions.
 """
 
 from __future__ import annotations
@@ -51,6 +67,11 @@ from ..utils.clock import Clock, parse_iso
 from ..utils.config import CoreConfig
 from . import constants as C
 from .metrics import NotebookMetrics
+from .sessionstate import (
+    SessionStateStore,
+    SnapshotInfo,
+    TRIGGER_FINAL,
+)
 
 logger = logging.getLogger("kubeflow_tpu.selfheal")
 
@@ -65,14 +86,31 @@ REASON_POD_FAILED = "pod-failed"
 REASON_CRASH_LOOP = "crash-loop"
 REASON_NODE_GONE = "node-gone"
 REASON_PENDING_TIMEOUT = "pending-timeout"
+# a slice restart performed by the migrate verb (checkpoint secured) —
+# distinguishes state-preserving restarts from bare ones in the counter
+REASON_MIGRATE = "migrate"
 # transient marker, not yet a disruption: a Pending worker becomes
 # REASON_PENDING_TIMEOUT only once the schedule deadline passes
 PENDING = "pending"
+
+# migrate triggers/results — bounded sets, they label
+# notebook_migrations_total{trigger,result}
+MIGRATE_TRIGGER_FAILURE = "failure"
+MIGRATE_TRIGGER_DRAIN = "drain"
+MIGRATE_TRIGGER_DEFRAG = "defrag"
+MIGRATE_TRIGGER_NODE_DRAIN = "node-drain"
+MIGRATE_RESULT_MIGRATED = "migrated"          # verb issued with a checkpoint
+MIGRATE_RESULT_RESTORED = "restored"          # slice Healthy post-restore
+MIGRATE_RESULT_FALLBACK = "fallback-restart"  # stale/absent ckpt -> bare
+MIGRATE_RESULT_SKIPPED = "skipped"            # voluntary without a ckpt
 
 # event reasons (kubectl describe notebook)
 EVENT_SLICE_RECOVERY = "SliceRecovery"
 EVENT_RECOVERY_EXHAUSTED = "RecoveryExhausted"
 EVENT_RECOVERY_RESTORED = "RecoveryRestored"
+EVENT_SLICE_MIGRATION = "SliceMigration"
+EVENT_MIGRATION_COMPLETE = "MigrationComplete"
+EVENT_MIGRATION_SKIPPED = "MigrationSkipped"
 
 
 class SliceRestartError(Exception):
@@ -116,14 +154,8 @@ def classify_worker(pod: KubeObject, api: ApiServer,
         waiting = (cs.get("state") or {}).get("waiting") or {}
         if waiting.get("reason") == "CrashLoopBackOff":
             return REASON_CRASH_LOOP
-    node_name = pod.spec.get("nodeName", "")
-    if node_name:
-        if node_cache is not None and node_name in node_cache:
-            node = node_cache[node_name]
-        else:
-            node = api.try_get("Node", "", node_name)
-            if node_cache is not None:
-                node_cache[node_name] = node
+    node = _node_of(pod, api, node_cache)
+    if pod.spec.get("nodeName", ""):
         if node is None:
             # the node object vanished under the pod: preemption or
             # scale-down, before the node controller reaped the pod
@@ -139,16 +171,38 @@ def classify_worker(pod: KubeObject, api: ApiServer,
     return None
 
 
+def _node_of(pod: KubeObject, api: ApiServer,
+             node_cache: Optional[dict]) -> Optional[KubeObject]:
+    node_name = pod.spec.get("nodeName", "")
+    if not node_name:
+        return None
+    if node_cache is not None and node_name in node_cache:
+        return node_cache[node_name]
+    node = api.try_get("Node", "", node_name)
+    if node_cache is not None:
+        node_cache[node_name] = node
+    return node
+
+
+def node_drained(pod: KubeObject, api: ApiServer,
+                 node_cache: Optional[dict] = None) -> bool:
+    """A worker parked on a cordoned Node (`spec.unschedulable`) is a
+    voluntary-migration candidate: the node is being drained, not failed —
+    classify_worker correctly stays quiet, the migrate verb moves it."""
+    node = _node_of(pod, api, node_cache)
+    return bool(node is not None and node.spec.get("unschedulable"))
+
+
 class RecoveryEngine:
     """Budgeted slice-atomic recovery, driven from the notebook reconcile.
 
     `maybe_recover` runs after the status pass: it classifies every worker
     of every slice, and for a disrupted slice either waits out the current
-    backoff (returning a requeue-after hint), restarts the whole slice
-    (write-ahead bookkeeping, then delete every pod), or — once the
-    sliding-window attempt budget is spent — escalates to the terminal
-    RecoveryExhausted condition and stops touching the slice until an
-    operator heals it (at which point the budget resets)."""
+    backoff (returning a requeue-after hint), migrates or restarts the
+    whole slice (write-ahead bookkeeping, then delete every pod), or —
+    once the sliding-window attempt budget is spent — escalates to the
+    terminal RecoveryExhausted condition and stops touching the slice
+    until an operator heals it (at which point the budget resets)."""
 
     def __init__(
         self,
@@ -158,6 +212,7 @@ class RecoveryEngine:
         recorder: EventRecorder,
         clock: Optional[Clock] = None,
         cache=None,
+        session: Optional[SessionStateStore] = None,
     ) -> None:
         self.api = api
         self.cfg = cfg
@@ -167,6 +222,9 @@ class RecoveryEngine:
         # informer cache for detection-path reads (Notebook freshness,
         # Node health in classify_worker); writes always go live
         self.cache = cache
+        # session-state store (core/sessionstate.py): when wired, the
+        # migrate verb is preferred over bare restart
+        self.session = session
 
     # -- entry point ----------------------------------------------------------
     def maybe_recover(
@@ -175,12 +233,16 @@ class RecoveryEngine:
         live_names: list[str],
         pods_of: Callable[[str], list[KubeObject]],
         restart_slice: Callable[[str], None],
+        stamp_restore: Optional[Callable[[str, int], None]] = None,
     ) -> float:
         """One recovery pass; returns the requeue-after hint in seconds
         (0.0 = nothing scheduled).  `live_names` is ordered slice 0 first,
         as the reconciler builds it; `restart_slice` must delete every pod
         of the named slice's StatefulSet, aggregating errors
-        (NotebookReconciler._restart_pods)."""
+        (NotebookReconciler._restart_pods); `stamp_restore(live_name, idx)`
+        must sync the live StatefulSet template with the freshly written
+        restore intent so the recreated pods boot with the
+        CHECKPOINT_RESTORE_* env (NotebookReconciler._stamp_restore)."""
         tpu = nb.tpu
         if tpu is None or not self.cfg.enable_self_healing:
             return 0.0
@@ -191,93 +253,226 @@ class RecoveryEngine:
         status = live.body.get("status", {}) or {}
         recovery = copy.deepcopy(status.get("sliceRecovery") or {})
         prev_recovery = copy.deepcopy(recovery)
+        session_state = copy.deepcopy(status.get("sessionState") or {})
+        prev_session = copy.deepcopy(session_state)
 
-        # Culling precedence: a stop-annotated notebook (slice health
-        # Stopping/Stopped) is being parked on purpose — "recovering" it
-        # would fight the cull pod-for-pod.  Once fully Stopped, stale
-        # bookkeeping (including an exhaustion verdict) is dropped so an
-        # un-culled notebook starts with a fresh budget.
+        # Culling precedence (cull > migrate > restart): a stop-annotated
+        # notebook (slice health Stopping/Stopped) is being parked on
+        # purpose — "recovering" it would fight the cull pod-for-pod.
+        # Once fully Stopped, stale recovery bookkeeping (including an
+        # exhaustion verdict) is dropped so an un-culled notebook starts
+        # with a fresh budget; status.sessionState deliberately SURVIVES
+        # the stop — the pre-cull checkpoint is what an un-culled notebook
+        # restores from.
         if C.STOP_ANNOTATION in live.metadata.annotations or \
                 status.get("sliceHealth") in ("Stopping", "Stopped"):
             if recovery and status.get("sliceHealth") == "Stopped":
                 self._write_bookkeeping(nb, {})
             return 0.0
 
+        # voluntary migration request: drain/defrag annotation on the CR
+        ann_raw = live.metadata.annotations.get(
+            C.ANNOTATION_MIGRATE, "").strip().lower()
+        ann_trigger = None
+        if ann_raw:
+            ann_trigger = ann_raw if ann_raw in (
+                MIGRATE_TRIGGER_DRAIN, MIGRATE_TRIGGER_DEFRAG,
+            ) else MIGRATE_TRIGGER_DRAIN
+
         # -- pass 1: pure detection (no span unless there is work) ------------
         shape = tpu.shape
         node_cache: dict[str, Optional[KubeObject]] = {}
-        detections: list[tuple[int, str, list[tuple[str, str]], bool, bool]] = []
+        detections: list[tuple] = []
         for idx, live_name in enumerate(live_names):
             pods = sorted(pods_of(live_name), key=lambda p: p.name)
             reasons: list[tuple[str, str]] = []
             pending = False
             ready = 0
+            drained = False
             for pod in pods:
                 verdict = classify_worker(pod, reader, node_cache)
                 if verdict == PENDING:
                     pending = True
                 elif verdict is not None:
                     reasons.append((pod.name, verdict))
+                elif node_drained(pod, reader, node_cache):
+                    drained = True
                 if _pod_ready(pod):
                     ready += 1
             healthy = not reasons and not pending and ready >= shape.num_hosts
-            detections.append((idx, live_name, reasons, pending, healthy))
+            # a disruption wins over a voluntary request (the failure path
+            # migrates too, just under the "failure" trigger)
+            trigger = None
+            if not reasons and not pending:
+                trigger = ann_trigger or (
+                    MIGRATE_TRIGGER_NODE_DRAIN if drained else None)
+            # migration-completeness audit: a worker positively stamped
+            # with a DIFFERENT restored generation than the in-flight
+            # intent survived the restart (e.g. a delete that failed
+            # mid-sweep) and still runs the old session — the migration
+            # must not finalize over it.  Absent stamps stay neutral
+            # (runtimes without the stamping agent must not wedge here).
+            stale_session = False
+            target = (session_state.get(str(idx)) or {})
+            if target.get("phase") == "migrating" and \
+                    target.get("restoreGeneration") is not None:
+                want = str(target["restoreGeneration"])
+                for pod in pods:
+                    got = pod.metadata.annotations.get(
+                        C.ANNOTATION_RESTORED_GENERATION)
+                    if got is not None and got != want:
+                        stale_session = True
+                        break
+            detections.append((idx, live_name, reasons, pending, healthy,
+                               trigger, stale_session))
 
-        if not recovery and not any(
-                reasons or pending
-                for _, _, reasons, pending, _ in detections):
+        migrating_inflight = any(
+            s.get("phase") == "migrating" for s in session_state.values())
+        if not recovery and not migrating_inflight and not any(
+                reasons or pending or trigger
+                for _, _, reasons, pending, _, trigger, _ in detections):
             return 0.0
 
         # -- pass 2: decisions, under the `recover` phase span ----------------
         now = self.clock.now()
         requeue = 0.0
-        restarts: list[tuple[int, str, str, str, int, float]] = []
+        restarts: list[dict] = []
         events: list[tuple[str, str, str]] = []
+        notes = {"deferred": False}
         with _TRACER.start_span(
             "recover", {"phase": "recover", "namespace": nb.namespace,
                         "notebook": nb.name}
         ) as span:
-            for idx, live_name, reasons, pending, healthy in detections:
+            for idx, live_name, reasons, pending, healthy, trigger, \
+                    stale_session in detections:
                 requeue = _merge_requeue(requeue, self._slice_pass(
-                    nb, idx, live_name, reasons, pending, healthy,
-                    recovery, restarts, events, span, now))
+                    nb, idx, live_name, reasons, pending, healthy, trigger,
+                    stale_session, recovery, session_state, restarts,
+                    events, notes, span, now))
 
             # per-slice passes mutate their state dicts in place; drop
             # entries that emptied out so the persisted bookkeeping stays
             # minimal (and the no-op status check stays meaningful)
             for key in [k for k, s in recovery.items() if not s]:
                 recovery.pop(key)
+            for key in [k for k, s in session_state.items() if not s]:
+                session_state.pop(key)
             exhausted = sorted(
                 k for k, s in recovery.items() if s.get("exhausted"))
-            if recovery != prev_recovery:
-                # write-ahead: the budget charge must survive a crash
-                # between here and the pod deletes below
-                self._write_bookkeeping(nb, recovery, exhausted)
+            if recovery != prev_recovery or session_state != prev_session:
+                # write-ahead: the budget charge AND the restore intent
+                # must survive a crash between here and the pod deletes
+                # below — a manager failover resumes the migration from
+                # status.sessionState instead of forgetting it
+                self._write_bookkeeping(nb, recovery, exhausted,
+                                        session_state)
             for etype, reason, message in events:
                 self.recorder.event(nb.obj, etype, reason, message)
 
-            for idx, live_name, reason, pod_name, attempt_n, delay in restarts:
-                span.add_event("slice.restart", {
-                    "slice": idx, "sts": live_name, "reason": reason,
-                    "pod": pod_name, "attempt": attempt_n,
-                    "backoff_s": delay,
-                })
-                self.metrics.slice_restarts.labels(
-                    nb.namespace, reason).inc()
-                self.recorder.event(
-                    nb.obj, "Normal", EVENT_SLICE_RECOVERY,
-                    "restarting slice %d (%s): %s is %s (attempt %d/%d, "
-                    "next backoff %.0fs)" % (
-                        idx, live_name, pod_name or "workers", reason,
-                        attempt_n, self.cfg.recovery_max_attempts, delay))
-                restart_slice(live_name)
+            for entry in restarts:
+                if entry["verb"] == REASON_MIGRATE:
+                    self._execute_migrate(nb, entry, stamp_restore,
+                                          restart_slice)
+                else:
+                    self._execute_restart(nb, entry, span, stamp_restore,
+                                          restart_slice)
+
+            # the drain/defrag annotation is consumed once every slice got
+            # its decision this pass; a deferred slice (backoff still
+            # armed, pods mid-recreate) keeps it for the requeued retry
+            if ann_trigger and not notes["deferred"]:
+                self._clear_migrate_annotation(nb)
         return requeue
+
+    # -- verb execution -------------------------------------------------------
+    def _execute_restart(self, nb, entry, span, stamp_restore,
+                         restart_slice) -> None:
+        if entry.get("restamp") and stamp_restore is not None:
+            # a dropped restore intent must leave the template too, or the
+            # recreated pods would resurrect the retired generation
+            stamp_restore(entry["live_name"], entry["idx"])
+        span.add_event("slice.restart", {
+            "slice": entry["idx"], "sts": entry["live_name"],
+            "reason": entry["reason"], "pod": entry["pod"],
+            "attempt": entry["attempt"], "backoff_s": entry["delay"],
+        })
+        self.metrics.slice_restarts.labels(
+            nb.namespace, entry["reason"]).inc()
+        if entry.get("fallback"):
+            # a session store is wired but could not supply a usable
+            # checkpoint: account the degraded outcome
+            self.metrics.migrations.labels(
+                entry.get("trigger") or MIGRATE_TRIGGER_FAILURE,
+                MIGRATE_RESULT_FALLBACK).inc()
+        self.recorder.event(
+            nb.obj, "Normal", EVENT_SLICE_RECOVERY,
+            "restarting slice %d (%s): %s is %s (attempt %d/%d, "
+            "next backoff %.0fs)" % (
+                entry["idx"], entry["live_name"],
+                entry["pod"] or "workers", entry["reason"],
+                entry["attempt"], self.cfg.recovery_max_attempts,
+                entry["delay"]))
+        restart_slice(entry["live_name"])
+
+    def _execute_migrate(self, nb, entry, stamp_restore,
+                         restart_slice) -> None:
+        """The migrate verb, under its own `migrate` phase span: restore
+        stamping first (the recreated pods must boot with the restore
+        env), then the slice-atomic restart.  The write-ahead
+        status.sessionState record already landed before this runs."""
+        snap: SnapshotInfo = entry["snap"]
+        trigger = entry.get("trigger") or MIGRATE_TRIGGER_FAILURE
+        with _TRACER.start_span("migrate", {
+            "phase": "migrate", "namespace": nb.namespace,
+            "notebook": nb.name, "slice": entry["idx"], "trigger": trigger,
+        }) as span:
+            span.add_event("migrate.snapshot", {
+                "slice": entry["idx"], "generation": snap.generation,
+                "digest": snap.digest, "age_s": entry["ckpt_age_s"],
+            })
+            self.metrics.slice_restarts.labels(
+                nb.namespace, REASON_MIGRATE).inc()
+            self.metrics.migrations.labels(
+                trigger, MIGRATE_RESULT_MIGRATED).inc()
+            self.recorder.event(
+                nb.obj, "Normal", EVENT_SLICE_MIGRATION,
+                "migrating slice %d (%s): %s; restoring checkpoint "
+                "generation %d (age %.0fs, attempt %d/%d)" % (
+                    entry["idx"], entry["live_name"],
+                    entry["reason_detail"], snap.generation,
+                    entry["ckpt_age_s"], entry["attempt"],
+                    self.cfg.recovery_max_attempts))
+            if stamp_restore is not None:
+                stamp_restore(entry["live_name"], entry["idx"])
+                span.add_event("migrate.restore_stamped", {
+                    "sts": entry["live_name"],
+                    "generation": snap.generation,
+                })
+            restart_slice(entry["live_name"])
+            span.add_event("slice.restart", {
+                "slice": entry["idx"], "sts": entry["live_name"],
+                "reason": REASON_MIGRATE, "attempt": entry["attempt"],
+                "backoff_s": entry["delay"],
+            })
 
     # -- per-slice decision ---------------------------------------------------
     def _slice_pass(self, nb, idx, live_name, reasons, pending, healthy,
-                    recovery, restarts, events, span, now) -> float:
+                    trigger, stale_session, recovery, session_state,
+                    restarts, events, notes, span, now) -> float:
         key = str(idx)
         state = recovery.get(key, {})
+        session = session_state.get(key, {})
+
+        # an incomplete migration (a worker provably still on the old
+        # session survived the restart sweep) re-enters the migrate flow
+        # as its own trigger — through the same budget, so a slice that
+        # can never complete still exhausts instead of churning
+        if stale_session and trigger is None and not reasons and \
+                not pending:
+            trigger = session.get("trigger") or MIGRATE_TRIGGER_FAILURE
+            span.add_event("migrate.incomplete", {
+                "slice": idx,
+                "generation": session.get("restoreGeneration")})
 
         # resolve Pending into a disruption only past the schedule deadline
         reason = reasons[0][1] if reasons else None
@@ -295,7 +490,11 @@ class RecoveryEngine:
         elif not pending:
             state.pop("pendingSince", None)
 
-        if reason is None:
+        if reason is None and trigger is None:
+            if healthy and session.get("phase") == "migrating":
+                # the migrated slice came back Ready: the restore is done
+                self._migration_restored(nb, idx, session, events, span)
+                session_state[key] = session
             if healthy and state:
                 self._slice_recovered(nb, idx, state, events, span, now)
                 if state:
@@ -306,19 +505,33 @@ class RecoveryEngine:
                 recovery[key] = state  # pendingSince cleanup above
             return 0.0
 
-        # -- disrupted --------------------------------------------------------
-        span.add_event("slice.disrupted", {
-            "slice": idx, "sts": live_name, "reason": reason,
-            "pod": pod_name,
-        })
+        voluntary = reason is None
+        if voluntary and not healthy:
+            # mid-recreate / not-yet-Ready: neither disrupted nor safely
+            # snapshottable — let the slice settle, keep the request
+            notes["deferred"] = True
+            if state:
+                recovery[key] = state
+            return self.cfg.recovery_backoff_base_s
+
+        # -- disrupted or voluntarily migrating -------------------------------
+        if voluntary:
+            span.add_event("migrate.requested", {
+                "slice": idx, "sts": live_name, "trigger": trigger})
+        else:
+            span.add_event("slice.disrupted", {
+                "slice": idx, "sts": live_name, "reason": reason,
+                "pod": pod_name,
+            })
         if state.get("exhausted"):
             # terminal: the budget is spent; an operator action that turns
             # the slice Healthy again (e.g. the restart annotation after a
             # fix) resets it via _slice_recovered
             recovery[key] = state
             return 0.0
-        state.setdefault("disruptedAt", self.clock.now_iso())
-        state["reason"] = reason
+        if not voluntary:
+            state.setdefault("disruptedAt", self.clock.now_iso())
+        state["reason"] = reason if reason is not None else trigger
         attempts = [t for t in state.get("attempts", [])
                     if now - parse_iso(t) < self.cfg.recovery_window_s]
         state["attempts"] = attempts
@@ -329,22 +542,46 @@ class RecoveryEngine:
             span.add_event("recovery.backoff_wait", {
                 "slice": idx, "remaining_s": remaining})
             recovery[key] = state
+            if voluntary:
+                notes["deferred"] = True
             return remaining
 
         if len(attempts) >= self.cfg.recovery_max_attempts:
             state["exhausted"] = True
             recovery[key] = state
             span.add_event("recovery.exhausted", {
-                "slice": idx, "attempts": len(attempts), "reason": reason})
+                "slice": idx, "attempts": len(attempts),
+                "reason": state["reason"]})
             events.append((
                 "Warning", EVENT_RECOVERY_EXHAUSTED,
                 "slice %d (%s) spent its restart budget (%d restarts in "
                 "%.0fs) on %s; manual intervention required" % (
                     idx, live_name, len(attempts),
-                    self.cfg.recovery_window_s, reason)))
+                    self.cfg.recovery_window_s, state["reason"])))
             logger.error(
                 "recovery exhausted for %s/%s slice %d after %d attempts "
-                "(%s)", nb.namespace, nb.name, idx, len(attempts), reason)
+                "(%s)", nb.namespace, nb.name, idx, len(attempts),
+                state["reason"])
+            return 0.0
+
+        # verb decision: migrate when a usable checkpoint can be secured
+        snap = None
+        ckpt_age = 0.0
+        if self.session is not None:
+            snap, ckpt_age = self._secure_checkpoint(nb, idx, span, now)
+        if snap is None and voluntary:
+            # a healthy session is never torn down without its state in
+            # hand — skip, tell the operator, consume the request
+            events.append((
+                "Warning", EVENT_MIGRATION_SKIPPED,
+                "slice %d (%s): voluntary migration (%s) skipped — no "
+                "session checkpoint within %.0fs" % (
+                    idx, live_name, trigger,
+                    self.cfg.checkpoint_max_age_s)))
+            self.metrics.migrations.labels(
+                trigger, MIGRATE_RESULT_SKIPPED).inc()
+            if state:
+                recovery[key] = state
             return 0.0
 
         delay = min(
@@ -355,9 +592,94 @@ class RecoveryEngine:
         state["lastRestartTime"] = stamp
         state["backoffUntil"] = _iso_at(now + delay)
         recovery[key] = state
-        restarts.append((idx, live_name, reason, pod_name, len(attempts),
-                         delay))
+        restamp = False
+        if snap is None and session.get("restoreGeneration") is not None:
+            # the bare fallback restarts COLD: retire the old restore
+            # intent (write-ahead) so the recreated pods don't resurrect
+            # an ancient session generation
+            session_state.pop(key, None)
+            session = {}
+            restamp = True
+        entry = {
+            "idx": idx, "live_name": live_name,
+            "reason": state["reason"], "pod": pod_name,
+            "attempt": len(attempts), "delay": delay,
+            "verb": REASON_MIGRATE if snap is not None else "restart",
+            "trigger": (trigger if voluntary else MIGRATE_TRIGGER_FAILURE)
+            if self.session is not None else None,
+            "snap": snap, "ckpt_age_s": ckpt_age,
+            "restamp": restamp,
+            "fallback": snap is None and self.session is not None,
+            "reason_detail": ("voluntary %s" % trigger) if voluntary
+            else "%s is %s" % (pod_name or "workers", state["reason"]),
+        }
+        if snap is not None:
+            # write-ahead restore intent: mirrored into status.sessionState
+            # before any pod dies, so failover resumes — not repeats — the
+            # restore
+            session.update({
+                "restoreGeneration": snap.generation,
+                "restoreUri": snap.uri,
+                "digest": snap.digest,
+                "savedAt": _iso_at(snap.saved_at),
+                "trigger": entry["trigger"],
+                "phase": "migrating",
+                "migratedAt": self.clock.now_iso(),
+            })
+            session.pop("restoredAt", None)
+            session_state[key] = session
+        restarts.append(entry)
         return delay
+
+    def _secure_checkpoint(self, nb: Notebook, idx: int, span,
+                           now: float) -> tuple[Optional[SnapshotInfo],
+                                                float]:
+        """Best checkpoint for a migrate decision: a just-in-time final
+        snapshot when the slice can still flush (the store dispatches to
+        the data plane), else the freshest stored snapshot within
+        CHECKPOINT_MAX_AGE_S.  Returns (snapshot, age_s) — (None, 0) means
+        the migrate verb is unavailable and restart is the fallback."""
+        final = self.session.request_final_snapshot(
+            nb.namespace, nb.name, idx)
+        if final is not None:
+            self.metrics.checkpoint_snapshots.labels(
+                nb.namespace, TRIGGER_FINAL).inc()
+            self.metrics.checkpoint_age_seconds.labels(
+                nb.namespace).observe(0.0)
+            span.add_event("checkpoint.final", {
+                "slice": idx, "generation": final.generation})
+            return final, 0.0
+        latest = self.session.latest(nb.namespace, nb.name, idx)
+        if latest is None:
+            span.add_event("checkpoint.missing", {"slice": idx})
+            return None, 0.0
+        age = max(now - latest.saved_at, 0.0)
+        self.metrics.checkpoint_age_seconds.labels(
+            nb.namespace).observe(age)
+        if age <= self.cfg.checkpoint_max_age_s:
+            span.add_event("checkpoint.fresh", {
+                "slice": idx, "generation": latest.generation,
+                "age_s": age})
+            return latest, age
+        span.add_event("checkpoint.stale", {
+            "slice": idx, "generation": latest.generation, "age_s": age})
+        return None, age
+
+    def _migration_restored(self, nb, idx, session, events, span) -> None:
+        """The migrated slice reads Healthy: flip the write-ahead record to
+        its terminal phase exactly once (failover-safe — a second manager
+        seeing phase=='restored' does nothing)."""
+        session["phase"] = "restored"
+        session["restoredAt"] = self.clock.now_iso()
+        span.add_event("migrate.restored", {
+            "slice": idx, "generation": session.get("restoreGeneration")})
+        self.metrics.migrations.labels(
+            session.get("trigger") or MIGRATE_TRIGGER_FAILURE,
+            MIGRATE_RESULT_RESTORED).inc()
+        events.append((
+            "Normal", EVENT_MIGRATION_COMPLETE,
+            "slice %d restored session checkpoint generation %s after "
+            "migration" % (idx, session.get("restoreGeneration"))))
 
     def _slice_recovered(self, nb, idx, state, events, span, now) -> None:
         """Disruption over: observe the detection→Healthy latency once and
@@ -393,10 +715,15 @@ class RecoveryEngine:
 
     # -- persistence ----------------------------------------------------------
     def _write_bookkeeping(self, nb: Notebook, recovery: dict,
-                           exhausted: Optional[list[str]] = None) -> None:
-        """Persist status.sliceRecovery (and the RecoveryExhausted
-        condition) with conflict retry.  Runs BEFORE any pod delete of the
-        same pass, so the attempt charge is crash-safe."""
+                           exhausted: Optional[list[str]] = None,
+                           session_state: Optional[dict] = None) -> None:
+        """Persist status.sliceRecovery + status.sessionState (and the
+        RecoveryExhausted condition) with conflict retry.  Runs BEFORE any
+        pod delete of the same pass, so the attempt charge and the restore
+        intent are crash-safe.  `session_state` None leaves
+        status.sessionState untouched (the Stopped-cleanup path drops only
+        the recovery budget — the pre-cull checkpoint record must
+        survive)."""
         exhausted = exhausted or []
 
         def write() -> None:
@@ -409,6 +736,11 @@ class RecoveryEngine:
                 st["sliceRecovery"] = copy.deepcopy(recovery)
             else:
                 st.pop("sliceRecovery", None)
+            if session_state is not None:
+                if session_state:
+                    st["sessionState"] = copy.deepcopy(session_state)
+                else:
+                    st.pop("sessionState", None)
             conds = list(st.get("conditions") or [])
             existing = next(
                 (c for c in conds
@@ -436,6 +768,18 @@ class RecoveryEngine:
 
         retry_on_conflict(write)
 
+    def _clear_migrate_annotation(self, nb: Notebook) -> None:
+        def clear() -> None:
+            try:
+                live = self.api.get("Notebook", nb.namespace, nb.name)
+            except NotFoundError:
+                return
+            if C.ANNOTATION_MIGRATE in live.metadata.annotations:
+                live.metadata.annotations.pop(C.ANNOTATION_MIGRATE, None)
+                self.api.update(live)
+
+        retry_on_conflict(clear)
+
 
 def _merge_requeue(current: float, hint: float) -> float:
     """Combine requeue-after hints: 0 means 'none'; otherwise soonest
@@ -454,12 +798,22 @@ def _iso_at(t: float) -> str:
 
 
 __all__ = [
+    "MIGRATE_RESULT_FALLBACK",
+    "MIGRATE_RESULT_MIGRATED",
+    "MIGRATE_RESULT_RESTORED",
+    "MIGRATE_RESULT_SKIPPED",
+    "MIGRATE_TRIGGER_DEFRAG",
+    "MIGRATE_TRIGGER_DRAIN",
+    "MIGRATE_TRIGGER_FAILURE",
+    "MIGRATE_TRIGGER_NODE_DRAIN",
     "PENDING",
     "REASON_CRASH_LOOP",
+    "REASON_MIGRATE",
     "REASON_NODE_GONE",
     "REASON_PENDING_TIMEOUT",
     "REASON_POD_FAILED",
     "RecoveryEngine",
     "SliceRestartError",
     "classify_worker",
+    "node_drained",
 ]

@@ -71,6 +71,58 @@ def _base_pod_template(nb: Notebook, cfg: CoreConfig, sts_name: str) -> dict:
     }
 
 
+def _render_checkpoint_contract(
+    nb: Notebook, cfg: CoreConfig, template: dict, slice_id: int
+) -> None:
+    """Checkpoint-sidecar contract on a TPU worker template (rendered only
+    when CHECKPOINT_STORE_URI is configured):
+
+    - env the in-pod runtime reads (runtime/checkpoint.py): the store URI
+      and the periodic snapshot interval;
+    - restore stamping: when `status.sessionState` carries a restore
+      intent for this slice (the migrate verb's write-ahead record), the
+      recreated pods get CHECKPOINT_RESTORE_URI/_GENERATION so the
+      runtime reloads the session instead of starting cold;
+    - a pre-stop exec hook (one last snapshot before any pod delete) and
+      the downward-API podinfo projection of the checkpoint-requested
+      annotation — the file transport CullSignalWatcher polls, so
+      periodic + pre-delete + cull snapshots all flow to the store."""
+    pod_spec = template["spec"]
+    main = pod_spec["containers"][0]
+    injected = [
+        {"name": C.ENV_CHECKPOINT_STORE_URI,
+         "value": cfg.checkpoint_store_uri},
+        {"name": C.ENV_CHECKPOINT_INTERVAL_S,
+         "value": f"{cfg.checkpoint_interval_s:g}"},
+    ]
+    session = (nb.status.get("sessionState") or {}).get(str(slice_id)) or {}
+    if session.get("restoreGeneration") is not None:
+        injected += [
+            {"name": C.ENV_CHECKPOINT_RESTORE_URI,
+             "value": session.get("restoreUri")
+             or cfg.checkpoint_store_uri},
+            {"name": C.ENV_CHECKPOINT_RESTORE_GENERATION,
+             "value": str(session["restoreGeneration"])},
+        ]
+    main["env"] = tpuenv.merge_env(main["env"], injected)
+    main.setdefault("lifecycle", {}).setdefault("preStop", {
+        "exec": {"command": ["python", "-m",
+                             "kubeflow_tpu.runtime.checkpoint",
+                             "--pre-stop"]},
+    })
+    tpuenv.upsert_by_name(pod_spec.setdefault("volumes", []), {
+        "name": "podinfo",
+        "downwardAPI": {"items": [{
+            "path": "checkpoint-requested",
+            "fieldRef": {"fieldPath": "metadata.annotations['%s']"
+                         % C.ANNOTATION_CHECKPOINT_REQUESTED},
+        }]},
+    })
+    tpuenv.upsert_by_name(main.setdefault("volumeMounts", []), {
+        "name": "podinfo", "mountPath": "/etc/podinfo",
+    })
+
+
 def _sts_meta(nb: Notebook, name: str, use_generate_name: bool) -> ObjectMeta:
     if use_generate_name:
         # name-length guard (notebook_controller.go:142-149): controller
@@ -125,6 +177,8 @@ def generate_statefulsets(nb: Notebook, cfg: CoreConfig) -> list[KubeObject]:
         main["env"] = tpuenv.merge_env(
             main["env"], tpuenv.tpu_env_vars(nb.name, shape, slice_id, tpu.slices)
         )
+        if cfg.checkpoint_store_uri:
+            _render_checkpoint_contract(nb, cfg, template, slice_id)
         sts = KubeObject(
             api_version="apps/v1",
             kind="StatefulSet",

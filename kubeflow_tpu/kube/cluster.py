@@ -20,6 +20,14 @@ from .errors import NotFoundError
 from .meta import KubeObject, ObjectMeta, set_controller_reference
 from .store import ApiServer, EventType, WatchEvent
 
+# mirrored from core.constants (string-identical; kept literal here so the
+# kube substrate stays importable without the core package)
+_NOTEBOOK_NAME_LABEL = "notebook-name"
+_TPU_SLICE_LABEL = "notebooks.kubeflow.org/tpu-slice"
+_RESTORED_GENERATION_ANNOTATION = \
+    "notebooks.kubeflow.org/restored-generation"
+_RESTORED_DIGEST_ANNOTATION = "notebooks.kubeflow.org/restored-digest"
+
 
 def parse_quantity(q) -> float:
     """Minimal k8s resource.Quantity parser (enough for cpu/memory/tpu)."""
@@ -53,6 +61,14 @@ class FakeCluster:
         # (namespace, sts_name) -> failure reason: pods (re)created for a
         # poisoned StatefulSet come up Failed (see poison_statefulset)
         self._poisoned: dict[tuple[str, str], str] = {}
+        # session-state plumbing (attach_session_store): the fake data
+        # plane plays the checkpoint sidecar — it answers the control
+        # plane's final-snapshot requests for reachable slices and stamps
+        # restored-generation/digest onto pods created with
+        # CHECKPOINT_RESTORE_* env, the audit trail restored-state
+        # equivalence drills assert against
+        self._session_store = None
+        self._session_payload: dict[tuple[str, str], bytes] = {}
         api.watch(self._on_event)
 
     # -- node inventory --------------------------------------------------------
@@ -172,6 +188,155 @@ class FakeCluster:
             except NotFoundError:
                 pass
 
+    def cordon_node(self, name: str) -> None:
+        """Chaos hook: mark a node unschedulable (kubectl cordon) — the
+        voluntary-migration trigger.  Pods already on the node keep
+        running; the fake scheduler stops placing new ones there."""
+        with self.api.fault_exempt():
+            node = self.api.try_get("Node", "", name)
+            if node is None:
+                return
+            node.spec["unschedulable"] = True
+            self.api.update(node)
+
+    def uncordon_node(self, name: str) -> None:
+        with self.api.fault_exempt():
+            node = self.api.try_get("Node", "", name)
+            if node is None:
+                return
+            node.spec.pop("unschedulable", None)
+            self.api.update(node)
+
+    def mark_running(self, namespace: str, name: str) -> None:
+        """Drive a created-but-not-yet-Ready pod to Running/Ready by hand —
+        the auto_ready=False escape hatch failover drills use to freeze the
+        cluster mid-recreate and resume it under a different manager."""
+        with self.api.fault_exempt():
+            pod = self.api.try_get("Pod", namespace, name)
+            if pod is None or not pod.spec.get("nodeName"):
+                return
+            self._mark_running(pod)
+            self._sync_sts_status_for_pod(pod)
+
+    # -- session-state data plane ----------------------------------------------
+    def attach_session_store(self, store,
+                             default_payload: bytes = b"jax-session") -> None:
+        """Wire a core.sessionstate store: this cluster now answers
+        `request_final_snapshot` (a reachable slice flushes its current
+        session payload as a `final` snapshot; an unreachable one returns
+        None) and stamps restore annotations onto pods that boot with
+        CHECKPOINT_RESTORE_* env."""
+        self._session_store = store
+        self._session_default_payload = default_payload
+        store.set_final_snapshot_handler(self._final_snapshot)
+
+    def set_session_payload(self, namespace: str, notebook: str,
+                            payload: bytes) -> None:
+        """The simulated in-memory kernel state of one notebook — what
+        snapshots capture and restores must reproduce."""
+        self._session_payload[(namespace, notebook)] = bytes(payload)
+
+    def session_payload(self, namespace: str, notebook: str) -> bytes:
+        return self._session_payload.get(
+            (namespace, notebook),
+            getattr(self, "_session_default_payload", b"jax-session"))
+
+    def snapshot_sessions(self, namespace: str, notebook: str,
+                          trigger: str = "periodic") -> list:
+        """Simulate the in-pod sidecar's periodic snapshot tick: write one
+        snapshot of the current session payload per live slice."""
+        assert self._session_store is not None, "attach_session_store first"
+        infos = []
+        with self.api.fault_exempt():
+            for slice_id in sorted(self._slice_ids(namespace, notebook)):
+                infos.append(self._session_store.put(
+                    namespace, notebook, slice_id,
+                    self.session_payload(namespace, notebook),
+                    trigger=trigger))
+        return infos
+
+    def _slice_ids(self, namespace: str, notebook: str) -> set[int]:
+        out = set()
+        for pod in self.api.list("Pod", namespace=namespace):
+            labels = pod.metadata.labels
+            if labels.get(_NOTEBOOK_NAME_LABEL) != notebook:
+                continue
+            try:
+                out.add(int(labels.get(_TPU_SLICE_LABEL, "0")))
+            except ValueError:
+                continue
+        return out
+
+    def _final_snapshot(self, namespace: str, notebook: str,
+                        slice_id: int):
+        """The control plane asked the slice to flush NOW.  Reachable =
+        every worker pod of the slice exists, is Running with live
+        containers, and still has its (Ready) node — then the current
+        session payload lands as a `final` snapshot.  Anything less
+        returns None and the engine falls back to stored checkpoints."""
+        with self.api.fault_exempt():
+            pods = [
+                p for p in self.api.list("Pod", namespace=namespace)
+                if p.metadata.labels.get(_NOTEBOOK_NAME_LABEL) == notebook
+                and p.metadata.labels.get(_TPU_SLICE_LABEL,
+                                          "0") == str(slice_id)
+            ]
+            if not pods:
+                return None
+            for pod in pods:
+                if (namespace, pod.name) in self._failed_pods:
+                    return None
+                status = pod.body.get("status", {}) or {}
+                if status.get("phase") != "Running":
+                    return None
+                for cs in status.get("containerStatuses", []) or []:
+                    waiting = (cs.get("state") or {}).get("waiting") or {}
+                    if waiting.get("reason") == "CrashLoopBackOff":
+                        return None
+                node_name = pod.spec.get("nodeName", "")
+                node = self.api.try_get("Node", "", node_name) \
+                    if node_name else None
+                if node_name and (node is None or not any(
+                        c.get("type") == "Ready"
+                        and c.get("status") == "True"
+                        for c in node.body.get("status", {}).get(
+                            "conditions", []))):
+                    return None
+            return self._session_store.put(
+                namespace, notebook, slice_id,
+                self.session_payload(namespace, notebook), trigger="final")
+
+    def _apply_restore_stamp(self, pod: KubeObject) -> None:
+        """A pod whose template carries CHECKPOINT_RESTORE_* env boots by
+        restoring that snapshot — the fake kubelet records what the
+        runtime would have done as annotations on the pod."""
+        if self._session_store is None:
+            return
+        env = {}
+        for c in pod.spec.get("containers", []):
+            for e in c.get("env", []) or []:
+                if "value" in e:
+                    env.setdefault(e.get("name"), e["value"])
+        gen_raw = env.get("CHECKPOINT_RESTORE_GENERATION")
+        if gen_raw is None:
+            return
+        try:
+            generation = int(gen_raw)
+        except ValueError:
+            return
+        notebook = pod.metadata.labels.get(_NOTEBOOK_NAME_LABEL, "")
+        try:
+            slice_id = int(pod.metadata.labels.get(_TPU_SLICE_LABEL, "0"))
+        except ValueError:
+            slice_id = 0
+        info = self._session_store.info(
+            pod.namespace, notebook, slice_id, generation)
+        if info is None:
+            return
+        pod.metadata.annotations[_RESTORED_GENERATION_ANNOTATION] = \
+            str(generation)
+        pod.metadata.annotations[_RESTORED_DIGEST_ANNOTATION] = info.digest
+
     def poison_statefulset(self, namespace: str, name: str,
                            reason: str = "TPUUnhealthy") -> None:
         """Chaos hook: every pod (re)created for this StatefulSet comes up
@@ -266,6 +431,7 @@ class FakeCluster:
         pod.metadata.labels.setdefault(
             "statefulset.kubernetes.io/pod-name", name
         )
+        self._apply_restore_stamp(pod)
         sts_live = self.api.get("StatefulSet", namespace, sts.name)
         set_controller_reference(sts_live, pod)
 
@@ -324,6 +490,8 @@ class FakeCluster:
             for res, q in (c.get("resources", {}).get("requests") or {}).items():
                 requests[res] = requests.get(res, 0.0) + parse_quantity(q)
         for node in self.api.list("Node"):
+            if node.spec.get("unschedulable"):
+                continue  # cordoned: kube-scheduler never places here
             node_labels = node.metadata.labels
             if not all(node_labels.get(k) == v for k, v in selector.items()):
                 continue

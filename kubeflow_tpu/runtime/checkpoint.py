@@ -1,4 +1,4 @@
-"""Checkpoint/restore hooks with cull-signal integration.
+"""Checkpoint/restore hooks with cull-signal + session-store integration.
 
 The reference has no in-process checkpointing — all state is CR annotations
 (SURVEY.md §5 "Checkpoint/resume").  A TPU notebook does real training, so
@@ -10,59 +10,206 @@ protocol (core/constants.py ANNOTATION_CHECKPOINT_REQUESTED/_COMPLETE):
 
 The signal transport is a file because annotations are projected into pods
 via the downward API; tests drive the same path with a tmp file.
+
+Two extensions ride on top:
+
+- **Torn-write safety.**  `CheckpointManager` grows a pure-python `local`
+  backend (the default when orbax is absent) whose `save` writes a temp
+  file, fsyncs, then atomically renames — and whose `restore` skips and
+  garbage-collects partial/corrupt writes, so a worker killed mid-save can
+  never resurrect a half-written step.
+
+- **The session-state tier** (core/sessionstate.py): `CheckpointSidecar`
+  implements the pod side of the checkpoint-sidecar contract the
+  controller renders into the StatefulSet template — periodic snapshots
+  every CHECKPOINT_INTERVAL_S into CHECKPOINT_STORE_URI, a forced
+  snapshot + acknowledge when the cull signal fires, and
+  `restore_instructions`/`restore_payload` consuming the
+  CHECKPOINT_RESTORE_URI/_GENERATION env the migrate verb stamps into
+  recreated pods.
 """
 
 from __future__ import annotations
 
 import os
+import pickle
 import threading
 import time
+from dataclasses import dataclass
 from pathlib import Path
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Mapping, Optional
 
 DEFAULT_SIGNAL_DIR = "/etc/podinfo"
 REQUEST_FILE = "checkpoint-requested"
 ACK_FILE = "checkpoint-complete"
 
+# the sidecar contract env (mirrors core.constants ENV_CHECKPOINT_*)
+ENV_STORE_URI = "CHECKPOINT_STORE_URI"
+ENV_INTERVAL_S = "CHECKPOINT_INTERVAL_S"
+ENV_RESTORE_URI = "CHECKPOINT_RESTORE_URI"
+ENV_RESTORE_GENERATION = "CHECKPOINT_RESTORE_GENERATION"
+
+_STEP_PREFIX = "step_"
+_STEP_SUFFIX = ".ckpt"
+_TMP_PREFIX = ".tmp-"
+
+
+def _to_host(tree: Any) -> Any:
+    """Device arrays -> host numpy before pickling (a local checkpoint must
+    not capture device buffers)."""
+    try:
+        import jax
+        import numpy as np
+    except ImportError:
+        return tree
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _like(state_like: Any, stored: Any) -> Any:
+    """Re-materialize restored leaves in the shape/type of `state_like`
+    (the orbax StandardRestore analog)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError:
+        return stored
+    if state_like is None:
+        return jax.tree.map(jnp.asarray, stored)
+    return jax.tree.map(lambda _, v: jnp.asarray(v), state_like, stored)
+
 
 class CheckpointManager:
-    """Thin Orbax wrapper: sharded async-capable save/restore keyed by step.
+    """Sharded async-capable save/restore keyed by step.
 
-    Multi-host safe: orbax coordinates the distributed write itself; every
-    process must call save/restore collectively.
+    backend="orbax" (the default when orbax is importable) delegates to an
+    Orbax CheckpointManager — multi-host safe, every process must call
+    save/restore collectively.  backend="local" is the dependency-free
+    single-host path with torn-write hardening: save is temp-write ->
+    fsync -> atomic rename, restore walks steps newest-first, skipping and
+    GC-ing anything partial or unreadable.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 backend: str = "auto"):
         self.directory = Path(directory)
-        self.manager = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
-            ),
-        )
+        self.max_to_keep = max_to_keep
+        if backend == "auto":
+            try:
+                import orbax.checkpoint  # noqa: F401
 
+                backend = "orbax"
+            except ImportError:
+                backend = "local"
+        self.backend = backend
+        if backend == "orbax":
+            import orbax.checkpoint as ocp
+
+            self._ocp = ocp
+            self.manager = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True
+                ),
+            )
+        else:
+            self.manager = None
+            self.directory.mkdir(parents=True, exist_ok=True)
+            self._gc_partials()
+
+    # -- local backend ---------------------------------------------------------
+    def _step_path(self, step: int) -> Path:
+        return self.directory / f"{_STEP_PREFIX}{step}{_STEP_SUFFIX}"
+
+    def _local_steps(self) -> list[int]:
+        steps = []
+        for p in self.directory.glob(f"{_STEP_PREFIX}*{_STEP_SUFFIX}"):
+            raw = p.name[len(_STEP_PREFIX):-len(_STEP_SUFFIX)]
+            if raw.isdigit():
+                steps.append(int(raw))
+        return sorted(steps)
+
+    def _gc_partials(self) -> None:
+        """Temp files under the checkpoint dir are saves that never reached
+        their atomic rename (killed mid-save): dead weight, never visible
+        as checkpoints — reclaim them."""
+        for tmp in self.directory.glob(f"{_TMP_PREFIX}*"):
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    def _local_save(self, step: int, state: Any) -> None:
+        payload = pickle.dumps(_to_host(state))
+        final = self._step_path(step)
+        tmp = self.directory / f"{_TMP_PREFIX}{final.name}-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        # the atomic commit point: a crash before this line leaves only
+        # the tmp file (GC'd later), a crash after it a complete step
+        os.replace(tmp, final)
+        dirfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        for stale in self._local_steps()[:-self.max_to_keep]:
+            try:
+                self._step_path(stale).unlink()
+            except OSError:
+                pass
+
+    def _local_restore(self, state_like: Any,
+                       step: Optional[int]) -> Any:
+        self._gc_partials()
+        candidates = [step] if step is not None else \
+            list(reversed(self._local_steps()))
+        for s in candidates:
+            path = self._step_path(s)
+            try:
+                stored = pickle.loads(path.read_bytes())
+            except (OSError, pickle.UnpicklingError, EOFError,
+                    AttributeError, ValueError):
+                # unreadable/corrupt step: GC it and fall back to the
+                # next-older checkpoint instead of failing the boot
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+                continue
+            return _like(state_like, stored)
+        return None
+
+    # -- shared surface --------------------------------------------------------
     def save(self, step: int, state: Any, wait: bool = False) -> None:
-        self.manager.save(step, args=self._ocp.args.StandardSave(state))
-        if wait:
-            self.manager.wait_until_finished()
+        if self.backend == "orbax":
+            self.manager.save(step, args=self._ocp.args.StandardSave(state))
+            if wait:
+                self.manager.wait_until_finished()
+        else:
+            self._local_save(step, state)
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
-        step = step if step is not None else self.manager.latest_step()
-        if step is None:
-            return None
-        return self.manager.restore(
-            step, args=self._ocp.args.StandardRestore(state_like)
-        )
+        if self.backend == "orbax":
+            step = step if step is not None else self.manager.latest_step()
+            if step is None:
+                return None
+            return self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(state_like)
+            )
+        return self._local_restore(state_like, step)
 
     def latest_step(self) -> Optional[int]:
-        return self.manager.latest_step()
+        if self.backend == "orbax":
+            return self.manager.latest_step()
+        steps = self._local_steps()
+        return steps[-1] if steps else None
 
     def close(self) -> None:
-        self.manager.wait_until_finished()
-        self.manager.close()
+        if self.backend == "orbax":
+            self.manager.wait_until_finished()
+            self.manager.close()
 
 
 class CullSignalWatcher:
@@ -106,3 +253,107 @@ def checkpoint_on_cull(
         return True
 
     return hook
+
+
+# -- session-state sidecar (the pod side of the migrate contract) --------------
+@dataclass(frozen=True)
+class RestoreInstruction:
+    """What a recreated pod of a migrated slice must restore: stamped into
+    the pod env by the recovery engine (CHECKPOINT_RESTORE_*)."""
+
+    uri: str
+    generation: int
+
+
+def restore_instructions(
+        env: Optional[Mapping[str, str]] = None) -> Optional[RestoreInstruction]:
+    env = env if env is not None else os.environ
+    uri = env.get(ENV_RESTORE_URI, "").strip()
+    raw = env.get(ENV_RESTORE_GENERATION, "").strip()
+    if not uri or not raw:
+        return None
+    try:
+        return RestoreInstruction(uri=uri, generation=int(raw))
+    except ValueError:
+        return None
+
+
+class CheckpointSidecar:
+    """Periodic + pre-stop/cull session snapshots into the session-state
+    store (core/sessionstate.py), addressed by notebook identity.
+
+    Drive `maybe_snapshot(step, payload_fn)` from the training/serving
+    loop: it snapshots when the periodic interval elapsed, and immediately
+    (plus acknowledges) when the cull signal file appears.  `payload_fn`
+    returns the serialized session bytes only when actually needed."""
+
+    def __init__(self, store, namespace: str, notebook: str, slice_id: int,
+                 interval_s: float = 300.0,
+                 watcher: Optional[CullSignalWatcher] = None,
+                 time_fn: Callable[[], float] = time.time):
+        self.store = store
+        self.namespace = namespace
+        self.notebook = notebook
+        self.slice_id = slice_id
+        self.interval_s = interval_s
+        self.watcher = watcher
+        self.time_fn = time_fn
+        self._last_snapshot: Optional[float] = None
+        self._cull_acked = False
+
+    @classmethod
+    def from_env(cls, namespace: str, notebook: str, slice_id: int,
+                 env: Optional[Mapping[str, str]] = None,
+                 watcher: Optional[CullSignalWatcher] = None,
+                 time_fn: Callable[[], float] = time.time
+                 ) -> Optional["CheckpointSidecar"]:
+        """Build from the rendered sidecar contract; None when the
+        controller did not configure a store (contract absent)."""
+        env = env if env is not None else os.environ
+        uri = env.get(ENV_STORE_URI, "").strip()
+        if not uri:
+            return None
+        try:
+            interval = float(env.get(ENV_INTERVAL_S, "") or 300.0)
+        except ValueError:
+            interval = 300.0
+        from ..core.sessionstate import open_store
+
+        return cls(open_store(uri), namespace, notebook, slice_id,
+                   interval_s=interval, watcher=watcher, time_fn=time_fn)
+
+    def maybe_snapshot(self, payload_fn: Callable[[], bytes]):
+        """Returns the SnapshotInfo written this call, or None."""
+        now = self.time_fn()
+        if self.watcher is not None and not self._cull_acked \
+                and self.watcher.check():
+            info = self.store.put(self.namespace, self.notebook,
+                                  self.slice_id, payload_fn(),
+                                  trigger="cull")
+            self.watcher.acknowledge()
+            self._cull_acked = True
+            self._last_snapshot = now
+            return info
+        if self._last_snapshot is not None and \
+                now - self._last_snapshot < self.interval_s:
+            return None
+        info = self.store.put(self.namespace, self.notebook, self.slice_id,
+                              payload_fn(), trigger="periodic")
+        self._last_snapshot = now
+        return info
+
+    def snapshot_now(self, payload: bytes, trigger: str = "pre-stop"):
+        """The pre-stop hook path: one last flush before the pod dies."""
+        self._last_snapshot = self.time_fn()
+        return self.store.put(self.namespace, self.notebook, self.slice_id,
+                              payload, trigger=trigger)
+
+    def restore_payload(
+            self, env: Optional[Mapping[str, str]] = None) -> Optional[bytes]:
+        """The boot path of a migrated pod: fetch the stamped generation's
+        payload (None -> cold start)."""
+        instr = restore_instructions(env)
+        if instr is None:
+            return None
+        return self.store.payload(self.namespace, self.notebook,
+                                  self.slice_id, instr.generation)

@@ -56,6 +56,19 @@ def _int(env: Mapping[str, str], key: str, default: int) -> int:
         return default
 
 
+def _float(env: Mapping[str, str], key: str, default: float) -> float:
+    """Float knob parse.  Duration knobs MUST come through here, not
+    `_int`: sub-second values like RECOVERY_BACKOFF_BASE_S=0.5 (fast soak
+    configs) silently truncated to the default under int()."""
+    v = env.get(key)
+    if v is None or not v.strip():
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
 @dataclass
 class CoreConfig:
     """Core notebook-controller config (reference main.go:58-148 flags +
@@ -96,6 +109,17 @@ class CoreConfig:
     recovery_max_attempts: int = 5            # RECOVERY_MAX_ATTEMPTS
     recovery_window_s: float = 3600.0         # RECOVERY_WINDOW_S
     recovery_pending_deadline_s: float = 300.0  # RECOVERY_PENDING_DEADLINE_S
+    # session-state tier (core/sessionstate.py + runtime/checkpoint.py):
+    # a non-empty store URI turns on the checkpoint-sidecar contract in the
+    # rendered pod template and teaches the RecoveryEngine the `migrate`
+    # verb.  A checkpoint older than checkpoint_max_age_s is stale — the
+    # engine falls back to a bare restart rather than restoring an ancient
+    # session.  checkpoint_signal_root hosts the per-notebook cull-signal
+    # dirs the CullSignalWatcher polls (empty = annotation handshake only).
+    checkpoint_store_uri: str = ""            # CHECKPOINT_STORE_URI
+    checkpoint_interval_s: float = 300.0      # CHECKPOINT_INTERVAL_S
+    checkpoint_max_age_s: float = 600.0       # CHECKPOINT_MAX_AGE_S
+    checkpoint_signal_root: str = ""          # CHECKPOINT_SIGNAL_ROOT
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -119,14 +143,20 @@ class CoreConfig:
             workqueue_burst=_int(env, "WORKQUEUE_BURST", 100),
             workqueue_workers=max(1, _int(env, "WORKQUEUE_WORKERS", 1)),
             enable_self_healing=_bool(env, "ENABLE_SELF_HEALING", True),
-            recovery_backoff_base_s=float(
-                _int(env, "RECOVERY_BACKOFF_BASE_S", 10)),
-            recovery_backoff_max_s=float(
-                _int(env, "RECOVERY_BACKOFF_MAX_S", 300)),
+            recovery_backoff_base_s=_float(
+                env, "RECOVERY_BACKOFF_BASE_S", 10.0),
+            recovery_backoff_max_s=_float(
+                env, "RECOVERY_BACKOFF_MAX_S", 300.0),
             recovery_max_attempts=_int(env, "RECOVERY_MAX_ATTEMPTS", 5),
-            recovery_window_s=float(_int(env, "RECOVERY_WINDOW_S", 3600)),
-            recovery_pending_deadline_s=float(
-                _int(env, "RECOVERY_PENDING_DEADLINE_S", 300)),
+            recovery_window_s=_float(env, "RECOVERY_WINDOW_S", 3600.0),
+            recovery_pending_deadline_s=_float(
+                env, "RECOVERY_PENDING_DEADLINE_S", 300.0),
+            checkpoint_store_uri=env.get("CHECKPOINT_STORE_URI", ""),
+            checkpoint_interval_s=_float(
+                env, "CHECKPOINT_INTERVAL_S", 300.0),
+            checkpoint_max_age_s=_float(
+                env, "CHECKPOINT_MAX_AGE_S", 600.0),
+            checkpoint_signal_root=env.get("CHECKPOINT_SIGNAL_ROOT", ""),
         )
 
 

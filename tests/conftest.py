@@ -51,8 +51,11 @@ def pytest_collection_modifyitems(config, items):
     # fail-open guard: a module is XLA-heavy iff it imports the compute
     # plane — a new model-test module missing from _COMPUTE_MODULES must
     # fail collection loudly, not silently join the fast lane
+    # runtime.checkpoint is exempt: its jax/orbax imports are lazy (the
+    # cull-signal + session-store plumbing is pure stdlib), so importing
+    # it does not drag XLA into the fast lane
     compute_import = re.compile(
-        r"kubeflow_tpu\.(models|ops|parallel|runtime)\b")
+        r"kubeflow_tpu\.(models|ops|parallel|runtime(?!\.checkpoint\b))")
     jax_import = re.compile(r"^\s*(?:import|from)\s+jax\b", re.M)
     seen_modules = {}
     for item in items:

@@ -36,6 +36,7 @@ CENTRAL_NS = "opendatahub"
 SOAK_ROUNDS = int(os.environ.get("CHAOS_SOAK_ROUNDS", "20"))
 SOAK_SEED = int(os.environ.get("CHAOS_SOAK_SEED", "20260804"))
 SELFHEAL_SOAK_ROUNDS = int(os.environ.get("SELFHEAL_SOAK_ROUNDS", "12"))
+MIGRATE_SOAK_ROUNDS = int(os.environ.get("MIGRATE_SOAK_ROUNDS", "10"))
 
 # the kinds the workbench controllers actually traffic in — the fault
 # plans draw their per-kind targeting from this pool
@@ -519,6 +520,262 @@ class TestSliceRecoverySoak:
         # terminal: a long quiet period adds zero restarts
         mgr.advance(3600)
         assert self._assert_slice_atomic(api, "doomed") == \
+            cfg.recovery_max_attempts
+        assert not mgr.dropped_errors
+
+
+class TestMigrationRecoverySoak:
+    """ISSUE-6 acceptance: the seeded checkpoint/migrate drill.  With a
+    fresh session checkpoint a disrupted slice recovers via the `migrate`
+    verb — audit-verified snapshot -> whole-slice restart -> restore
+    stamping — and the restored session is byte-equivalent (digest) to the
+    pre-disruption snapshot; with a stale checkpoint it falls back to the
+    bare restart, accounted separately in
+    notebook_slice_restarts_total{reason}; and a manager failover
+    mid-migration resumes from status.sessionState without
+    double-restoring."""
+
+    HOSTS = 4
+
+    CFG = dict(
+        recovery_backoff_base_s=1.0,
+        recovery_backoff_max_s=30.0,
+        recovery_max_attempts=4,
+        recovery_window_s=120.0,
+        recovery_pending_deadline_s=60.0,
+        checkpoint_store_uri="mem://session-state",
+        checkpoint_max_age_s=300.0,
+    )
+
+    # API faults for this soak target the control-plane verbs, not Pod
+    # deletes: a delete that fails mid-sweep legitimately leaves workers
+    # of the OLD session running until the next detection pass (covered by
+    # TestSliceRecoverySoak), which would make byte-exact equivalence
+    # assertions racy here.  The drill's subject is state fidelity.
+    FAULT_KINDS = ("Notebook", "StatefulSet", "Service", "ConfigMap",
+                   "Event")
+
+    def _env(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+        from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 8, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock,
+                      flight_recorder=FlightRecorder(capacity=16384,
+                                                     per_object=4096))
+        store = InMemorySessionStore(clock=clock)
+        cluster.attach_session_store(store)
+        cfg = CoreConfig(**self.CFG)
+        metrics = NotebookMetrics(api)
+        setup_core_controllers(mgr, cfg, metrics, session=store)
+        return api, cluster, mgr, clock, cfg, metrics, store
+
+    def _delete_groups(self, api, name):
+        recs = [r for r in api.audit_log(verb="delete", kind="Pod")
+                if r.name.startswith(name + "-")]
+        expected = {f"{name}-{i}" for i in range(self.HOSTS)}
+        for i in range(0, len(recs), self.HOSTS):
+            chunk = {r.name for r in recs[i:i + self.HOSTS]}
+            assert chunk == expected, (
+                "partial-slice pod deletion observed",
+                [(r.name, r.ok) for r in recs])
+        return len(recs) // self.HOSTS
+
+    def _restored_stamps(self, api, ns="user1"):
+        from kubeflow_tpu.core import constants as CC
+
+        return {
+            p.name: (p.metadata.annotations.get(
+                CC.ANNOTATION_RESTORED_GENERATION),
+                p.metadata.annotations.get(CC.ANNOTATION_RESTORED_DIGEST))
+            for p in api.list("Pod", namespace=ns)
+        }
+
+    def test_seeded_migration_drill_restores_state(self):
+        """Seeded rounds of disrupt-with-checkpoint: fresh rounds must
+        migrate and restore the exact pre-disruption snapshot; stale
+        rounds must bare-restart with NO restore stamping — the two verbs'
+        accounting kept separate and exact across the whole soak."""
+        api, cluster, mgr, clock, cfg, metrics, store = self._env()
+        nb = Notebook.new("migsoak", "user1", tpu=TPUSpec("v5e", "4x4"))
+        api.create(nb.obj)
+        mgr.run_until_idle()
+
+        print(f"\nmigration soak: seed={SOAK_SEED} "
+              f"rounds={MIGRATE_SOAK_ROUNDS} "
+              "(reproduce with CHAOS_SOAK_SEED/MIGRATE_SOAK_ROUNDS)")
+        rng = random.Random(SOAK_SEED + 29)
+        expect_migrated = 0
+        expect_bare = 0
+        for round_i in range(MIGRATE_SOAK_ROUNDS):
+            payload = b"kernel-%d-%d" % (round_i, rng.randrange(2**32))
+            cluster.set_session_payload("user1", "migsoak", payload)
+            (snap,) = cluster.snapshot_sessions("user1", "migsoak")
+            # every third round runs with a stale checkpoint — a fixed
+            # cadence (not seed-drawn) so ANY round count exercises both
+            # verbs and the expected accounting stays exact
+            stale = round_i % 3 == 1
+            if stale:
+                # age the checkpoint past CHECKPOINT_MAX_AGE_S (and the
+                # sliding budget window, which is shorter) before the hit
+                mgr.advance(cfg.checkpoint_max_age_s + 60)
+            plan_seed = rng.randrange(2**31)
+            plan = random_fault_plan(plan_seed, kinds=self.FAULT_KINDS,
+                                     clock=mgr.clock)
+            api.install_fault_plan(plan)
+            kind = rng.choice(["fail_one", "fail_two", "crashloop"])
+            with api.fault_exempt():
+                if kind == "fail_one":
+                    cluster.fail_pod("user1",
+                                     f"migsoak-{rng.randrange(4)}")
+                elif kind == "fail_two":
+                    for i in rng.sample(range(4), 2):
+                        cluster.fail_pod("user1", f"migsoak-{i}")
+                else:
+                    cluster.crashloop_pod("user1",
+                                          f"migsoak-{rng.randrange(4)}")
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+            api.clear_fault_plan()
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+
+            assert not mgr.dropped_errors, (round_i, kind, plan_seed)
+            status = api.get("Notebook", "user1",
+                             "migsoak").body["status"]
+            assert status["sliceHealth"] == "Healthy", (round_i, kind)
+            stamps = self._restored_stamps(api)
+            if stale:
+                expect_bare += 1
+                # bare restart: the recreated session started cold
+                assert all(g is None for g, _ in stamps.values()), \
+                    (round_i, stamps)
+            else:
+                expect_migrated += 1
+                # restored-state equivalence: every worker restored the
+                # pre-disruption session byte-for-byte (digest).  The
+                # generation may legitimately advance past the periodic
+                # snapshot when an injected fault forced the
+                # migrate.incomplete path to re-flush (a `final` snapshot
+                # of the same session), but it can never regress.
+                entry = status["sessionState"]["0"]
+                assert entry["phase"] == "restored", (round_i, entry)
+                assert entry["restoreGeneration"] >= snap.generation
+                assert entry["digest"] == snap.digest, (round_i, entry)
+                for pod_name, (gen, digest) in stamps.items():
+                    assert gen == str(entry["restoreGeneration"]), \
+                        (round_i, pod_name, stamps)
+                    assert digest == snap.digest, (round_i, pod_name)
+            self._delete_groups(api, "migsoak")
+            # age out the sliding window so each round has a fresh budget
+            mgr.advance(self.CFG["recovery_window_s"])
+
+        assert expect_migrated > 0 and expect_bare > 0, \
+            "soak must exercise both verbs; tune the seed"
+        # migrate vs bare-restart accounting: every fresh round migrated
+        # (possibly more than once when a fault forced a re-migration),
+        # every stale round bare-restarted under the disruption's own
+        # reason — the migrate label never bleeds into bare restarts
+        assert metrics.slice_restarts.value("user1", "migrate") >= \
+            expect_migrated
+        bare_total = sum(
+            metrics.slice_restarts.value("user1", reason)
+            for reason in ("pod-failed", "crash-loop"))
+        assert bare_total == expect_bare
+        assert metrics.migrations.value("failure", "migrated") >= \
+            expect_migrated
+        # ...but each migration chain finalizes exactly once
+        assert metrics.migrations.value("failure", "restored") == \
+            expect_migrated
+        assert metrics.migrations.value("failure", "fallback-restart") == \
+            expect_bare
+        assert_no_concurrent_per_key_reconciles(mgr)
+
+    def test_failover_mid_migration_resumes_without_double_restore(self):
+        """Kill the manager between the migrate restart and the slice
+        turning Healthy: the successor must finish the SAME migration from
+        status.sessionState — no second slice restart, no second restore,
+        the original snapshot generation stamped on every worker."""
+        api, cluster, mgr_a, clock, cfg, metrics_a, store = self._env()
+        nb = Notebook.new("failover", "user1", tpu=TPUSpec("v5e", "4x4"))
+        api.create(nb.obj)
+        mgr_a.run_until_idle()
+        cluster.set_session_payload("user1", "failover", b"mid-migration")
+        (snap,) = cluster.snapshot_sessions("user1", "failover")
+
+        # freeze the data plane mid-recreate: the migrate verb fires (pods
+        # deleted, restore stamped) but the new pods never turn Ready
+        # under manager A
+        cluster.auto_ready = False
+        cluster.fail_pod("user1", "failover-1")
+        mgr_a.run_until_idle()
+        status = api.get("Notebook", "user1", "failover").body["status"]
+        assert status["sessionState"]["0"]["phase"] == "migrating"
+        assert self._delete_groups(api, "failover") == 1
+
+        # leader failover: a brand-new manager resumes from the CR alone
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+
+        mgr_b = Manager(api, clock=clock)
+        metrics_b = NotebookMetrics(api)
+        setup_core_controllers(mgr_b, CoreConfig(**self.CFG), metrics_b,
+                               session=store)
+        with api.fault_exempt():
+            mgr_b.enqueue_all()
+        mgr_b.run_until_idle()
+        # the successor must NOT re-restart the recreating slice
+        assert self._delete_groups(api, "failover") == 1
+
+        # the data plane catches up; B observes Healthy and finalizes
+        cluster.auto_ready = True
+        for i in range(self.HOSTS):
+            cluster.mark_running("user1", f"failover-{i}")
+        mgr_b.run_until_idle()
+        status = api.get("Notebook", "user1", "failover").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        entry = status["sessionState"]["0"]
+        assert entry["phase"] == "restored"
+        assert entry["restoreGeneration"] == snap.generation
+        assert self._delete_groups(api, "failover") == 1  # exactly one
+        for pod_name, (gen, digest) in self._restored_stamps(api).items():
+            assert gen == str(snap.generation), pod_name
+            assert digest == snap.digest, pod_name
+        # finalization happened exactly once, on the successor
+        assert metrics_b.migrations.value("failure", "restored") == 1
+
+    def test_migrate_budget_shared_with_restart_exhausts_at_cap(self):
+        """Migrate attempts and bare-restart attempts draw from ONE
+        budget: a poisoned slice whose checkpoint goes stale mid-recovery
+        migrates first, bare-restarts after, and lands on
+        RecoveryExhausted at exactly the configured cap."""
+        api, cluster, mgr, clock, cfg, metrics, store = self._env()
+        nb = Notebook.new("doomed", "user1", tpu=TPUSpec("v5e", "4x4"))
+        api.create(nb.obj)
+        mgr.run_until_idle()
+        cluster.snapshot_sessions("user1", "doomed")
+        cluster.poison_statefulset("user1", "doomed")
+        with api.fault_exempt():
+            mgr.enqueue_all()
+        mgr.settle(max_seconds=float(
+            cfg.recovery_window_s + 10 * cfg.recovery_backoff_max_s))
+        assert self._delete_groups(api, "doomed") == \
+            cfg.recovery_max_attempts
+        migrated = metrics.slice_restarts.value("user1", "migrate")
+        bare = metrics.slice_restarts.value("user1", "pod-failed")
+        assert migrated >= 1, "the fresh checkpoint must migrate first"
+        assert migrated + bare == cfg.recovery_max_attempts
+        cond = next(
+            (c for c in api.get("Notebook", "user1", "doomed")
+             .body["status"]["conditions"]
+             if c.get("type") == "RecoveryExhausted"), None)
+        assert cond is not None and cond["status"] == "True"
+        mgr.advance(3600)
+        assert self._delete_groups(api, "doomed") == \
             cfg.recovery_max_attempts
         assert not mgr.dropped_errors
 

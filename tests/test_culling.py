@@ -250,6 +250,96 @@ class TestSliceAtomicCulling:
         assert not culler.stop_annotation_is_set(
             api.get("Notebook", "u1", "tnb").metadata)
 
+    def _signal_env(self, tmp_path):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                         idleness_check_period_min=1,
+                         checkpoint_before_cull=True,
+                         checkpoint_signal_root=str(tmp_path / "signals"))
+        metrics = NotebookMetrics(api)
+        jupyter = FakeJupyterState()
+        setup_core_controllers(mgr, cfg, metrics)
+        setup_culling(mgr, cfg, jupyter, metrics)
+        api.create(Notebook.new("tnb", "u1", tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        jupyter.set_kernels("u1", "tnb", [idle_kernel()])
+        return api, mgr, clock, metrics, tmp_path / "signals" / "u1" / "tnb"
+
+    def test_cull_signal_file_written_and_ack_honored(self, tmp_path):
+        """Satellite: the cull path drives the ACTUAL CullSignalWatcher
+        transport — the culler writes the request file, the in-pod
+        checkpoint_on_cull hook fires off it, and the ack file (not just
+        the annotation) releases the cull, all on the FakeClock."""
+        from kubeflow_tpu.runtime.checkpoint import (
+            CheckpointManager,
+            CullSignalWatcher,
+            checkpoint_on_cull,
+        )
+
+        api, mgr, clock, metrics, sig_dir = self._signal_env(tmp_path)
+        mgr.advance(61 * 60)  # idle verdict -> request written, cull held
+        nb = api.get("Notebook", "u1", "tnb")
+        assert C.ANNOTATION_CHECKPOINT_REQUESTED in nb.metadata.annotations
+        assert not culler.stop_annotation_is_set(nb.metadata)
+        assert (sig_dir / "checkpoint-requested").read_text() == "true"
+
+        # the runtime side: the per-step hook sees the request, saves, acks
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"), backend="local")
+        hook = checkpoint_on_cull(ckpt, CullSignalWatcher(str(sig_dir)))
+        assert hook(7, {"w": [1.0, 2.0]}) is True
+        assert ckpt.latest_step() == 7
+        assert (sig_dir / "checkpoint-complete").exists()
+
+        # next culling pass: ack honored -> stop annotation lands, slice
+        # transitions toward Stopping/Stopped, signal files retired
+        mgr.advance(61)
+        nb = api.get("Notebook", "u1", "tnb")
+        assert culler.stop_annotation_is_set(nb.metadata)
+        assert api.list("Pod", namespace="u1") == []
+        assert nb.body["status"]["sliceHealth"] in ("Stopping", "Stopped")
+        assert not (sig_dir / "checkpoint-requested").exists()
+        assert not (sig_dir / "checkpoint-complete").exists()
+        assert metrics.checkpoint_snapshots.value("u1", "cull") == 1
+
+    def test_cull_signal_timeout_without_ack(self, tmp_path):
+        """No ack ever arrives (runtime wedged): the grace window — one
+        idleness check period — expires and the cull proceeds anyway."""
+        api, mgr, clock, metrics, sig_dir = self._signal_env(tmp_path)
+        mgr.advance(61 * 60)
+        assert (sig_dir / "checkpoint-requested").exists()
+        assert not culler.stop_annotation_is_set(
+            api.get("Notebook", "u1", "tnb").metadata)
+        mgr.advance(2 * 60)  # grace expired, still no ack file
+        nb = api.get("Notebook", "u1", "tnb")
+        assert culler.stop_annotation_is_set(nb.metadata)
+        assert metrics.checkpoint_snapshots.value("u1", "cull") == 0
+
+    def test_activity_resumption_clears_signal_files(self, tmp_path):
+        api, mgr, clock, metrics, sig_dir = self._signal_env(tmp_path)
+        mgr.advance(61 * 60)
+        assert (sig_dir / "checkpoint-requested").exists()
+        # the user comes back before the grace expires: bump the
+        # last-activity annotation the culler trusts
+        from kubeflow_tpu.kube import retry_on_conflict
+
+        def touch():
+            nb = api.get("Notebook", "u1", "tnb")
+            nb.metadata.annotations[C.LAST_ACTIVITY_ANNOTATION] = \
+                clock.now_iso()
+            api.update(nb)
+
+        retry_on_conflict(touch)
+        mgr.advance(2 * 60)
+        nb = api.get("Notebook", "u1", "tnb")
+        assert not culler.stop_annotation_is_set(nb.metadata)
+        assert C.ANNOTATION_CHECKPOINT_REQUESTED not in \
+            nb.metadata.annotations
+        assert not (sig_dir / "checkpoint-requested").exists()
+
     def test_checkpoint_grace_expires(self):
         api = ApiServer()
         cluster = FakeCluster(api)

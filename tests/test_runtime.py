@@ -78,6 +78,151 @@ class TestCheckpoint:
         mgr.close()
 
 
+class TestTornCheckpoints:
+    """Satellite: the local backend's kill-mid-save safety — save is
+    temp-write -> fsync -> atomic rename, restore skips and GCs partial
+    writes, so a worker killed mid-save can never resurrect a torn step."""
+
+    def _mgr(self, tmp_path):
+        return CheckpointManager(str(tmp_path / "local"), backend="local")
+
+    def test_local_roundtrip(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        state = {"w": jnp.arange(8.0), "step": jnp.asarray(3)}
+        mgr.save(3, state, wait=True)
+        like = jax.tree.map(jnp.zeros_like, state)
+        restored = mgr.restore(like)
+        assert float(restored["w"][5]) == 5.0
+        assert mgr.latest_step() == 3
+        mgr.close()
+
+    def test_kill_mid_save_leaves_previous_step_restorable(
+            self, tmp_path, monkeypatch):
+        mgr = self._mgr(tmp_path)
+        state1 = {"w": jnp.ones(4)}
+        mgr.save(1, state1)
+
+        # the kill: the process dies after the temp write but BEFORE the
+        # atomic rename — model it by making the rename never happen
+        import os as _os
+
+        def power_cut(src, dst):
+            raise OSError("killed mid-save (before rename)")
+
+        monkeypatch.setattr(_os, "replace", power_cut)
+        with pytest.raises(OSError):
+            mgr.save(2, {"w": jnp.full(4, 2.0)})
+        monkeypatch.undo()
+        # the torn write is visible only as a temp file, never a step
+        assert list(mgr.directory.glob(".tmp-*"))
+        assert mgr.latest_step() == 1
+
+        # a fresh manager (the restarted worker) restores step 1 and GCs
+        # the partial
+        mgr2 = CheckpointManager(str(mgr.directory), backend="local")
+        restored = mgr2.restore({"w": jnp.zeros(4)})
+        assert float(restored["w"][0]) == 1.0
+        assert not list(mgr2.directory.glob(".tmp-*"))
+
+    def test_corrupt_step_skipped_and_gced_on_restore(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.save(1, {"w": jnp.ones(2)})
+        mgr.save(2, {"w": jnp.full(2, 2.0)})
+        # a torn final file (disk-level corruption) must not poison boot:
+        # restore falls back to the next-older step and GCs the husk
+        (mgr.directory / "step_3.ckpt").write_bytes(b"\x00garbage")
+        assert mgr.latest_step() == 3
+        restored = mgr.restore({"w": jnp.zeros(2)})
+        assert float(restored["w"][0]) == 2.0
+        assert not (mgr.directory / "step_3.ckpt").exists()
+        assert mgr.latest_step() == 2
+
+    def test_max_to_keep_prunes_oldest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "p"), max_to_keep=2,
+                                backend="local")
+        for step in (1, 2, 3):
+            mgr.save(step, {"w": jnp.full(2, float(step))})
+        assert mgr._local_steps() == [2, 3]
+
+
+class TestCheckpointSidecar:
+    """The pod side of the session-state contract (core/sessionstate.py):
+    periodic snapshots by interval, forced snapshot + ack on the cull
+    signal, restore from the stamped CHECKPOINT_RESTORE_* env."""
+
+    def _store(self, clock):
+        from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+
+        return InMemorySessionStore(clock=clock)
+
+    def test_periodic_interval(self):
+        from kubeflow_tpu.runtime.checkpoint import CheckpointSidecar
+        from kubeflow_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=0.0)
+        store = self._store(clock)
+        sidecar = CheckpointSidecar(store, "u1", "nb", 0, interval_s=60.0,
+                                    time_fn=clock.now)
+        assert sidecar.maybe_snapshot(lambda: b"s0") is not None  # first
+        assert sidecar.maybe_snapshot(lambda: b"s1") is None      # too soon
+        clock.advance(61)
+        info = sidecar.maybe_snapshot(lambda: b"s1")
+        assert info.generation == 2 and info.trigger == "periodic"
+
+    def test_cull_signal_forces_snapshot_and_acks(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import CheckpointSidecar
+        from kubeflow_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(start=0.0)
+        store = self._store(clock)
+        signal_dir = tmp_path / "podinfo"
+        signal_dir.mkdir()
+        watcher = CullSignalWatcher(str(signal_dir))
+        sidecar = CheckpointSidecar(store, "u1", "nb", 0, interval_s=1e9,
+                                    watcher=watcher, time_fn=clock.now)
+        sidecar.maybe_snapshot(lambda: b"base")
+        (signal_dir / REQUEST_FILE).write_text("true")
+        info = sidecar.maybe_snapshot(lambda: b"final-state")
+        assert info is not None and info.trigger == "cull"
+        assert (signal_dir / ACK_FILE).exists()
+        # fires once per cull cycle
+        assert sidecar.maybe_snapshot(lambda: b"again") is None
+
+    def test_restore_instructions_and_payload(self):
+        from kubeflow_tpu.runtime.checkpoint import (
+            CheckpointSidecar,
+            restore_instructions,
+        )
+        from kubeflow_tpu.utils.clock import FakeClock
+
+        assert restore_instructions({}) is None
+        assert restore_instructions(
+            {"CHECKPOINT_RESTORE_URI": "mem://x",
+             "CHECKPOINT_RESTORE_GENERATION": "nope"}) is None
+        clock = FakeClock()
+        store = self._store(clock)
+        info = store.put("u1", "nb", 0, b"the-session")
+        sidecar = CheckpointSidecar(store, "u1", "nb", 0,
+                                    time_fn=clock.now)
+        env = {"CHECKPOINT_RESTORE_URI": store.uri,
+               "CHECKPOINT_RESTORE_GENERATION": str(info.generation)}
+        assert sidecar.restore_payload(env) == b"the-session"
+        assert sidecar.restore_payload({}) is None  # cold start
+
+    def test_from_env_honors_contract(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import CheckpointSidecar
+
+        assert CheckpointSidecar.from_env("u1", "nb", 0, env={}) is None
+        sidecar = CheckpointSidecar.from_env(
+            "u1", "nb", 1,
+            env={"CHECKPOINT_STORE_URI": f"file://{tmp_path}/s",
+                 "CHECKPOINT_INTERVAL_S": "45"})
+        assert sidecar is not None and sidecar.interval_s == 45.0
+        info = sidecar.snapshot_now(b"pre-stop-state")
+        assert info.trigger == "pre-stop"
+        assert sidecar.store.payload("u1", "nb", 1) == b"pre-stop-state"
+
+
 class TestStepMetrics:
     def test_mfu_math(self):
         timer = StepTimer(TINY, batch=4, seq_len=128, num_chips=1)

@@ -18,13 +18,22 @@ from kubeflow_tpu.api.types import (
 from kubeflow_tpu.core.metrics import NotebookMetrics
 from kubeflow_tpu.core.notebook_controller import setup_core_controllers
 from kubeflow_tpu.core.selfheal import (
+    MIGRATE_RESULT_FALLBACK,
+    MIGRATE_RESULT_MIGRATED,
+    MIGRATE_RESULT_RESTORED,
+    MIGRATE_RESULT_SKIPPED,
+    MIGRATE_TRIGGER_DRAIN,
+    MIGRATE_TRIGGER_FAILURE,
+    MIGRATE_TRIGGER_NODE_DRAIN,
     PENDING,
     REASON_CRASH_LOOP,
+    REASON_MIGRATE,
     REASON_NODE_GONE,
     REASON_PENDING_TIMEOUT,
     REASON_POD_FAILED,
     classify_worker,
 )
+from kubeflow_tpu.core.sessionstate import InMemorySessionStore
 from kubeflow_tpu.kube import (
     ApiServer,
     FakeCluster,
@@ -393,3 +402,232 @@ class TestRestartAggregation:
         fams = dict(metrics.families())
         assert fams["notebook_slice_restarts_total"] == "counter"
         assert fams["notebook_disruption_recovery_seconds"] == "histogram"
+        assert fams["notebook_checkpoint_snapshots_total"] == "counter"
+        assert fams["notebook_checkpoint_age_seconds"] == "histogram"
+        assert fams["notebook_migrations_total"] == "counter"
+
+
+# -- the migrate verb ----------------------------------------------------------
+def make_migrate_env(cfg=None, tpu_nodes=HOSTS):
+    """make_env plus a wired session-state store: the cluster answers
+    final-snapshot requests and stamps restores, the engine prefers the
+    migrate verb."""
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node", allocatable={"cpu": "64", "memory": "256Gi"})
+    if tpu_nodes:
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    tpu_nodes, 4)
+    clock = FakeClock()
+    mgr = Manager(api, clock=clock)
+    store = InMemorySessionStore(clock=clock)
+    cluster.attach_session_store(store)
+    metrics = NotebookMetrics(api)
+    cfg = cfg or CoreConfig(checkpoint_store_uri="mem://session-state")
+    setup_core_controllers(mgr, cfg, metrics, session=store)
+    return api, cluster, mgr, clock, metrics, store
+
+
+def restored_stamps(api, ns="u1"):
+    """(generation, digest) restore stamps per pod name — the fake
+    kubelet's record of what the runtime restored at boot."""
+    from kubeflow_tpu.core import constants as C
+
+    return {
+        p.name: (p.metadata.annotations.get(
+            C.ANNOTATION_RESTORED_GENERATION),
+            p.metadata.annotations.get(C.ANNOTATION_RESTORED_DIGEST))
+        for p in api.list("Pod", namespace=ns)
+    }
+
+
+def session_entry(api, ns="u1", name="heal", slice_id="0"):
+    status = api.get("Notebook", ns, name).body.get("status", {})
+    return (status.get("sessionState") or {}).get(slice_id)
+
+
+class TestMigrateVerb:
+    def test_fresh_checkpoint_prefers_migrate_over_restart(self):
+        api, cluster, mgr, clock, metrics, store = make_migrate_env()
+        create_tpu_nb(api, mgr)
+        cluster.set_session_payload("u1", "heal", b"kernel-state-A")
+        (snap,) = cluster.snapshot_sessions("u1", "heal")
+        cluster.fail_pod("u1", "heal-1")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert pod_delete_groups(api, "heal") == 1  # still slice-atomic
+        # restarts counted under the migrate reason, not the disruption
+        assert metrics.slice_restarts.value("u1", REASON_MIGRATE) == 1
+        assert metrics.slice_restarts.value("u1", REASON_POD_FAILED) == 0
+        assert metrics.migrations.value(
+            MIGRATE_TRIGGER_FAILURE, MIGRATE_RESULT_MIGRATED) == 1
+        assert metrics.migrations.value(
+            MIGRATE_TRIGGER_FAILURE, MIGRATE_RESULT_RESTORED) == 1
+        # write-ahead record reached its terminal phase
+        entry = session_entry(api)
+        assert entry["phase"] == "restored"
+        assert entry["restoreGeneration"] == snap.generation
+        # restored-state equivalence: every recreated worker restored the
+        # pre-disruption snapshot, byte-for-byte (digest)
+        for name, (gen, digest) in restored_stamps(api).items():
+            assert gen == str(snap.generation), name
+            assert digest == snap.digest, name
+        assert "SliceMigration" in event_reasons(api)
+        assert "MigrationComplete" in event_reasons(api)
+
+    def test_stale_checkpoint_falls_back_to_bare_restart(self):
+        cfg = CoreConfig(checkpoint_store_uri="mem://session-state",
+                         checkpoint_max_age_s=300.0)
+        api, cluster, mgr, clock, metrics, store = make_migrate_env(cfg)
+        create_tpu_nb(api, mgr)
+        cluster.snapshot_sessions("u1", "heal")
+        clock.advance(3600)  # checkpoint is now ancient
+        mgr.run_until_idle()
+        cluster.fail_pod("u1", "heal-2")
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert metrics.slice_restarts.value("u1", REASON_POD_FAILED) == 1
+        assert metrics.slice_restarts.value("u1", REASON_MIGRATE) == 0
+        assert metrics.migrations.value(
+            MIGRATE_TRIGGER_FAILURE, MIGRATE_RESULT_FALLBACK) == 1
+        # no restore instructions were stamped: the session started cold
+        assert all(gen is None for gen, _ in restored_stamps(api).values())
+        assert session_entry(api) is None
+
+    def test_voluntary_drain_annotation_migrates_and_clears(self):
+        api, cluster, mgr, clock, metrics, store = make_migrate_env()
+        create_tpu_nb(api, mgr)
+        cluster.set_session_payload("u1", "heal", b"drained-state")
+        live = api.get("Notebook", "u1", "heal")
+        live.metadata.annotations[
+            "notebooks.kubeflow.org/migrate"] = "drain"
+        api.update(live)
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        # a healthy slice CAN flush: the store got a final snapshot and
+        # the restored state is exactly that flush
+        assert metrics.checkpoint_snapshots.value("u1", "final") == 1
+        snap = store.latest("u1", "heal", 0)
+        assert snap.trigger == "final"
+        for gen, digest in restored_stamps(api).values():
+            assert gen == str(snap.generation) and digest == snap.digest
+        assert metrics.migrations.value(
+            MIGRATE_TRIGGER_DRAIN, MIGRATE_RESULT_MIGRATED) == 1
+        # request consumed; budget charged (shared with recovery)
+        live = api.get("Notebook", "u1", "heal")
+        assert "notebooks.kubeflow.org/migrate" not in \
+            live.metadata.annotations
+        assert len(recovery_state(api)["attempts"]) == 1
+
+    def test_cordoned_node_triggers_node_drain_migration(self):
+        api, cluster, mgr, clock, metrics, store = make_migrate_env(
+            tpu_nodes=HOSTS + 4)
+        create_tpu_nb(api, mgr)
+        cluster.set_session_payload("u1", "heal", b"on-cordoned-node")
+        victim = api.get("Pod", "u1", "heal-2").spec["nodeName"]
+        cluster.cordon_node(victim)
+        mgr.run_until_idle()
+        status = api.get("Notebook", "u1", "heal").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        assert metrics.migrations.value(
+            MIGRATE_TRIGGER_NODE_DRAIN, MIGRATE_RESULT_MIGRATED) == 1
+        # the migrated slice left the cordoned node entirely
+        for pod in api.list("Pod", namespace="u1"):
+            assert pod.spec["nodeName"] != victim
+        assert session_entry(api)["phase"] == "restored"
+
+    def test_voluntary_without_checkpoint_is_skipped(self):
+        """A healthy session is never torn down without its state in hand:
+        no store wired to the cluster -> final snapshot unanswered, no
+        stored checkpoint -> the voluntary request is consumed without a
+        restart."""
+        api, cluster, mgr, clock, metrics, store = make_migrate_env()
+        cluster._session_store.set_final_snapshot_handler(None)  # unreachable
+        create_tpu_nb(api, mgr)
+        api.clear_audit_log()
+        live = api.get("Notebook", "u1", "heal")
+        live.metadata.annotations[
+            "notebooks.kubeflow.org/migrate"] = "defrag"
+        api.update(live)
+        mgr.run_until_idle()
+        assert api.audit_log(verb="delete", kind="Pod") == []
+        assert metrics.migrations.value(
+            "defrag", MIGRATE_RESULT_SKIPPED) == 1
+        assert "MigrationSkipped" in event_reasons(api)
+        live = api.get("Notebook", "u1", "heal")
+        assert "notebooks.kubeflow.org/migrate" not in \
+            live.metadata.annotations
+        # nothing charged against the shared budget
+        assert recovery_state(api) is None
+
+    def test_migrate_and_restart_share_one_budget(self):
+        """The satellite acceptance: attempts spent by the migrate verb and
+        by bare restarts draw from ONE budget, and exhaustion still yields
+        RecoveryExhausted.  A poisoned slice (pods always come back
+        Failed) with a checkpoint that goes stale mid-recovery migrates
+        first, bare-restarts after, and exhausts at exactly the cap."""
+        cfg = CoreConfig(checkpoint_store_uri="mem://session-state",
+                         checkpoint_max_age_s=25.0,
+                         recovery_backoff_base_s=10.0,
+                         recovery_backoff_max_s=40.0,
+                         recovery_max_attempts=3,
+                         recovery_window_s=100000.0)
+        api, cluster, mgr, clock, metrics, store = make_migrate_env(cfg)
+        create_tpu_nb(api, mgr)
+        cluster.snapshot_sessions("u1", "heal")  # fresh at t0
+        cluster.poison_statefulset("u1", "heal")
+        mgr.enqueue_all()
+        mgr.run_until_idle()      # attempt 1: ckpt fresh -> migrate
+        assert metrics.slice_restarts.value("u1", REASON_MIGRATE) == 1
+        for _ in range(8):
+            mgr.advance(50)       # ckpt now stale -> bare restarts
+        assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+        assert metrics.slice_restarts.value("u1", REASON_MIGRATE) == 1
+        assert metrics.slice_restarts.value(
+            "u1", REASON_POD_FAILED) == cfg.recovery_max_attempts - 1
+        cond = exhausted_condition(api)
+        assert cond is not None and cond["status"] == "True"
+        assert recovery_state(api)["exhausted"] is True
+        # terminal: no further churn of either verb
+        mgr.advance(10000)
+        assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+
+
+class TestConfigParsing:
+    def test_recovery_knobs_parse_sub_second_floats(self):
+        """Satellite regression: RECOVERY_* duration knobs went through
+        _int, so RECOVERY_BACKOFF_BASE_S=0.5 (fast soak configs) silently
+        truncated to the default."""
+        cfg = CoreConfig.from_env({
+            "RECOVERY_BACKOFF_BASE_S": "0.5",
+            "RECOVERY_BACKOFF_MAX_S": "2.5",
+            "RECOVERY_WINDOW_S": "90.5",
+            "RECOVERY_PENDING_DEADLINE_S": "1.25",
+            "CHECKPOINT_INTERVAL_S": "0.75",
+            "CHECKPOINT_MAX_AGE_S": "1.5",
+        })
+        assert cfg.recovery_backoff_base_s == 0.5
+        assert cfg.recovery_backoff_max_s == 2.5
+        assert cfg.recovery_window_s == 90.5
+        assert cfg.recovery_pending_deadline_s == 1.25
+        assert cfg.checkpoint_interval_s == 0.75
+        assert cfg.checkpoint_max_age_s == 1.5
+
+    def test_checkpoint_knob_defaults_and_uri(self):
+        cfg = CoreConfig.from_env({})
+        assert cfg.checkpoint_store_uri == ""
+        assert cfg.checkpoint_interval_s == 300.0
+        assert cfg.checkpoint_max_age_s == 600.0
+        cfg = CoreConfig.from_env({
+            "CHECKPOINT_STORE_URI": "file:///var/ckpt",
+            "CHECKPOINT_SIGNAL_ROOT": "/var/signals",
+        })
+        assert cfg.checkpoint_store_uri == "file:///var/ckpt"
+        assert cfg.checkpoint_signal_root == "/var/signals"
+
+    def test_garbage_floats_keep_defaults(self):
+        cfg = CoreConfig.from_env({"RECOVERY_BACKOFF_BASE_S": "soon"})
+        assert cfg.recovery_backoff_base_s == 10.0

@@ -1,0 +1,178 @@
+"""Session-state store tests (core/sessionstate.py): generation
+monotonicity, freshness metadata, the final-snapshot handler hook, the
+dir-backed backend's torn-write safety, and the rendered checkpoint-sidecar
+contract (core/workload.py)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.sessionstate import (
+    DirSessionStore,
+    InMemorySessionStore,
+    open_store,
+    payload_digest,
+)
+from kubeflow_tpu.core.workload import generate_statefulsets
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+
+@pytest.fixture(params=["mem", "dir"])
+def store(request, tmp_path):
+    clock = FakeClock()
+    if request.param == "mem":
+        return InMemorySessionStore(clock=clock)
+    return DirSessionStore(str(tmp_path / "sessions"), clock=clock)
+
+
+class TestStoreSemantics:
+    def test_generations_monotonic_and_latest(self, store):
+        a = store.put("u1", "nb", 0, b"state-1")
+        b = store.put("u1", "nb", 0, b"state-2")
+        other = store.put("u1", "nb", 1, b"slice-1-state")
+        assert (a.generation, b.generation) == (1, 2)
+        assert other.generation == 1  # per-slice counters
+        latest = store.latest("u1", "nb", 0)
+        assert latest.generation == 2
+        assert latest.digest == payload_digest(b"state-2")
+        assert store.payload("u1", "nb", 0) == b"state-2"
+        assert store.payload("u1", "nb", 0, generation=1) == b"state-1"
+        assert store.info("u1", "nb", 0, 1).trigger == "periodic"
+        assert store.latest("u1", "missing", 0) is None
+
+    def test_freshness_metadata_uses_store_clock(self, store):
+        first = store.put("u1", "nb", 0, b"x")
+        store.clock.advance(120)
+        second = store.put("u1", "nb", 0, b"y")
+        assert second.saved_at - first.saved_at == pytest.approx(120)
+
+    def test_pruned_to_max_to_keep(self, store):
+        store.max_to_keep = 3
+        for i in range(6):
+            store.put("u1", "nb", 0, b"v%d" % i)
+        gens = [s.generation for s in store.snapshots("u1", "nb", 0)]
+        assert gens == [4, 5, 6]
+        # pruning keeps generations monotonic (no reuse of dropped ids)
+        assert store.put("u1", "nb", 0, b"v6").generation == 7
+
+    def test_final_snapshot_handler_dispatch(self, store):
+        calls = []
+
+        def handler(ns, nb, slice_id):
+            calls.append((ns, nb, slice_id))
+            return store.put(ns, nb, slice_id, b"flushed", trigger="final")
+
+        assert store.request_final_snapshot("u1", "nb", 0) is None  # unwired
+        store.set_final_snapshot_handler(handler)
+        info = store.request_final_snapshot("u1", "nb", 0)
+        assert calls == [("u1", "nb", 0)]
+        assert info.trigger == "final" and info.generation == 1
+
+        # a handler that raises reads as "unreachable", never an error
+        store.set_final_snapshot_handler(
+            lambda *a: (_ for _ in ()).throw(RuntimeError("pod gone")))
+        assert store.request_final_snapshot("u1", "nb", 0) is None
+
+
+class TestDirStoreTornWrites:
+    def test_payload_without_commit_marker_is_invisible_and_gced(
+            self, tmp_path):
+        store = DirSessionStore(str(tmp_path), clock=FakeClock())
+        store.put("u1", "nb", 0, b"good")
+        d = store._slice_dir("u1", "nb", 0)
+        # simulate a sidecar killed after the payload write but before the
+        # metadata commit marker landed
+        (d / "gen-2.bin").write_bytes(b"torn")
+        (d / ".tmp-gen-3.json-999").write_bytes(b"partial meta")
+        snaps = store.snapshots("u1", "nb", 0)
+        assert [s.generation for s in snaps] == [1]
+        assert not (d / "gen-2.bin").exists()      # orphan GC'd
+        assert not list(d.glob(".tmp-*"))          # stray tmp GC'd
+        # the next save reuses the generation slot cleanly
+        assert store.put("u1", "nb", 0, b"again").generation == 2
+
+    def test_corrupt_commit_marker_drops_both_halves(self, tmp_path):
+        store = DirSessionStore(str(tmp_path), clock=FakeClock())
+        store.put("u1", "nb", 0, b"good")
+        d = store._slice_dir("u1", "nb", 0)
+        (d / "gen-5.json").write_text("{not json")
+        (d / "gen-5.bin").write_bytes(b"whatever")
+        assert [s.generation for s in store.snapshots("u1", "nb", 0)] == [1]
+        assert not (d / "gen-5.json").exists()
+        assert not (d / "gen-5.bin").exists()
+
+    def test_survives_reopen(self, tmp_path):
+        a = DirSessionStore(str(tmp_path), clock=FakeClock())
+        info = a.put("u1", "nb", 2, b"persisted", trigger="pre-stop")
+        b = DirSessionStore(str(tmp_path), clock=FakeClock())
+        got = b.latest("u1", "nb", 2)
+        assert got == info
+        assert b.payload("u1", "nb", 2) == b"persisted"
+        meta = json.loads(
+            (b._slice_dir("u1", "nb", 2) / "gen-1.json").read_text())
+        assert meta["trigger"] == "pre-stop"
+
+
+class TestOpenStore:
+    def test_uri_dispatch(self, tmp_path):
+        assert isinstance(open_store("mem://x"), InMemorySessionStore)
+        d = open_store(f"file://{tmp_path}/s")
+        assert isinstance(d, DirSessionStore)
+        bare = open_store(str(tmp_path / "bare"))
+        assert isinstance(bare, DirSessionStore)
+        assert bare.uri.startswith("file://")
+
+
+class TestSidecarContractRender:
+    """core/workload.py renders the checkpoint-sidecar contract into every
+    TPU worker template when CHECKPOINT_STORE_URI is configured."""
+
+    CFG = CoreConfig(checkpoint_store_uri="file:///ckpt/store",
+                     checkpoint_interval_s=120.0)
+
+    def _main(self, sts):
+        return sts.spec["template"]["spec"]["containers"][0]
+
+    def test_env_prestop_and_podinfo_rendered(self):
+        nb = Notebook.new("nb", "u1", tpu=TPUSpec("v5e", "4x4"))
+        (sts,) = generate_statefulsets(nb, self.CFG)
+        main = self._main(sts)
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env[C.ENV_CHECKPOINT_STORE_URI] == "file:///ckpt/store"
+        assert env[C.ENV_CHECKPOINT_INTERVAL_S] == "120"
+        # no restore intent in status -> no restore stamping
+        assert C.ENV_CHECKPOINT_RESTORE_GENERATION not in env
+        assert main["lifecycle"]["preStop"]["exec"]["command"][-1] \
+            == "--pre-stop"
+        vols = {v["name"]: v for v in sts.spec["template"]["spec"]["volumes"]}
+        items = vols["podinfo"]["downwardAPI"]["items"]
+        assert items[0]["path"] == "checkpoint-requested"
+        assert C.ANNOTATION_CHECKPOINT_REQUESTED in \
+            items[0]["fieldRef"]["fieldPath"]
+        mounts = {m["name"]: m for m in main["volumeMounts"]}
+        assert mounts["podinfo"]["mountPath"] == "/etc/podinfo"
+
+    def test_restore_intent_stamped_from_session_state(self):
+        nb = Notebook.new("nb", "u1", tpu=TPUSpec("v5e", "4x4", slices=2))
+        nb.obj.body["status"] = {"sessionState": {
+            "1": {"restoreGeneration": 7, "phase": "migrating",
+                  "restoreUri": "file:///ckpt/store/u1/nb/slice-1/gen-7"},
+        }}
+        slice0, slice1 = generate_statefulsets(nb, self.CFG)
+        env0 = {e["name"]: e.get("value") for e in self._main(slice0)["env"]}
+        env1 = {e["name"]: e.get("value") for e in self._main(slice1)["env"]}
+        assert C.ENV_CHECKPOINT_RESTORE_GENERATION not in env0
+        assert env1[C.ENV_CHECKPOINT_RESTORE_GENERATION] == "7"
+        assert env1[C.ENV_CHECKPOINT_RESTORE_URI].endswith("slice-1/gen-7")
+
+    def test_contract_absent_without_store_uri(self):
+        nb = Notebook.new("nb", "u1", tpu=TPUSpec("v5e", "4x4"))
+        (sts,) = generate_statefulsets(nb, CoreConfig())
+        main = self._main(sts)
+        env = {e["name"] for e in main["env"]}
+        assert C.ENV_CHECKPOINT_STORE_URI not in env
+        assert "lifecycle" not in main
+        assert "volumes" not in sts.spec["template"]["spec"]
