@@ -83,4 +83,12 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   echo "== loadtest convergence smoke =="
   python loadtest/convergence.py --count 200 --compare-workers 8 \
     --check-budget ci/apiserver_call_budget.json
+  # scheduler smoke: bursty arrival trace through the slice scheduler +
+  # warm pool, warm-on vs warm-off — warm p50 notebook-ready time must
+  # stay strictly (and by margin, see the budget) below the cold path,
+  # with gang atomicity and pool bookkeeping audited at every wave and a
+  # manager failover injected mid-run
+  echo "== loadtest bursty warm-pool smoke =="
+  python loadtest/convergence.py --bursty 24 --bursts 3 --warm-size 8 \
+    --tpu v5e:4x4 --check-warm-budget ci/warmpool_budget.json
 fi

@@ -34,6 +34,12 @@ ANNOTATION_MIGRATE = "notebooks.kubeflow.org/migrate"
 # the audit trail restored-state-equivalence drills assert against
 ANNOTATION_RESTORED_GENERATION = "notebooks.kubeflow.org/restored-generation"
 ANNOTATION_RESTORED_DIGEST = "notebooks.kubeflow.org/restored-digest"
+# the SliceScheduler's all-or-nothing placement intent (core/scheduler.py):
+# JSON {"v": 1, "slices": {"<id>": {"pool": ..., "nodes": [...]}}} written
+# BEFORE any slice StatefulSet exists; the workload renderer turns each
+# slice's pool assignment into a nodeSelector.  Contains "notebook" so
+# _propagated_annotations never copies it onto pods.
+ANNOTATION_PLACEMENT = "notebooks.kubeflow.org/placement"
 
 # checkpoint-sidecar contract: env rendered into every TPU worker when
 # CHECKPOINT_STORE_URI is configured (consumed by runtime/checkpoint.py)
@@ -55,4 +61,15 @@ PREFIX_ENV_VAR = "NB_PREFIX"
 # GKE TPU node labels
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 TPU_RESOURCE = "google.com/tpu"
+
+# warm-pool bookkeeping object (core/scheduler.py): one cluster-scoped
+# TPUWarmPool per accelerator/topology shape; claim/release state lives in
+# its status so it survives manager crash and leader failover
+WARMPOOL_KIND = "TPUWarmPool"
+WARMSLICE_PROVISIONING = "Provisioning"
+WARMSLICE_READY = "Ready"
+WARMSLICE_CLAIMED = "Claimed"
+WARMSLICE_STATES = (WARMSLICE_PROVISIONING, WARMSLICE_READY,
+                    WARMSLICE_CLAIMED)

@@ -100,6 +100,27 @@ class NotebookMetrics:
             "Checkpoint/migrate recoveries by trigger and outcome",
             labels=("trigger", "result"),
         )
+        # slice scheduler + warm pool (core/scheduler.py): per-reconcile
+        # scheduling outcomes (result is the bounded scheduler.SCHEDULE_*
+        # set), per-claim warm-pool outcomes (hit | miss | bypass), and the
+        # per-shape pool census recomputed at scrape time from the
+        # TPUWarmPool objects (state: Provisioning | Ready | Claimed)
+        self.schedule_attempts = self.registry.counter(
+            "notebook_schedule_attempts_total",
+            "Slice-scheduler placement attempts by outcome",
+            labels=("result",),
+        )
+        self.warmpool_hits = self.registry.counter(
+            "notebook_warmpool_hits_total",
+            "Warm-pool claim outcomes (hit=pre-provisioned slice claimed, "
+            "miss=cold provision, bypass=pre-existing capacity)",
+            labels=("result",),
+        )
+        self.warmpool_size = self.registry.gauge(
+            "notebook_warmpool_size",
+            "Warm-pool slices per accelerator-topology shape and state",
+            labels=("shape", "state"),
+        )
         # workqueue / retry observability (controller-runtime exports the
         # same family: workqueue_depth, workqueue_retries_total) — scraped
         # from Manager.queue_stats() when a manager is attached.  The
@@ -190,6 +211,25 @@ class NotebookMetrics:
             self.running.labels(ns).set(len(names))
         for ns, n in per_ns_chips.items():
             self.tpu_chips_requested.labels(ns).set(n)
+        # warm-pool census: every shape x state combination is set each
+        # scrape (zeros included) so a drained state reads 0, not stale
+        try:
+            pools = self.api.list(C.WARMPOOL_KIND)
+        except Exception:  # noqa: BLE001 — a real-cluster backend without
+            pools = []     # the CRD must not break the scrape
+        for pool in pools:
+            shape = "%s-%s" % (pool.spec.get("accelerator", ""),
+                               pool.spec.get("topology", ""))
+            counts = {state: 0 for state in C.WARMSLICE_STATES}
+            for e in (pool.body.get("status", {}).get("slices")
+                      or {}).values():
+                if e.get("external"):
+                    continue  # bypass claims are not pool capacity
+                state = e.get("state", "")
+                if state in counts:
+                    counts[state] += 1
+            for state, n in counts.items():
+                self.warmpool_size.labels(shape, state).set(n)
         if self.manager is not None:
             stats = self.manager.queue_stats()
             for name in stats["controllers"]:

@@ -97,6 +97,19 @@ class NotebookReconciler:
         if obj.metadata.deletion_timestamp is not None:
             return Result()
 
+        # gang gate (core/scheduler.py): with the slice scheduler on, a TPU
+        # notebook renders NO workload until the all-or-nothing placement
+        # intent covers every slice — so a half-placed slice can never
+        # exist, let alone wedge.  The scheduler's annotation write is a
+        # non-status update and re-triggers this reconciler.
+        if self.cfg.enable_slice_scheduler and nb.tpu is not None \
+                and C.STOP_ANNOTATION not in nb.metadata.annotations:
+            from .scheduler import placement_covers
+
+            if not placement_covers(nb, nb.tpu.slices):
+                self._update_status(nb, [], scheduling=True)
+                return Result()
+
         from .workload import (
             generate_headless_service,
             generate_service,
@@ -335,12 +348,14 @@ class NotebookReconciler:
         if rh.copy_statefulset_fields(desired, live):
             self.api.update(live)
 
-    def _update_status(self, nb: Notebook, live_names: list[str]) -> None:
+    def _update_status(self, nb: Notebook, live_names: list[str],
+                       scheduling: bool = False) -> None:
         with _TRACER.start_span("status", {"phase": "status"}) as span:
-            self._compute_and_write_status(nb, live_names, span)
+            self._compute_and_write_status(nb, live_names, span,
+                                           scheduling=scheduling)
 
     def _compute_and_write_status(self, nb: Notebook, live_names: list[str],
-                                  span) -> None:
+                                  span, scheduling: bool = False) -> None:
         """Mirror pod conditions + container state into the CR
         (createNotebookStatus, notebook_controller.go:299-374); TPU
         notebooks additionally get per-worker states and slice health.
@@ -441,6 +456,10 @@ class NotebookReconciler:
                 # reads "Stopping", so nothing downstream treats a
                 # half-culled slice as safely parked
                 slice_health = "Stopped" if ready == 0 else "Stopping"
+            elif scheduling and ready == 0:
+                # gang-gated: waiting on the slice scheduler's placement
+                # intent — distinct from Unhealthy (nothing failed yet)
+                slice_health = "Scheduling"
             elif ready == expected_hosts:
                 slice_health = "Healthy"
             elif ready == 0:
@@ -593,6 +612,7 @@ def setup_core_controllers(
     cfg: Optional[CoreConfig] = None,
     metrics: Optional[NotebookMetrics] = None,
     session=None,
+    provisioner=None,
 ) -> NotebookReconciler:
     """Wire the core controllers into a manager (main.go:58-148 analog;
     culling registration is separate, gated on ENABLE_CULLING —
@@ -668,4 +688,12 @@ def setup_core_controllers(
         for_kind="Event",
         watches=[],
     )
+    # topology-aware slice scheduler + warm-pool autoscaler (ENABLE_SLICE_
+    # SCHEDULER): owns placement intent and warm-slice claims; the
+    # `provisioner` hook (FakeCluster in standalone mode) turns capacity
+    # up/down for it
+    if cfg.enable_slice_scheduler:
+        from .scheduler import setup_scheduler
+
+        setup_scheduler(mgr, cfg, metrics, provisioner=provisioner)
     return rec

@@ -1,0 +1,874 @@
+"""Topology-aware TPU-slice scheduler + warm-pool autoscaler.
+
+Placement used to be whatever the fake kubelet's first-fit loop did: no
+notion of slices, so a multi-host slice's workers could land wherever
+capacity happened to be free, and every notebook start paid the cold
+slice-provision path.  This module owns placement and capacity instead
+(ROADMAP item 4; NotebookOS arXiv:2503.20591 shows interactive platforms
+live or die on notebook-ready latency, and the RL-scheduler line of work
+arXiv:2601.13579 motivates keeping the policy pluggable behind a
+deterministic cost function):
+
+- **Gang placement intent.**  `SliceScheduler` reconciles Notebooks and
+  writes an all-or-nothing placement intent
+  (`notebooks.kubeflow.org/placement`, JSON: slice id -> node-pool
+  assignment) BEFORE any workload StatefulSet exists — the notebook
+  controller gang-gates rendering on it, so a half-placed slice can never
+  wedge: either every slice of the notebook has a pool or no pod binds.
+  The rendered StatefulSet turns each assignment into a
+  `cloud.google.com/gke-nodepool` nodeSelector, which co-locates the
+  whole gang on one pool.
+
+- **PlacementPolicy.**  The placement decision itself sits behind a small
+  interface; `CostFunctionPolicy` is the deterministic default — pack
+  multi-host gangs onto the feasible pool with the least leftover
+  capacity (best-fit, fights fragmentation), spread single-host notebooks
+  onto the node with the most free chips — so a learned policy can drop
+  in without touching claim bookkeeping.
+
+- **Warm pool.**  One cluster-scoped `TPUWarmPool` object per
+  accelerator/topology shape tracks pre-provisioned slices through
+  Provisioning -> Ready -> Claimed.  A new Notebook *claims* a Ready
+  slice (O(reconcile) to first pod instead of a cold provision of
+  WARMPOOL_PROVISION_S); a miss provisions a dedicated slice on demand
+  (reservation written ahead, so a crash mid-flight resumes instead of
+  double-provisioning).  All claim/release state lives in the pool
+  object's status — manager crash or leader failover changes nothing.
+
+- **Culling -> reclamation.**  A culled/Stopped notebook's claimed slices
+  drain back into the pool as Ready (nodes stay provisioned — the
+  capacity is resold to the next claim) rather than being destroyed.
+  Release waits for `sliceHealth == "Stopped"`, which by construction
+  postdates the checkpoint-on-cull handshake: a slice is never reclaimed
+  while a final snapshot may still be flushing.
+
+- **Autoscaler.**  `WarmPoolController` drives each pool toward a target
+  hit-rate: the target grows by the misses observed since the last pass
+  (bounded by WARMPOOL_MAX_SIZE) and decays back toward WARMPOOL_SIZE
+  one step at a time while the cumulative hit rate holds above
+  WARMPOOL_TARGET_HIT_RATE and idle Ready slices exceed the target.
+  Excess idle slices are retired (deprovisioned) deterministically.
+
+Everything is timed off the injected Clock, so the whole subsystem is
+FakeClock-exact: provisioning latency is a `readyAt` deadline plus a
+requeue_after, never a sleep.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from ..api.types import Notebook
+from ..kube import (
+    AlreadyExistsError,
+    ApiServer,
+    EventRecorder,
+    EventType,
+    InvalidError,
+    KubeObject,
+    Manager,
+    ObjectMeta,
+    Request,
+    Result,
+    WatchSpec,
+    parse_quantity,
+    retry_on_conflict,
+)
+from ..tpu.topology import SliceShape, TopologyError, resolve
+from ..utils import tracing
+from ..utils.clock import Clock
+from ..utils.config import CoreConfig
+from . import constants as C
+from .metrics import NotebookMetrics
+
+logger = logging.getLogger("kubeflow_tpu.scheduler")
+
+# the `schedule` phase span parents onto the manager's per-attempt
+# reconcile root via the shared context stack (flight-recorder visible)
+_TRACER = tracing.get_tracer("kubeflow_tpu.core.scheduler")
+
+# schedule-attempt outcomes — bounded set, they label
+# notebook_schedule_attempts_total{result}
+SCHEDULE_PLACED = "placed"
+SCHEDULE_NOOP = "noop"
+SCHEDULE_WAIT = "wait-provisioning"
+SCHEDULE_RELEASED = "released"
+
+# warm-pool claim outcomes — bounded set, they label
+# notebook_warmpool_hits_total{result}
+CLAIM_HIT = "hit"            # claimed a pre-provisioned Ready slice
+CLAIM_MISS = "miss"          # cold path: dedicated provision reserved
+CLAIM_BYPASS = "bypass"      # placed on pre-existing unmanaged capacity
+
+# event reasons (kubectl describe notebook)
+EVENT_SCHEDULED = "SliceScheduled"
+EVENT_RELEASED = "SliceReleased"
+
+
+def pool_object_name(accelerator: str, topology: str) -> str:
+    return f"warmpool-{accelerator}-{topology}"
+
+
+def parse_warmpool_shapes(shapes: str) -> list[tuple[str, str]]:
+    """WARMPOOL_SHAPES="v5e:4x4,v5p:2x2x2" -> [(accelerator, topology)].
+    Malformed entries are skipped (config must never take the manager
+    down), duplicates collapse."""
+    out: list[tuple[str, str]] = []
+    for part in shapes.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        accel, _, topo = part.partition(":")
+        if not accel or not topo:
+            continue
+        try:
+            resolve(accel, topo)
+        except TopologyError:
+            logger.warning("WARMPOOL_SHAPES: skipping malformed %r", part)
+            continue
+        if (accel, topo) not in out:
+            out.append((accel, topo))
+    return out
+
+
+def placement_of(annotations: dict) -> dict:
+    """The placement intent's slice map ({"<id>": {"pool": ..,
+    "nodes": [..]}}) from CR annotations; {} when absent/malformed."""
+    raw = annotations.get(C.ANNOTATION_PLACEMENT)
+    if not raw:
+        return {}
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return {}
+    slices = doc.get("slices") if isinstance(doc, dict) else None
+    return slices if isinstance(slices, dict) else {}
+
+
+def placement_covers(nb: Notebook, num_slices: int) -> bool:
+    """True when the intent assigns a pool to EVERY slice — the gang
+    gate the notebook controller holds STS rendering on."""
+    slices = placement_of(nb.metadata.annotations)
+    return all(
+        (slices.get(str(i)) or {}).get("pool")
+        for i in range(num_slices)
+    )
+
+
+# -- placement policy ----------------------------------------------------------
+@dataclass(frozen=True)
+class NodeCapacity:
+    """One schedulable node as the policy sees it: its pool membership and
+    the TPU chips still free after bound pods and standing reservations."""
+
+    name: str
+    pool: str
+    free_chips: float
+    total_chips: float
+
+
+@dataclass(frozen=True)
+class GangPlacement:
+    """All-or-nothing verdict: the pool the gang lands on plus the exact
+    node set (ordinal-ordered), or nothing at all."""
+
+    pool: str
+    nodes: tuple[str, ...]
+
+
+class PlacementPolicy(Protocol):
+    """The pluggable placement decision (a learned policy drops in here).
+    Must be deterministic for a given inventory: the scheduler replays it
+    on retries and across failovers and expects the same answer."""
+
+    def place(self, shape: SliceShape,
+              nodes: list[NodeCapacity]) -> Optional[GangPlacement]: ...
+
+
+class CostFunctionPolicy:
+    """Deterministic cost-function placement.
+
+    Multi-host gangs: feasible pools are those with >= num_hosts nodes
+    each fitting chips_per_host; the chosen pool minimizes leftover free
+    chips after placement (best-fit packing — keeps big contiguous pools
+    free for big gangs), tie-broken by pool name; within the pool the
+    fullest fitting nodes are taken first (hole-filling).  Never returns
+    a partial gang.
+
+    Single-host notebooks: spread — the node with the MOST free chips
+    wins (tie-break by name), so interactive singles distribute instead
+    of stacking onto one host.
+    """
+
+    def place(self, shape: SliceShape,
+              nodes: list[NodeCapacity]) -> Optional[GangPlacement]:
+        need = float(shape.chips_per_host)
+        fitting = [n for n in nodes if n.free_chips >= need]
+        if shape.num_hosts == 1:
+            if not fitting:
+                return None
+            best = sorted(fitting, key=lambda n: (-n.free_chips, n.name))[0]
+            return GangPlacement(best.pool, (best.name,))
+        by_pool: dict[str, list[NodeCapacity]] = {}
+        for n in fitting:
+            by_pool.setdefault(n.pool, []).append(n)
+        candidates: list[tuple[float, str, tuple[str, ...]]] = []
+        for pool, members in sorted(by_pool.items()):
+            if len(members) < shape.num_hosts:
+                continue
+            chosen = sorted(members, key=lambda n: (n.free_chips, n.name))
+            chosen = chosen[: shape.num_hosts]
+            leftover = sum(n.free_chips for n in members) \
+                - shape.num_hosts * need
+            candidates.append(
+                (leftover, pool, tuple(n.name for n in chosen)))
+        if not candidates:
+            return None
+        _, pool, names = min(candidates)
+        return GangPlacement(pool, names)
+
+
+# -- slice scheduler controller ------------------------------------------------
+class SliceScheduler:
+    """Owns the Notebook -> capacity binding: warm claims, cold
+    provisioning reservations, bypass placement on unmanaged capacity,
+    and culling->reclamation release.  All bookkeeping rides the shape's
+    TPUWarmPool status (one object per shape, optimistic concurrency
+    serializes racing claims), and the final intent is the placement
+    annotation — written only once EVERY slice has an assignment."""
+
+    def __init__(
+        self,
+        api: ApiServer,
+        cfg: CoreConfig,
+        metrics: NotebookMetrics,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+        cache=None,
+        policy: Optional[PlacementPolicy] = None,
+    ):
+        self.api = api
+        self.cfg = cfg
+        self.metrics = metrics
+        self.recorder = recorder or EventRecorder(api, "slice-scheduler")
+        self.clock = clock or Clock()
+        self.cache = cache
+        self.policy = policy or CostFunctionPolicy()
+
+    def reconcile(self, req: Request) -> Result:
+        if self.cache is not None:
+            obj = self.cache.get("Notebook", req.namespace, req.name)
+        else:
+            obj = self.api.try_get("Notebook", req.namespace, req.name)
+        if obj is None:
+            return Result()  # deletion: the pool controller GCs claims
+        nb = Notebook(obj)
+        tpu = nb.tpu
+        if tpu is None or obj.metadata.deletion_timestamp is not None:
+            return Result()
+        try:
+            shape = tpu.validate()
+        except InvalidError:
+            return Result()  # the validation webhook's problem, not ours
+        with _TRACER.start_span(
+            "schedule",
+            {"phase": "schedule", "namespace": req.namespace,
+             "notebook": req.name},
+        ) as span:
+            if C.STOP_ANNOTATION in nb.metadata.annotations:
+                return self._release(nb, shape, span)
+            return self._place(nb, tpu.slices, shape, span)
+
+    # -- placement -------------------------------------------------------------
+    def _place(self, nb: Notebook, num_slices: int, shape: SliceShape,
+               span) -> Result:
+        key = f"{nb.namespace}/{nb.name}"
+        out: dict = {}
+
+        def attempt() -> None:
+            live = self._ensure_pool(shape)
+            before = copy.deepcopy(live.body.get("status") or {})
+            st = copy.deepcopy(before)
+            st.setdefault("target", self.cfg.warmpool_size)
+            st.setdefault("seq", 0)
+            for k in ("hits", "misses", "bypass"):
+                st.setdefault(k, 0)
+            slices = st.setdefault("slices", {})
+            claims = {CLAIM_HIT: 0, CLAIM_MISS: 0, CLAIM_BYPASS: 0}
+            assignments: dict[int, str] = {}
+            waiting = False
+
+            # adopt claims/reservations already held (crash recovery: the
+            # claim is written ahead of the annotation, so a scheduler
+            # that died in between finds and finishes its own work)
+            for sid in sorted(slices):
+                e = slices[sid]
+                if e.get("claimedBy") != key:
+                    continue
+                idx = e.get("claimedSlice")
+                if isinstance(idx, int) and 0 <= idx < num_slices \
+                        and idx not in assignments:
+                    assignments[idx] = sid
+                else:
+                    self._release_entry(slices, sid)  # stale (scale-in)
+
+            for idx in range(num_slices):
+                sid = assignments.get(idx)
+                if sid is not None:
+                    e = slices[sid]
+                    if e.get("state") == C.WARMSLICE_PROVISIONING:
+                        waiting = True
+                    elif e.get("state") == C.WARMSLICE_READY:
+                        e["state"] = C.WARMSLICE_CLAIMED
+                    continue
+                # warm claim: lowest-id Ready unclaimed pool slice
+                cand = next(
+                    (s for s in sorted(slices)
+                     if slices[s].get("state") == C.WARMSLICE_READY
+                     and not slices[s].get("claimedBy")
+                     and not slices[s].get("external")),
+                    None)
+                if cand is not None:
+                    slices[cand].update({
+                        "state": C.WARMSLICE_CLAIMED,
+                        "claimedBy": key,
+                        "claimedSlice": idx,
+                    })
+                    assignments[idx] = cand
+                    st["hits"] += 1
+                    claims[CLAIM_HIT] += 1
+                    continue
+                # bypass: cost-function placement on pre-existing capacity
+                # outside any warm pool
+                gp = self.policy.place(
+                    shape, self._inventory(nb, shape, st))
+                if gp is not None:
+                    st["seq"] += 1
+                    sid = f"ws-{st['seq']:04d}"
+                    slices[sid] = {
+                        "state": C.WARMSLICE_CLAIMED,
+                        "external": True,
+                        "pool": gp.pool,
+                        "nodes": list(gp.nodes),
+                        "claimedBy": key,
+                        "claimedSlice": idx,
+                    }
+                    assignments[idx] = sid
+                    st["bypass"] += 1
+                    claims[CLAIM_BYPASS] += 1
+                    continue
+                # cold path: reserve a dedicated slice, provisioned by the
+                # WarmPoolController once readyAt passes
+                st["seq"] += 1
+                sid = f"ws-{st['seq']:04d}"
+                slices[sid] = {
+                    "state": C.WARMSLICE_PROVISIONING,
+                    "pool": "warm-%s-%s-%04d" % (
+                        shape.accelerator.name, shape.topology, st["seq"]),
+                    "readyAt": self.clock.now()
+                    + self.cfg.warmpool_provision_s,
+                    "claimedBy": key,
+                    "claimedSlice": idx,
+                }
+                assignments[idx] = sid
+                st["misses"] += 1
+                claims[CLAIM_MISS] += 1
+                waiting = True
+
+            if st != before:
+                live.status = st
+                self.api.update_status(live)
+            out.update(waiting=waiting, assignments=assignments,
+                       slices=copy.deepcopy(slices), claims=claims)
+
+        retry_on_conflict(attempt)
+
+        for result, n in out["claims"].items():
+            if n:
+                self.metrics.warmpool_hits.labels(result).inc(n)
+        if out["waiting"]:
+            span.add_event("schedule.wait", {
+                "reason": "provisioning",
+                "slices": len(out["assignments"])})
+            self._count(SCHEDULE_WAIT)
+            # the TPUWarmPool watch wakes us the moment the reservation
+            # turns Ready; the requeue is a safety net, not the signal
+            return Result(
+                requeue_after=max(self.cfg.warmpool_provision_s, 1.0))
+
+        intent = {"v": 1, "slices": {}}
+        for idx in range(num_slices):
+            e = out["slices"][out["assignments"][idx]]
+            entry = {"pool": e["pool"]}
+            if e.get("nodes"):
+                entry["nodes"] = list(e["nodes"])
+            intent["slices"][str(idx)] = entry
+        encoded = json.dumps(intent, sort_keys=True, separators=(",", ":"))
+        wrote = [False]
+
+        def write_intent() -> None:
+            live = self.api.get("Notebook", nb.namespace, nb.name)
+            if live.metadata.annotations.get(
+                    C.ANNOTATION_PLACEMENT) == encoded:
+                return
+            live.metadata.annotations[C.ANNOTATION_PLACEMENT] = encoded
+            self.api.update(live)
+            wrote[0] = True
+
+        retry_on_conflict(write_intent)
+        if wrote[0]:
+            span.add_event("schedule.placed", {
+                "pools": ",".join(sorted(
+                    e["pool"] for e in intent["slices"].values()))})
+            self._count(SCHEDULE_PLACED)
+            self.recorder.event(
+                nb.obj, "Normal", EVENT_SCHEDULED,
+                "Placed %d slice(s) onto pool(s) %s" % (
+                    num_slices,
+                    ", ".join(sorted(set(
+                        e["pool"] for e in intent["slices"].values())))))
+        else:
+            self._count(SCHEDULE_NOOP)
+        return Result()
+
+    # -- reclamation -----------------------------------------------------------
+    def _release(self, nb: Notebook, shape: SliceShape, span) -> Result:
+        """Culling -> reclamation: once the stopped notebook's slice is
+        fully parked (sliceHealth == Stopped — which postdates the
+        checkpoint-on-cull handshake by construction), its claims drain
+        back into the warm pool (nodes stay provisioned: the capacity is
+        resold) and the placement intent is retired so a later restart
+        re-places afresh."""
+        key = f"{nb.namespace}/{nb.name}"
+        pool = self.api.try_get(
+            C.WARMPOOL_KIND, "", pool_object_name(
+                shape.accelerator.name, shape.topology))
+        has_claims = pool is not None and any(
+            e.get("claimedBy") == key
+            for e in (pool.body.get("status", {}).get("slices") or {})
+            .values())
+        has_intent = C.ANNOTATION_PLACEMENT in nb.metadata.annotations
+        if not has_claims and not has_intent:
+            return Result()
+        health = (nb.status or {}).get("sliceHealth")
+        if health != "Stopped":
+            # still draining (Stopping) or status not written yet: the
+            # notebook controller's status transition re-triggers us
+            span.add_event("schedule.release_wait",
+                           {"sliceHealth": health or ""})
+            return Result()
+
+        if has_claims:
+            def release_claims() -> None:
+                live = self.api.get(C.WARMPOOL_KIND, "", pool.name)
+                st = copy.deepcopy(live.body.get("status") or {})
+                slices = st.setdefault("slices", {})
+                changed = False
+                for sid in list(slices):
+                    if slices[sid].get("claimedBy") == key:
+                        self._release_entry(slices, sid)
+                        changed = True
+                if changed:
+                    live.status = st
+                    self.api.update_status(live)
+
+            retry_on_conflict(release_claims)
+
+        def drop_intent() -> None:
+            live = self.api.get("Notebook", nb.namespace, nb.name)
+            if C.ANNOTATION_PLACEMENT in live.metadata.annotations:
+                del live.metadata.annotations[C.ANNOTATION_PLACEMENT]
+                self.api.update(live)
+
+        retry_on_conflict(drop_intent)
+        span.add_event("schedule.released")
+        self._count(SCHEDULE_RELEASED)
+        self.recorder.event(
+            nb.obj, "Normal", EVENT_RELEASED,
+            "Slice capacity returned to the warm pool")
+        return Result()
+
+    @staticmethod
+    def _release_entry(slices: dict, sid: str) -> None:
+        """Un-claim one pool slice: external (bypass) entries vanish —
+        the capacity was never pool-managed; warm entries turn Ready
+        (Provisioning reservations stay Provisioning) and rejoin the
+        claimable pool with their nodes intact."""
+        e = slices[sid]
+        if e.get("external"):
+            del slices[sid]
+            return
+        if e.get("state") == C.WARMSLICE_CLAIMED:
+            e["state"] = C.WARMSLICE_READY
+        e.pop("claimedBy", None)
+        e.pop("claimedSlice", None)
+
+    # -- capacity inventory ----------------------------------------------------
+    def _inventory(self, nb: Notebook, shape: SliceShape,
+                   pool_status: dict) -> list[NodeCapacity]:
+        """Schedulable capacity for bypass placement: nodes matching the
+        shape's accelerator/topology labels, grouped by node pool, with
+        free chips net of bound pods AND standing reservations (other
+        notebooks' pool entries whose pods have not bound yet).  Nodes
+        owned by any warm pool are excluded — warm capacity moves only
+        through claims."""
+        key = f"{nb.namespace}/{nb.name}"
+        reader = self.cache if self.cache is not None else self.api
+        warm_pools: set[str] = set()
+        reservations: dict[str, float] = {}
+        # pods once: per-node bound chips, per (node, notebook) bound chips
+        bound: dict[str, float] = {}
+        bound_by_nb: dict[tuple[str, str], float] = {}
+        for pod in reader.list("Pod"):
+            node = pod.spec.get("nodeName")
+            if not node:
+                continue
+            chips = _tpu_request(pod.spec)
+            if chips <= 0:
+                continue
+            bound[node] = bound.get(node, 0.0) + chips
+            owner = "%s/%s" % (
+                pod.namespace,
+                pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL, ""))
+            bound_by_nb[(node, owner)] = \
+                bound_by_nb.get((node, owner), 0.0) + chips
+        for pool_obj in self.api.list(C.WARMPOOL_KIND):
+            spec = pool_obj.spec
+            try:
+                pshape = resolve(spec.get("accelerator", ""),
+                                 spec.get("topology", ""))
+            except TopologyError:
+                continue
+            entries = (pool_obj.body.get("status", {})
+                       .get("slices") or {})
+            if pool_obj.name == pool_object_name(
+                    shape.accelerator.name, shape.topology):
+                entries = pool_status.get("slices") or {}
+            for e in entries.values():
+                if not e.get("external"):
+                    warm_pools.add(e.get("pool", ""))
+                claimant = e.get("claimedBy", "")
+                if claimant == key:
+                    continue
+                for node in e.get("nodes") or []:
+                    already = bound_by_nb.get((node, claimant), 0.0) \
+                        if claimant else 0.0
+                    reservations[node] = reservations.get(node, 0.0) + \
+                        max(pshape.chips_per_host - already, 0.0)
+        out: list[NodeCapacity] = []
+        for node in reader.list("Node"):
+            if node.spec.get("unschedulable"):
+                continue
+            labels = node.metadata.labels
+            if labels.get(C.GKE_TPU_ACCELERATOR_LABEL) != \
+                    shape.accelerator.gke_label:
+                continue
+            if labels.get(C.GKE_TPU_TOPOLOGY_LABEL) != shape.topology:
+                continue
+            pool = labels.get(C.GKE_NODEPOOL_LABEL) or node.name
+            if pool in warm_pools:
+                continue
+            total = parse_quantity(
+                node.body.get("status", {})
+                .get("allocatable", {}).get(C.TPU_RESOURCE, 0))
+            free = total - bound.get(node.name, 0.0) \
+                - reservations.get(node.name, 0.0)
+            out.append(NodeCapacity(node.name, pool, free, total))
+        return out
+
+    # -- plumbing --------------------------------------------------------------
+    def _ensure_pool(self, shape: SliceShape) -> KubeObject:
+        name = pool_object_name(shape.accelerator.name, shape.topology)
+        obj = self.api.try_get(C.WARMPOOL_KIND, "", name)
+        if obj is not None:
+            return obj
+        try:
+            return self.api.create(new_pool_object(
+                shape.accelerator.name, shape.topology))
+        except AlreadyExistsError:
+            return self.api.get(C.WARMPOOL_KIND, "", name)
+
+    def _count(self, result: str) -> None:
+        self.metrics.schedule_attempts.labels(result).inc()
+
+
+def _tpu_request(pod_spec: dict) -> float:
+    total = 0.0
+    for c in pod_spec.get("containers", []):
+        req = (c.get("resources", {}).get("requests") or {}) \
+            .get(C.TPU_RESOURCE)
+        if req is not None:
+            total += parse_quantity(req)
+    return total
+
+
+def new_pool_object(accelerator: str, topology: str) -> KubeObject:
+    return KubeObject(
+        api_version="kubeflow.org/v1",
+        kind=C.WARMPOOL_KIND,
+        metadata=ObjectMeta(name=pool_object_name(accelerator, topology)),
+        body={"spec": {"accelerator": accelerator, "topology": topology}},
+    )
+
+
+# -- warm-pool controller ------------------------------------------------------
+class WarmPoolController:
+    """Reconciles TPUWarmPool objects: turns Provisioning reservations
+    into Ready slices once their readyAt deadline passes (via the
+    pluggable SliceProvisioner — FakeCluster.provision_slice in
+    standalone mode, the cloud's node auto-provisioner in real life),
+    garbage-collects claims whose notebook vanished, and runs the
+    hit-rate autoscaler + sizing loop for shapes listed in
+    WARMPOOL_SHAPES."""
+
+    def __init__(
+        self,
+        api: ApiServer,
+        cfg: CoreConfig,
+        metrics: NotebookMetrics,
+        provisioner=None,
+        clock: Optional[Clock] = None,
+    ):
+        self.api = api
+        self.cfg = cfg
+        self.metrics = metrics
+        self.provisioner = provisioner
+        self.clock = clock or Clock()
+        self._managed_shapes = {
+            (a, t) for a, t in parse_warmpool_shapes(cfg.warmpool_shapes)}
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.api.try_get(C.WARMPOOL_KIND, req.namespace, req.name)
+        if obj is None:
+            return Result()
+        try:
+            shape = resolve(obj.spec.get("accelerator", ""),
+                            obj.spec.get("topology", ""))
+        except TopologyError:
+            return Result()
+        requeue = [0.0]
+
+        def attempt() -> None:
+            live = self.api.get(C.WARMPOOL_KIND, req.namespace, req.name)
+            before = copy.deepcopy(live.body.get("status") or {})
+            st = copy.deepcopy(before)
+            requeue[0] = self._step(st, shape)
+            if st != before:
+                live.status = st
+                self.api.update_status(live)
+
+        retry_on_conflict(attempt)
+        if requeue[0] > 0:
+            return Result(requeue_after=requeue[0])
+        return Result()
+
+    def _step(self, st: dict, shape: SliceShape) -> float:
+        now = self.clock.now()
+        st.setdefault("target", self.cfg.warmpool_size)
+        st.setdefault("seq", 0)
+        for k in ("hits", "misses", "bypass"):
+            st.setdefault(k, 0)
+        slices = st.setdefault("slices", {})
+
+        # orphan-claim GC: a deleted notebook never released — reclaim
+        # (the failover-safe twin of the scheduler's Stopped release)
+        for sid in list(slices):
+            claimant = slices[sid].get("claimedBy")
+            if not claimant:
+                continue
+            ns, _, name = claimant.partition("/")
+            nb = self.api.try_get("Notebook", ns, name)
+            if nb is None or nb.metadata.deletion_timestamp is not None:
+                SliceScheduler._release_entry(slices, sid)
+
+        # Provisioning -> Ready once the deadline passes; the provisioner
+        # call is idempotent, so an RMW conflict retry re-runs it safely
+        next_due: Optional[float] = None
+        for sid in sorted(slices):
+            e = slices[sid]
+            if e.get("state") != C.WARMSLICE_PROVISIONING:
+                continue
+            ready_at = float(e.get("readyAt", 0.0))
+            if ready_at <= now:
+                e["nodes"] = self._provision(shape, e["pool"])
+                e["state"] = C.WARMSLICE_READY
+                e.pop("readyAt", None)
+            elif next_due is None or ready_at < next_due:
+                next_due = ready_at
+
+        if (shape.accelerator.name, shape.topology) in self._managed_shapes \
+                and self.cfg.warmpool_size > 0:
+            next_due = self._autoscale(st, shape, now, next_due)
+        else:
+            # unmanaged shape (not in WARMPOOL_SHAPES): idle capacity is
+            # not kept warm — a released slice is torn straight back down,
+            # which is exactly the cold path the warm pool exists to beat
+            st["target"] = 0
+            for sid in sorted(slices):
+                e = slices[sid]
+                if e.get("state") == C.WARMSLICE_READY \
+                        and not e.get("claimedBy") \
+                        and not e.get("external"):
+                    self._deprovision(e["pool"])
+                    del slices[sid]
+
+        return max(next_due - now, 0.0) if next_due is not None else 0.0
+
+    def _autoscale(self, st: dict, shape: SliceShape, now: float,
+                   next_due: Optional[float]) -> Optional[float]:
+        """Grow the target by the misses observed since the last pass
+        (every miss is a notebook that paid the cold path — the pool was
+        too small); decay it one step back toward the configured base
+        while the cumulative hit rate holds above the goal and idle
+        capacity exceeds the target.  Then size the pool to the target:
+        provision the shortfall, retire idle excess (highest id first —
+        the youngest slices go back first, deterministically)."""
+        slices = st["slices"]
+        base = self.cfg.warmpool_size
+        target = int(st.get("target", base))
+        dm = st["misses"] - st.get("lastMisses", 0)
+        dh = st["hits"] - st.get("lastHits", 0)
+        # windowed hit rate (since the last pass): the cumulative rate
+        # never recovers from an early burst of misses, so decay would
+        # stall forever on it.  An empty window counts as healthy.
+        window = dh + dm
+        hit_rate = (dh / window) if window else 1.0
+        unclaimed = [
+            sid for sid in sorted(slices)
+            if not slices[sid].get("claimedBy")
+            and not slices[sid].get("external")]
+        idle_ready = [
+            sid for sid in unclaimed
+            if slices[sid].get("state") == C.WARMSLICE_READY]
+        last_decay = float(st.setdefault("lastDecayAt", now))
+        if dm > 0:
+            # every miss is a notebook that paid the cold path: grow, and
+            # reset the scale-down cooldown
+            target = min(target + dm, self.cfg.warmpool_max_size)
+            st["lastDecayAt"] = now
+        elif target > base and len(idle_ready) >= target \
+                and hit_rate >= self.cfg.warmpool_target_hit_rate \
+                and now - last_decay >= self.cfg.warmpool_decay_s:
+            # a full cooldown with zero misses and the pool fully idle:
+            # one step back toward the configured base
+            target -= 1
+            st["lastDecayAt"] = now
+        st["lastMisses"] = st["misses"]
+        st["lastHits"] = st["hits"]
+        st["target"] = target
+        if target > base:
+            # arm the next decay check — an idle pool gets no events, so
+            # the cooldown must wake the reconciler itself
+            decay_due = float(st["lastDecayAt"]) + self.cfg.warmpool_decay_s
+            if next_due is None or decay_due < next_due:
+                next_due = decay_due
+
+        while len(unclaimed) < target:
+            st["seq"] += 1
+            sid = f"ws-{st['seq']:04d}"
+            ready_at = now + self.cfg.warmpool_provision_s
+            slices[sid] = {
+                "state": C.WARMSLICE_PROVISIONING,
+                "pool": "warm-%s-%s-%04d" % (
+                    shape.accelerator.name, shape.topology, st["seq"]),
+                "readyAt": ready_at,
+            }
+            unclaimed.append(sid)
+            if next_due is None or ready_at < next_due:
+                next_due = ready_at
+        # shrink: cancel not-yet-up Provisioning entries first (nothing to
+        # tear down), then retire the youngest idle Ready slices — a just-
+        # reclaimed slice must never lose out to a pending turn-up
+        cancellable = [
+            sid for sid in unclaimed
+            if slices[sid].get("state") == C.WARMSLICE_PROVISIONING]
+        while len(unclaimed) > target and (cancellable or idle_ready):
+            sid = cancellable.pop() if cancellable else idle_ready.pop()
+            self._deprovision(slices[sid]["pool"])
+            del slices[sid]
+            unclaimed.remove(sid)
+        return next_due
+
+    def _provision(self, shape: SliceShape, pool: str) -> list[str]:
+        if self.provisioner is None:
+            # real-cluster mode: capacity turn-up belongs to the cloud's
+            # node auto-provisioner; the pool entry still tracks intent
+            return []
+        return list(self.provisioner.provision_slice(shape, pool))
+
+    def _deprovision(self, pool: str) -> None:
+        if self.provisioner is not None:
+            self.provisioner.deprovision_slice(pool)
+
+
+# -- wiring --------------------------------------------------------------------
+def setup_scheduler(
+    mgr: Manager,
+    cfg: CoreConfig,
+    metrics: NotebookMetrics,
+    provisioner=None,
+    policy: Optional[PlacementPolicy] = None,
+) -> tuple[SliceScheduler, WarmPoolController]:
+    """Register the SliceScheduler + WarmPoolController pair and seed the
+    per-shape pool objects for WARMPOOL_SHAPES.  `provisioner` is the
+    data-plane hook (FakeCluster in standalone mode) that actually turns
+    capacity up/down; None means capacity management is external."""
+    api = mgr.api
+    sched = SliceScheduler(
+        api, cfg, metrics, EventRecorder(api, "slice-scheduler"),
+        clock=mgr.clock, cache=mgr.cache, policy=policy)
+    pools = WarmPoolController(
+        api, cfg, metrics, provisioner=provisioner, clock=mgr.clock)
+
+    def pool_to_notebooks(obj: KubeObject) -> list[Request]:
+        # a pool transition (reservation turned Ready, slice released)
+        # re-evaluates exactly the notebooks holding entries in it
+        out: list[Request] = []
+        seen: set[str] = set()
+        for e in (obj.body.get("status", {}).get("slices") or {}).values():
+            claimant = e.get("claimedBy")
+            if claimant and claimant not in seen:
+                seen.add(claimant)
+                ns, _, name = claimant.partition("/")
+                out.append(Request(ns, name))
+        return out
+
+    def notebook_to_pool(obj: KubeObject) -> list[Request]:
+        tpu = obj.spec.get("tpu") or {}
+        accel = str(tpu.get("accelerator", ""))
+        topo = str(tpu.get("topology", ""))
+        if not accel or not topo:
+            return []
+        return [Request("", pool_object_name(accel, topo))]
+
+    mgr.register(
+        "slice-scheduler",
+        sched,
+        for_kind="Notebook",
+        # no suppress_status_only here: release keys off the Stopped
+        # sliceHealth transition, which IS a status-only write
+        watches=[WatchSpec(kind=C.WARMPOOL_KIND, mapper=pool_to_notebooks)],
+    )
+    mgr.register(
+        "warm-pool",
+        pools,
+        for_kind=C.WARMPOOL_KIND,
+        watches=[WatchSpec(
+            kind="Notebook",
+            mapper=notebook_to_pool,
+            # only deletions matter: orphan-claim GC
+            predicate=lambda ev: ev.type is EventType.DELETED,
+        )],
+    )
+    for accel, topo in parse_warmpool_shapes(cfg.warmpool_shapes):
+        if api.try_get(C.WARMPOOL_KIND, "",
+                       pool_object_name(accel, topo)) is None:
+            try:
+                api.create(new_pool_object(accel, topo))
+            except AlreadyExistsError:
+                pass
+    return sched, pools

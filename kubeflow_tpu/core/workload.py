@@ -159,6 +159,12 @@ def generate_statefulsets(nb: Notebook, cfg: CoreConfig) -> list[KubeObject]:
         return [sts]
 
     shape = tpu.validate()
+    # slice-scheduler placement intent (core/scheduler.py): slice id ->
+    # node-pool assignment, rendered as a nodeSelector so the whole gang
+    # co-locates on the pool the scheduler chose
+    from .scheduler import placement_of
+
+    placement = placement_of(nb.metadata.annotations)
     out = []
     for slice_id in range(tpu.slices):
         name = tpuenv.statefulset_name(nb.name, slice_id, tpu.slices)
@@ -170,6 +176,9 @@ def generate_statefulsets(nb: Notebook, cfg: CoreConfig) -> list[KubeObject]:
         selector = pod_spec.setdefault("nodeSelector", {})
         selector[C.GKE_TPU_ACCELERATOR_LABEL] = shape.accelerator.gke_label
         selector[C.GKE_TPU_TOPOLOGY_LABEL] = shape.topology
+        assigned_pool = (placement.get(str(slice_id)) or {}).get("pool")
+        if assigned_pool:
+            selector[C.GKE_NODEPOOL_LABEL] = assigned_pool
         main = pod_spec["containers"][0]
         resources = main.setdefault("resources", {})
         for kind in ("requests", "limits"):

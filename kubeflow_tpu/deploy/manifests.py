@@ -116,6 +116,54 @@ def notebook_crd(conversion_webhook: bool = True) -> dict:
     return crd
 
 
+def warmpool_crd() -> dict:
+    """The TPUWarmPool CRD (core/scheduler.py): one cluster-scoped object
+    per accelerator/topology shape; claim/release bookkeeping lives in its
+    status subresource so it survives manager failover."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"tpuwarmpools.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": "TPUWarmPool",
+                "listKind": "TPUWarmPoolList",
+                "plural": "tpuwarmpools",
+                "singular": "tpuwarmpool",
+            },
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "name": "v1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "properties": {
+                                        "accelerator": {"type": "string"},
+                                        "topology": {"type": "string"},
+                                    },
+                                },
+                                "status": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields":
+                                        True,
+                                },
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }
+
+
 def rbac_role() -> dict:
     """ClusterRole covering both controllers (reference config/rbac/role.yaml
     union of core + odh markers)."""
@@ -124,6 +172,13 @@ def rbac_role() -> dict:
          "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
         {"apiGroups": [GROUP],
          "resources": ["notebooks/status", "notebooks/finalizers"],
+         "verbs": ["get", "update", "patch"]},
+        # slice scheduler + warm pool (core/scheduler.py): claim/release
+        # bookkeeping lives on TPUWarmPool status
+        {"apiGroups": [GROUP], "resources": ["tpuwarmpools"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": [GROUP], "resources": ["tpuwarmpools/status"],
          "verbs": ["get", "update", "patch"]},
         {"apiGroups": ["apps"], "resources": ["statefulsets"],
          "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
@@ -356,6 +411,7 @@ def render_profile(profile: str = "standalone",
         raise ValueError(f"unknown profile {profile!r}; choose from {PROFILES}")
     docs: list[dict] = [
         notebook_crd(conversion_webhook=profile != "standalone"),
+        warmpool_crd(),
         rbac_role(),
         {
             "apiVersion": "v1",

@@ -27,6 +27,10 @@ _TPU_SLICE_LABEL = "notebooks.kubeflow.org/tpu-slice"
 _RESTORED_GENERATION_ANNOTATION = \
     "notebooks.kubeflow.org/restored-generation"
 _RESTORED_DIGEST_ANNOTATION = "notebooks.kubeflow.org/restored-digest"
+_GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+_GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+_GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+_TPU_RESOURCE = "google.com/tpu"
 
 
 def parse_quantity(q) -> float:
@@ -69,7 +73,18 @@ class FakeCluster:
         # equivalence drills assert against
         self._session_store = None
         self._session_payload: dict[tuple[str, str], bytes] = {}
+        # incremental scheduler accounting: per-node used resources kept in
+        # lockstep with pod bind/delete events, so one placement decision
+        # costs O(nodes) instead of O(pods x nodes).  _bound remembers each
+        # accounted pod's (node, requests) so re-deliveries stay idempotent.
+        self._node_used: dict[str, dict[str, float]] = {}
+        self._bound: dict[tuple[str, str], tuple[str, dict[str, float]]] = {}
         api.watch(self._on_event)
+        # prime the accounting for pods that predate this cluster (a data
+        # plane attached to an already-populated store)
+        with api.fault_exempt():
+            for pod in api.list("Pod"):
+                self._account_pod(pod)
 
     # -- node inventory --------------------------------------------------------
     def add_node(
@@ -99,26 +114,75 @@ class FakeCluster:
         num_hosts: int,
         chips_per_host: int,
         name_prefix: str = "tpu-node",
+        pool: Optional[str] = None,
     ) -> list[KubeObject]:
         """Fake GKE TPU node pool: one node per slice host, labeled the way
-        GKE labels TPU nodes so nodeSelector scheduling is exercised."""
+        GKE labels TPU nodes so nodeSelector scheduling is exercised.  Every
+        node carries a `cloud.google.com/gke-nodepool` label (one call = one
+        pool unless overridden) — the grouping the topology-aware slice
+        scheduler packs gangs by."""
+        pool = pool or f"{name_prefix}-{accelerator}"
         nodes = []
         for i in range(num_hosts):
             nodes.append(
                 self.add_node(
                     f"{name_prefix}-{accelerator}-{i}",
                     labels={
-                        "cloud.google.com/gke-tpu-accelerator": accelerator,
-                        "cloud.google.com/gke-tpu-topology": topology,
+                        _GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                        _GKE_TPU_TOPOLOGY_LABEL: topology,
+                        _GKE_NODEPOOL_LABEL: pool,
                     },
                     allocatable={
                         "cpu": "96",
                         "memory": "192Gi",
-                        "google.com/tpu": str(chips_per_host),
+                        _TPU_RESOURCE: str(chips_per_host),
                     },
                 )
             )
         return nodes
+
+    # -- cloud provider (warm-pool provisioner hook) ---------------------------
+    def provision_slice(self, shape, pool: str) -> list[str]:
+        """Turn up one TPU slice's node set for the warm pool
+        (core/scheduler.WarmPoolController): num_hosts nodes labeled with
+        the given nodepool, each exposing chips_per_host `google.com/tpu`.
+        Idempotent — a conflict-retried or crash-resumed provisioning pass
+        skips nodes that already exist."""
+        names = []
+        with self.api.fault_exempt():
+            for i in range(shape.num_hosts):
+                name = f"{pool}-{i}"
+                names.append(name)
+                if self.api.try_get("Node", "", name) is not None:
+                    continue
+                self.add_node(
+                    name,
+                    labels={
+                        _GKE_TPU_ACCELERATOR_LABEL:
+                            shape.accelerator.gke_label,
+                        _GKE_TPU_TOPOLOGY_LABEL: shape.topology,
+                        _GKE_NODEPOOL_LABEL: pool,
+                    },
+                    allocatable={
+                        "cpu": "96",
+                        "memory": "192Gi",
+                        _TPU_RESOURCE: str(shape.chips_per_host),
+                    },
+                )
+        return names
+
+    def deprovision_slice(self, pool: str) -> None:
+        """Tear a warm slice's node set back down (autoscaler shrink)."""
+        with self.api.fault_exempt():
+            doomed = [
+                n.name for n in self.api.list("Node")
+                if n.metadata.labels.get(_GKE_NODEPOOL_LABEL) == pool
+            ]
+            for name in doomed:
+                try:
+                    self.api.delete("Node", "", name)
+                except NotFoundError:
+                    pass
 
     # -- failure injection -----------------------------------------------------
     def fail_pod(self, namespace: str, name: str, reason: str = "TPUUnhealthy") -> None:
@@ -206,6 +270,11 @@ class FakeCluster:
                 return
             node.spec.pop("unschedulable", None)
             self.api.update(node)
+            # schedule capacity came back: pods the cordon left Pending must
+            # retry NOW, not whenever the next unrelated node/capacity event
+            # happens to land (a no-op update notifies no watcher, so the
+            # Node-MODIFIED retry path alone cannot be relied on)
+            self._retry_pending_pods()
 
     def mark_running(self, namespace: str, name: str) -> None:
         """Drive a created-but-not-yet-Ready pod to Running/Ready by hand —
@@ -368,12 +437,19 @@ class FakeCluster:
                 self._reconcile_sts(ev.obj.namespace, ev.obj.name)
             elif ev.type == EventType.DELETED:
                 pass  # pods cascade via owner-ref GC
-        elif kind == "Pod" and ev.type == EventType.DELETED:
-            self._failed_pods.discard((ev.obj.namespace, ev.obj.name))
-            owner = ev.obj.metadata.controller_owner()
-            if owner is not None and owner.kind == "StatefulSet":
-                self._reconcile_sts(ev.obj.namespace, owner.name)
-            self._retry_pending_pods()  # freed capacity may unblock others
+        elif kind == "Pod":
+            if ev.type == EventType.DELETED:
+                self._unaccount_pod(ev.obj)
+                self._failed_pods.discard((ev.obj.namespace, ev.obj.name))
+                owner = ev.obj.metadata.controller_owner()
+                if owner is not None and owner.kind == "StatefulSet":
+                    self._reconcile_sts(ev.obj.namespace, owner.name)
+                self._retry_pending_pods()  # freed capacity may unblock others
+            else:
+                # bind accounting: the synchronous watch stream means the
+                # used-resources map is current before the write that bound
+                # the pod even returns to its caller
+                self._account_pod(ev.obj)
         elif kind == "Node" and ev.type in (EventType.ADDED, EventType.MODIFIED):
             self._retry_pending_pods()
         elif kind == "ServiceAccount" and ev.type == EventType.ADDED:
@@ -483,12 +559,60 @@ class FakeCluster:
         }
         self.api.update_status(pod)
 
-    def _schedule(self, pod: KubeObject) -> Optional[KubeObject]:
-        selector = pod.spec.get("nodeSelector") or {}
+    @staticmethod
+    def _pod_requests(pod_spec: dict) -> dict[str, float]:
         requests: dict[str, float] = {}
-        for c in pod.spec.get("containers", []):
+        for c in pod_spec.get("containers", []):
             for res, q in (c.get("resources", {}).get("requests") or {}).items():
                 requests[res] = requests.get(res, 0.0) + parse_quantity(q)
+        return requests
+
+    def _account_pod(self, pod: KubeObject) -> None:
+        """Fold a bound pod into the per-node used map (idempotent: a
+        re-delivered event with unchanged node+requests is a no-op)."""
+        key = (pod.namespace, pod.name)
+        node = pod.spec.get("nodeName") or ""
+        requests = self._pod_requests(pod.spec) if node else {}
+        prev = self._bound.get(key)
+        if prev is not None and prev == (node, requests):
+            return
+        if prev is not None:
+            self._subtract_used(*prev)
+            del self._bound[key]
+        if not node:
+            return
+        self._bound[key] = (node, requests)
+        used = self._node_used.setdefault(node, {})
+        for res, v in requests.items():
+            used[res] = used.get(res, 0.0) + v
+
+    def _unaccount_pod(self, pod: KubeObject) -> None:
+        prev = self._bound.pop((pod.namespace, pod.name), None)
+        if prev is not None:
+            self._subtract_used(*prev)
+
+    def _subtract_used(self, node: str, requests: dict[str, float]) -> None:
+        used = self._node_used.get(node)
+        if used is None:
+            return
+        for res, v in requests.items():
+            left = used.get(res, 0.0) - v
+            if left > 1e-9:
+                used[res] = left
+            else:
+                used.pop(res, None)
+        if not used:
+            del self._node_used[node]
+
+    def node_used(self, name: str) -> dict[str, float]:
+        """Incrementally-maintained used resources of one node (the sum of
+        requests of pods bound there) — the equivalence tests compare this
+        against the brute-force recount."""
+        return dict(self._node_used.get(name, {}))
+
+    def _schedule(self, pod: KubeObject) -> Optional[KubeObject]:
+        selector = pod.spec.get("nodeSelector") or {}
+        requests = self._pod_requests(pod.spec)
         for node in self.api.list("Node"):
             if node.spec.get("unschedulable"):
                 continue  # cordoned: kube-scheduler never places here
@@ -496,14 +620,9 @@ class FakeCluster:
             if not all(node_labels.get(k) == v for k, v in selector.items()):
                 continue
             alloc = node.body.get("status", {}).get("allocatable", {})
-            # subtract pods already bound to this node
-            used: dict[str, float] = {}
-            for p in self.api.list("Pod"):
-                if p.spec.get("nodeName") != node.name:
-                    continue
-                for c in p.spec.get("containers", []):
-                    for res, q in (c.get("resources", {}).get("requests") or {}).items():
-                        used[res] = used.get(res, 0.0) + parse_quantity(q)
+            # used resources come from the incrementally-maintained map —
+            # O(1) per node instead of a full pod-list resum per candidate
+            used = self._node_used.get(node.name, {})
             if all(
                 parse_quantity(alloc.get(res, 0)) - used.get(res, 0.0) >= need
                 for res, need in requests.items()

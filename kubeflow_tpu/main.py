@@ -241,7 +241,9 @@ def build_manager(
     core_cfg = core_cfg or CoreConfig.from_env()
     odh_cfg = odh_cfg or OdhConfig.from_env()
     metrics = NotebookMetrics(api)
-    setup_core_controllers(mgr, core_cfg, metrics)
+    # the fake cluster doubles as the warm-pool provisioner (cloud-provider
+    # hook): ENABLE_SLICE_SCHEDULER turns capacity up/down through it
+    setup_core_controllers(mgr, core_cfg, metrics, provisioner=cluster)
     setup_culling(mgr, core_cfg, metrics=metrics)
     from .odh.controller import setup_odh_controllers
     from .odh.tls_profile import SecurityProfileWatcher, fetch_apiserver_tls_profile

@@ -120,6 +120,22 @@ class CoreConfig:
     checkpoint_interval_s: float = 300.0      # CHECKPOINT_INTERVAL_S
     checkpoint_max_age_s: float = 600.0       # CHECKPOINT_MAX_AGE_S
     checkpoint_signal_root: str = ""          # CHECKPOINT_SIGNAL_ROOT
+    # topology-aware slice scheduler + warm-pool autoscaler
+    # (core/scheduler.py).  When enabled, TPU workload StatefulSets are
+    # gang-gated on an all-or-nothing placement intent, and a warm pool of
+    # pre-provisioned slices per shape (WARMPOOL_SHAPES, e.g.
+    # "v5e:4x4,v5p:2x2x2") turns notebook start into a claim instead of a
+    # cold slice provision (warmpool_provision_s of fake/real time).  The
+    # autoscaler grows the per-shape target on misses (bounded by
+    # warmpool_max_size) and decays it back toward warmpool_size while the
+    # observed hit rate holds above warmpool_target_hit_rate.
+    enable_slice_scheduler: bool = False      # ENABLE_SLICE_SCHEDULER
+    warmpool_size: int = 0                    # WARMPOOL_SIZE
+    warmpool_shapes: str = ""                 # WARMPOOL_SHAPES
+    warmpool_provision_s: float = 120.0       # WARMPOOL_PROVISION_S
+    warmpool_max_size: int = 64               # WARMPOOL_MAX_SIZE
+    warmpool_target_hit_rate: float = 0.9     # WARMPOOL_TARGET_HIT_RATE
+    warmpool_decay_s: float = 600.0           # WARMPOOL_DECAY_S
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -157,6 +173,15 @@ class CoreConfig:
             checkpoint_max_age_s=_float(
                 env, "CHECKPOINT_MAX_AGE_S", 600.0),
             checkpoint_signal_root=env.get("CHECKPOINT_SIGNAL_ROOT", ""),
+            enable_slice_scheduler=_bool(
+                env, "ENABLE_SLICE_SCHEDULER", False),
+            warmpool_size=max(0, _int(env, "WARMPOOL_SIZE", 0)),
+            warmpool_shapes=env.get("WARMPOOL_SHAPES", ""),
+            warmpool_provision_s=_float(env, "WARMPOOL_PROVISION_S", 120.0),
+            warmpool_max_size=max(1, _int(env, "WARMPOOL_MAX_SIZE", 64)),
+            warmpool_target_hit_rate=_float(
+                env, "WARMPOOL_TARGET_HIT_RATE", 0.9),
+            warmpool_decay_s=_float(env, "WARMPOOL_DECAY_S", 600.0),
         )
 
 

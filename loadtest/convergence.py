@@ -29,6 +29,18 @@ is identical to the single-worker run.
 reconciles-per-notebook against the committed budget and fails on >
 `tolerance` regression — the deterministic CI perf gate.  Regenerate an
 intentionally-changed budget with `--write-budget FILE`.
+
+Bursty mode (`--bursty N`) drives the slice scheduler + warm pool
+(core/scheduler.py) with a bursty arrival trace instead of one flood:
+`--bursts` waves of N TPU notebooks, each wave stopped (the cull analog)
+before the next so culling->reclamation resells the same slices, with a
+manager failover injected mid-run (pool bookkeeping and placement intents
+must survive it).  It runs the trace twice — warm pool on
+(`--warm-size`) and off — and prints p50/p99 notebook-ready time and
+slice utilization for both; `--check-warm-budget FILE` gates the
+comparison (warm p50 strictly below cold, minimum hit rate) for CI.
+Gang atomicity (never a partially placed slice; every slice co-located
+on one node pool) is asserted at every wave's convergence.
 """
 
 from __future__ import annotations
@@ -209,6 +221,253 @@ def run_fleet(count: int, workers: int, tpu: str = "") -> dict:
     }
 
 
+def _percentile(values: list[float], q: float) -> float:
+    """Exact q-percentile (nearest-rank) of measured ready times."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    import math
+
+    idx = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+    return ordered[idx]
+
+
+def _audit_gang(api: ApiServer, shape) -> None:
+    """Gang atomicity + co-location: every TPU slice has either all of its
+    workers bound or none, and all bound workers of one slice sit on nodes
+    of ONE node pool (the scheduler's placement intent, honored)."""
+    from kubeflow_tpu.core import constants as C
+
+    by_slice: dict[tuple[str, str, str], list] = {}
+    for pod in api.list("Pod"):
+        nb = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+        if nb is None:
+            continue
+        slice_id = pod.metadata.labels.get(C.TPU_SLICE_LABEL, "0")
+        by_slice.setdefault((pod.namespace, nb, slice_id), []).append(pod)
+    for (ns, nb, slice_id), pods in sorted(by_slice.items()):
+        bound = [p for p in pods if p.spec.get("nodeName")]
+        if len(bound) not in (0, shape.num_hosts):
+            raise AssertionError(
+                f"gang atomicity violated: {ns}/{nb} slice {slice_id} has "
+                f"{len(bound)}/{shape.num_hosts} workers bound")
+        pools = set()
+        for p in bound:
+            node = api.try_get("Node", "", p.spec["nodeName"])
+            pools.add(None if node is None
+                      else node.metadata.labels.get(C.GKE_NODEPOOL_LABEL))
+        if bound and len(pools) != 1:
+            raise AssertionError(
+                f"co-location violated: {ns}/{nb} slice {slice_id} spans "
+                f"pools {sorted(str(p) for p in pools)}")
+
+
+def _audit_pool_bookkeeping(api: ApiServer) -> None:
+    """Claim consistency: no slice entry claimed twice for one notebook
+    slice, no claim pointing at a missing notebook, and every placed
+    (annotated) notebook backed by exactly its claims."""
+    from kubeflow_tpu.core import constants as C
+    from kubeflow_tpu.core.scheduler import placement_of
+
+    claims: dict[tuple[str, int], str] = {}
+    for pool in api.list(C.WARMPOOL_KIND):
+        slices = pool.body.get("status", {}).get("slices") or {}
+        for sid, e in slices.items():
+            claimant = e.get("claimedBy")
+            if not claimant:
+                continue
+            ckey = (claimant, e.get("claimedSlice"))
+            if ckey in claims:
+                raise AssertionError(
+                    f"double claim: {ckey} held by {claims[ckey]} and {sid}")
+            claims[ckey] = sid
+            ns, _, name = claimant.partition("/")
+            if api.try_get("Notebook", ns, name) is None:
+                raise AssertionError(f"orphan claim {sid} -> {claimant}")
+    for nb in api.list("Notebook"):
+        tpu = nb.spec.get("tpu")
+        if not tpu:
+            continue
+        placed = placement_of(nb.metadata.annotations)
+        if not placed:
+            continue
+        key = f"{nb.namespace}/{nb.name}"
+        for i in range(int(tpu.get("slices", 1))):
+            if (key, i) not in claims:
+                raise AssertionError(
+                    f"placement intent of {key} slice {i} has no backing "
+                    "claim")
+
+
+def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
+               warm_size: int, provision_s: float = 120.0,
+               failover: bool = True) -> dict:
+    """One bursty-arrival run of the slice scheduler: `bursts` waves of
+    `count` TPU notebooks, each wave stopped (culled) before the next so
+    reclamation resells its slices, a manager failover between waves 1
+    and 2, and exact per-notebook ready-time measurement off the
+    FakeClock."""
+    from kubeflow_tpu.core import constants as C
+    from kubeflow_tpu.core.metrics import NotebookMetrics
+    from kubeflow_tpu.kube import retry_on_conflict
+
+    accel, topology = tpu.split(":")
+    spec = TPUSpec(accel, topology)
+    shape = spec.validate()
+    env = {
+        "ENABLE_SLICE_SCHEDULER": "true",
+        "WARMPOOL_SIZE": str(warm_size),
+        "WARMPOOL_SHAPES": f"{accel}:{topology}" if warm_size else "",
+        "WARMPOOL_PROVISION_S": f"{provision_s:g}",
+    }
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    clock = FakeClock()
+
+    def build() -> tuple[Manager, NotebookMetrics]:
+        mgr = Manager(api, clock=clock,
+                      flight_recorder=FlightRecorder(
+                          capacity=max(4096, count * bursts * 8),
+                          max_objects=max(2048, count * bursts * 4)))
+        cfg = CoreConfig.from_env(env)
+        metrics = NotebookMetrics(api, manager=mgr)
+        setup_core_controllers(mgr, cfg, metrics, provisioner=cluster)
+        return mgr, metrics
+
+    mgr, metrics = build()
+    mgr.settle(max_seconds=provision_s * 4 + 60)  # pre-warm the pool
+
+    expected_ready = shape.num_hosts * spec.slices
+    ready_s: dict[str, float] = {}
+    utilization: list[float] = []
+
+    def drain_until_ready(pending: dict[str, float],
+                          deadline_s: float) -> None:
+        deadline = clock.now() + deadline_s
+        while True:
+            mgr.run_until_idle()
+            for name in list(pending):
+                status = api.get("Notebook", NAMESPACE,
+                                 name).body.get("status") or {}
+                if status.get("readyReplicas") == expected_ready:
+                    ready_s[name] = clock.now() - pending.pop(name)
+            if not pending:
+                return
+            due = [d for (_, _, d) in mgr.pending_delayed()]
+            if not due or min(due) > deadline:
+                raise AssertionError(
+                    f"{len(pending)} notebooks unready past the deadline "
+                    f"(first: {sorted(pending)[:3]})")
+            delta = min(due) - clock.now()
+            if delta > 0:
+                clock.advance(delta)
+
+    def stop_and_release(names: list[str]) -> None:
+        for name in names:
+            def stop() -> None:
+                live = api.get("Notebook", NAMESPACE, name)
+                live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+                api.update(live)
+            retry_on_conflict(stop)
+        mgr.settle(max_seconds=gap_s)
+        for name in names:
+            live = api.get("Notebook", NAMESPACE, name)
+            health = (live.body.get("status") or {}).get("sliceHealth")
+            if health != "Stopped":
+                raise AssertionError(f"{name} failed to stop: {health}")
+            if C.ANNOTATION_PLACEMENT in live.metadata.annotations:
+                raise AssertionError(
+                    f"{name} stopped but its slice was never reclaimed")
+
+    for b in range(bursts):
+        if b == 1 and failover:
+            # manager failover mid-run: a fresh manager over the same
+            # store must resume claims/intents, never re-derive them
+            mgr.stop()
+            mgr, metrics = build()
+            mgr.enqueue_all()
+            mgr.settle(max_seconds=60)
+        names = [f"nb-b{b}-{i:04d}" for i in range(count)]
+        t0 = clock.now()
+        for name in names:
+            api.create(Notebook.new(name, NAMESPACE, tpu=spec).obj)
+        drain_until_ready({n: t0 for n in names},
+                          deadline_s=provision_s * 4 + 600)
+        _audit_gang(api, shape)
+        _audit_pool_bookkeeping(api)
+        # slice utilization at wave convergence: claimed warm slices over
+        # warm slices currently up (Ready or Claimed)
+        claimed = up = 0
+        for pool in api.list(C.WARMPOOL_KIND):
+            for e in (pool.body.get("status", {}).get("slices")
+                      or {}).values():
+                if e.get("external"):
+                    continue
+                if e.get("state") == C.WARMSLICE_CLAIMED:
+                    claimed += 1
+                    up += 1
+                elif e.get("state") == C.WARMSLICE_READY:
+                    up += 1
+        utilization.append(round(claimed / up, 3) if up else 1.0)
+        stop_and_release(names)
+        _audit_pool_bookkeeping(api)
+
+    hits = misses = bypass = 0
+    for pool in api.list(C.WARMPOOL_KIND):
+        st = pool.body.get("status") or {}
+        hits += int(st.get("hits", 0))
+        misses += int(st.get("misses", 0))
+        bypass += int(st.get("bypass", 0))
+    served = hits + misses + bypass
+    values = list(ready_s.values())
+    mgr.stop()
+    return {
+        "mode": "warm" if warm_size else "cold",
+        "notebooks": count * bursts,
+        "bursts": bursts,
+        "warm_size": warm_size,
+        "failover": failover,
+        "hits": hits,
+        "misses": misses,
+        "bypass": bypass,
+        "hit_rate": round(hits / served, 3) if served else 0.0,
+        "ready_p50_s": round(_percentile(values, 0.50), 3),
+        "ready_p99_s": round(_percentile(values, 0.99), 3),
+        "ready_max_s": round(max(values), 3) if values else 0.0,
+        "slice_utilization": utilization,
+        "ready_histogram_count":
+            metrics.notebook_ready_seconds.count_value(NAMESPACE),
+    }
+
+
+def check_warm_budget(warm: dict, cold: dict, budget: dict) -> list[str]:
+    """CI gate over the warm-vs-cold comparison: warm-pool-on p50 ready
+    time strictly below the cold path, a minimum warm hit rate, and a
+    minimum converged slice utilization."""
+    failures = []
+    if not warm["ready_p50_s"] < cold["ready_p50_s"]:
+        failures.append(
+            f"warm p50 {warm['ready_p50_s']}s not strictly below cold p50 "
+            f"{cold['ready_p50_s']}s")
+    max_frac = budget.get("max_warm_p50_fraction_of_cold")
+    if max_frac is not None and cold["ready_p50_s"] > 0 and \
+            warm["ready_p50_s"] > cold["ready_p50_s"] * max_frac:
+        failures.append(
+            f"warm p50 {warm['ready_p50_s']}s above "
+            f"{max_frac:.0%} of cold p50 {cold['ready_p50_s']}s")
+    min_hit = budget.get("min_hit_rate")
+    if min_hit is not None and warm["hit_rate"] < min_hit:
+        failures.append(
+            f"warm hit rate {warm['hit_rate']} < {min_hit}")
+    min_util = budget.get("min_slice_utilization")
+    if min_util is not None:
+        worst = min(warm["slice_utilization"] or [0.0])
+        if worst < min_util:
+            failures.append(
+                f"converged slice utilization {worst} < {min_util}")
+    return failures
+
+
 def check_budget(result: dict, budget: dict) -> list[str]:
     """Failures (empty = within budget).  A measurement may regress at
     most `tolerance` (fraction) over the committed per-notebook budget."""
@@ -248,7 +507,39 @@ def main(argv=None) -> int:
                         help="budget JSON; fail on >tolerance regression")
     parser.add_argument("--write-budget", default="",
                         help="write the measured result as the new budget")
+    parser.add_argument("--bursty", type=int, default=0, metavar="N",
+                        help="bursty slice-scheduler mode: N TPU notebooks "
+                        "per wave, warm-pool-on vs off comparison")
+    parser.add_argument("--bursts", type=int, default=3)
+    parser.add_argument("--burst-gap-s", type=float, default=300.0)
+    parser.add_argument("--warm-size", type=int, default=8,
+                        help="warm-pool base target for the warm run")
+    parser.add_argument("--provision-s", type=float, default=120.0,
+                        help="modeled cold slice-provision latency")
+    parser.add_argument("--check-warm-budget", default="",
+                        help="warm-vs-cold budget JSON (min hit rate, p50 "
+                        "ratio); fail on regression")
     args = parser.parse_args(argv)
+
+    if args.bursty:
+        tpu = args.tpu or "v5e:4x4"
+        warm = run_bursty(args.bursty, args.bursts, args.burst_gap_s, tpu,
+                          warm_size=args.warm_size,
+                          provision_s=args.provision_s)
+        cold = run_bursty(args.bursty, args.bursts, args.burst_gap_s, tpu,
+                          warm_size=0, provision_s=args.provision_s)
+        out = {"tpu": tpu, "warm": warm, "cold": cold}
+        rc = 0
+        budget = {}
+        if args.check_warm_budget:
+            budget = json.loads(Path(args.check_warm_budget).read_text())
+        failures = check_warm_budget(warm, cold, budget)
+        out["warm_budget_ok"] = not failures
+        for f in failures:
+            print(f"WARM BUDGET FAIL: {f}", file=sys.stderr)
+            rc = 1
+        print(json.dumps(out))
+        return rc
 
     result = run_fleet(args.count, args.workers, tpu=args.tpu)
     state = result.pop("_state")

@@ -1,0 +1,565 @@
+"""Slice scheduler + warm pool (core/scheduler.py) and the FakeCluster
+scheduling satellites: cost-function placement properties (gang atomicity,
+co-location, spread), gang-gated rendering, warm-pool claim/release across
+a manager failover, culling->reclamation, the hit-rate autoscaler, the
+cordon->uncordon retry regression, and the incremental used-resources map
+equivalence."""
+
+from __future__ import annotations
+
+import json
+import random
+import unittest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.core.scheduler import (
+    CostFunctionPolicy,
+    NodeCapacity,
+    SliceScheduler,
+    parse_warmpool_shapes,
+    placement_covers,
+    placement_of,
+    pool_object_name,
+)
+from kubeflow_tpu.core.workload import generate_statefulsets
+from kubeflow_tpu.kube import (
+    ApiServer,
+    FakeCluster,
+    KubeObject,
+    Manager,
+    ObjectMeta,
+)
+from kubeflow_tpu.tpu.topology import resolve
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+V5E_4X4 = resolve("v5e", "4x4")      # 4 hosts x 4 chips
+V5E_1X1 = resolve("v5e", "1x1")      # single host, 1 chip
+SPEC = TPUSpec("v5e", "4x4")
+POOL_NAME = pool_object_name("v5e", "4x4")
+
+
+def scheduler_env(warm_size=0, shapes="", provision_s=120.0, extra=None):
+    env = {
+        "ENABLE_SLICE_SCHEDULER": "true",
+        "WARMPOOL_SIZE": str(warm_size),
+        "WARMPOOL_SHAPES": shapes,
+        "WARMPOOL_PROVISION_S": f"{provision_s:g}",
+    }
+    env.update(extra or {})
+    return CoreConfig.from_env(env)
+
+
+def make_env(cfg=None, provisioner=True):
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    clock = FakeClock()
+    mgr = Manager(api, clock=clock)
+    cfg = cfg or scheduler_env()
+    metrics = NotebookMetrics(api, manager=mgr)
+    setup_core_controllers(mgr, cfg, metrics,
+                           provisioner=cluster if provisioner else None)
+    return api, cluster, clock, mgr, metrics
+
+
+def pool_status(api):
+    obj = api.try_get(C.WARMPOOL_KIND, "", POOL_NAME)
+    return (obj.body.get("status") or {}) if obj is not None else {}
+
+
+def stop_notebook(api, namespace, name):
+    live = api.get("Notebook", namespace, name)
+    live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+    api.update(live)
+
+
+# -- placement policy ----------------------------------------------------------
+class TestCostFunctionPolicy(unittest.TestCase):
+    def _nodes(self, pool, n, free, total=4.0):
+        return [NodeCapacity(f"{pool}-{i}", pool, free, total)
+                for i in range(n)]
+
+    def test_multi_host_packs_best_fit_pool(self):
+        # pool-a fits exactly; pool-b leaves slack — best-fit picks a
+        nodes = self._nodes("pool-a", 4, 4.0) + self._nodes("pool-b", 6, 4.0)
+        gp = CostFunctionPolicy().place(V5E_4X4, nodes)
+        self.assertIsNotNone(gp)
+        self.assertEqual(gp.pool, "pool-a")
+        self.assertEqual(len(gp.nodes), 4)
+
+    def test_multi_host_never_partial(self):
+        # neither pool alone fits the 4-host gang: placement must refuse
+        # outright, not scatter workers across pools
+        nodes = self._nodes("pool-a", 3, 4.0) + self._nodes("pool-b", 2, 4.0)
+        self.assertIsNone(CostFunctionPolicy().place(V5E_4X4, nodes))
+
+    def test_multi_host_skips_full_nodes(self):
+        nodes = self._nodes("pool-a", 4, 4.0)
+        nodes[0] = NodeCapacity("pool-a-0", "pool-a", 0.0, 4.0)
+        self.assertIsNone(CostFunctionPolicy().place(V5E_4X4, nodes))
+
+    def test_single_host_spreads(self):
+        nodes = [NodeCapacity("n-0", "p", 1.0, 8.0),
+                 NodeCapacity("n-1", "p", 7.0, 8.0),
+                 NodeCapacity("n-2", "p", 3.0, 8.0)]
+        gp = CostFunctionPolicy().place(V5E_1X1, nodes)
+        self.assertEqual(gp.nodes, ("n-1",))  # most free chips wins
+
+    def test_deterministic(self):
+        rng = random.Random(7)
+        nodes = [
+            NodeCapacity(f"n-{i}", f"pool-{i % 5}",
+                         float(rng.randint(0, 4)), 4.0)
+            for i in range(40)
+        ]
+        policy = CostFunctionPolicy()
+        first = policy.place(V5E_4X4, list(nodes))
+        for _ in range(5):
+            rng.shuffle(nodes)
+            self.assertEqual(policy.place(V5E_4X4, list(nodes)), first)
+
+    def test_property_gang_atomicity_and_colocation(self):
+        """Randomized inventories: a returned placement is always a full
+        co-located gang on fitting nodes; None only when genuinely no pool
+        fits the whole gang."""
+        policy = CostFunctionPolicy()
+        for seed in range(200):
+            rng = random.Random(seed)
+            shape = resolve("v5e", rng.choice(["4x4", "4x8", "1x1", "2x2"]))
+            nodes = [
+                NodeCapacity(f"n-{i:02d}", f"pool-{rng.randint(0, 3)}",
+                             float(rng.randint(0, 8)), 8.0)
+                for i in range(rng.randint(0, 24))
+            ]
+            gp = policy.place(shape, nodes)
+            by_name = {n.name: n for n in nodes}
+            if gp is not None:
+                self.assertEqual(len(gp.nodes), shape.num_hosts)
+                self.assertEqual(len(set(gp.nodes)), shape.num_hosts)
+                for name in gp.nodes:
+                    self.assertEqual(by_name[name].pool, gp.pool)
+                    self.assertGreaterEqual(by_name[name].free_chips,
+                                            shape.chips_per_host)
+            else:
+                by_pool: dict[str, int] = {}
+                for n in nodes:
+                    if n.free_chips >= shape.chips_per_host:
+                        by_pool[n.pool] = by_pool.get(n.pool, 0) + 1
+                self.assertFalse(
+                    any(k >= shape.num_hosts for k in by_pool.values()),
+                    f"seed {seed}: a feasible pool was refused")
+
+
+class TestParseShapes(unittest.TestCase):
+    def test_parse(self):
+        self.assertEqual(parse_warmpool_shapes("v5e:4x4, v5p:2x2x2"),
+                         [("v5e", "4x4"), ("v5p", "2x2x2")])
+
+    def test_malformed_skipped(self):
+        self.assertEqual(
+            parse_warmpool_shapes("v5e:4x4,nope,v9:1x1,v5e:4x4,:,x:"),
+            [("v5e", "4x4")])
+
+
+# -- gang gate + rendering -----------------------------------------------------
+class TestGangGate(unittest.TestCase):
+    def test_no_statefulset_until_placed(self):
+        """The placement intent is written BEFORE any pod binds: while the
+        cold provision is pending, zero StatefulSets exist and the status
+        reads Scheduling — never a partially placed slice."""
+        api, cluster, clock, mgr, _ = make_env(
+            cfg=scheduler_env(provision_s=60.0))
+        api.create(Notebook.new("nb", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()
+        self.assertEqual(api.list("StatefulSet", namespace="default"), [])
+        nb = api.get("Notebook", "default", "nb")
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Scheduling")
+        self.assertNotIn(C.ANNOTATION_PLACEMENT, nb.metadata.annotations)
+        # provision completes -> intent lands -> the whole gang binds
+        mgr.advance(60.0)
+        mgr.run_until_idle()
+        nb = api.get("Notebook", "default", "nb")
+        self.assertTrue(placement_covers(Notebook(nb), 1))
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Healthy")
+        pods = [p for p in api.list("Pod", namespace="default")
+                if p.spec.get("nodeName")]
+        self.assertEqual(len(pods), V5E_4X4.num_hosts)
+        pools = {
+            api.get("Node", "", p.spec["nodeName"])
+            .metadata.labels.get(C.GKE_NODEPOOL_LABEL)
+            for p in pods
+        }
+        self.assertEqual(len(pools), 1)
+
+    def test_placement_renders_nodeselector(self):
+        nb = Notebook.new("nb", "default", tpu=SPEC)
+        nb.metadata.annotations[C.ANNOTATION_PLACEMENT] = json.dumps(
+            {"v": 1, "slices": {"0": {"pool": "pool-x"}}})
+        sts = generate_statefulsets(nb, CoreConfig())[0]
+        selector = sts.spec["template"]["spec"]["nodeSelector"]
+        self.assertEqual(selector[C.GKE_NODEPOOL_LABEL], "pool-x")
+        self.assertEqual(selector[C.GKE_TPU_ACCELERATOR_LABEL],
+                         V5E_4X4.accelerator.gke_label)
+
+    def test_placement_helpers_tolerate_garbage(self):
+        self.assertEqual(placement_of({}), {})
+        self.assertEqual(
+            placement_of({C.ANNOTATION_PLACEMENT: "not-json"}), {})
+        self.assertEqual(
+            placement_of({C.ANNOTATION_PLACEMENT: "[1,2]"}), {})
+        nb = Notebook.new("nb", "default", tpu=TPUSpec("v5e", "4x4", 2))
+        nb.metadata.annotations[C.ANNOTATION_PLACEMENT] = json.dumps(
+            {"v": 1, "slices": {"0": {"pool": "p"}}})
+        self.assertFalse(placement_covers(nb, 2))  # slice 1 missing
+
+    def test_bypass_places_on_preexisting_capacity(self):
+        """Pre-existing (unmanaged) node pools are claimed through the
+        cost-function bypass path: no warm pool, no provision delay."""
+        api, cluster, clock, mgr, metrics = make_env()
+        cluster.add_tpu_slice_nodes(
+            V5E_4X4.accelerator.gke_label, "4x4", 4, 4, name_prefix="ext")
+        api.create(Notebook.new("nb", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()  # no clock advance: placement must be instant
+        nb = api.get("Notebook", "default", "nb")
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Healthy")
+        st = pool_status(api)
+        self.assertEqual(st["bypass"], 1)
+        (entry,) = [e for e in st["slices"].values() if e.get("external")]
+        self.assertEqual(entry["claimedBy"], "default/nb")
+        self.assertEqual(metrics.warmpool_hits.value("bypass"), 1.0)
+
+
+# -- warm pool: claim, failover, reclamation, autoscaler -----------------------
+class TestWarmPool(unittest.TestCase):
+    def _prewarmed(self, warm_size=2):
+        cfg = scheduler_env(warm_size=warm_size, shapes="v5e:4x4")
+        api, cluster, clock, mgr, metrics = make_env(cfg=cfg)
+        mgr.settle(max_seconds=600.0)
+        st = pool_status(api)
+        self.assertEqual(
+            [e["state"] for e in st["slices"].values()],
+            ["Ready"] * warm_size)
+        return api, cluster, clock, mgr, metrics, cfg
+
+    def test_warm_claim_is_instant(self):
+        api, cluster, clock, mgr, metrics, _ = self._prewarmed()
+        t0 = clock.now()
+        api.create(Notebook.new("nb", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()  # NO advance: a warm hit needs no fake time
+        nb = api.get("Notebook", "default", "nb")
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Healthy")
+        self.assertEqual(clock.now(), t0)
+        self.assertEqual(pool_status(api)["hits"], 1)
+        self.assertEqual(metrics.warmpool_hits.value("hit"), 1.0)
+
+    def test_claim_release_idempotent_across_failover(self):
+        """Pool bookkeeping lives on the API object: a fresh manager over
+        the same store adopts the claims verbatim (no re-claim, no double
+        accounting), and release still works post-failover."""
+        api, cluster, clock, mgr, metrics, cfg = self._prewarmed()
+        api.create(Notebook.new("nb", "default", tpu=SPEC).obj)
+        mgr.settle(max_seconds=600.0)
+        before = pool_status(api)
+        annotation_before = api.get(
+            "Notebook", "default", "nb").metadata.annotations[
+                C.ANNOTATION_PLACEMENT]
+        mgr.stop()
+        # failover: new manager + controllers, same store and clock
+        mgr2 = Manager(api, clock=clock)
+        metrics2 = NotebookMetrics(api, manager=mgr2)
+        setup_core_controllers(mgr2, cfg, metrics2, provisioner=cluster)
+        mgr2.enqueue_all()
+        mgr2.settle(max_seconds=600.0)
+        after = pool_status(api)
+        self.assertEqual(before["hits"], after["hits"])
+        self.assertEqual(before["misses"], after["misses"])
+        self.assertEqual(
+            {sid: e.get("claimedBy") for sid, e in before["slices"].items()},
+            {sid: e.get("claimedBy") for sid, e in after["slices"].items()})
+        self.assertEqual(
+            api.get("Notebook", "default", "nb")
+            .metadata.annotations[C.ANNOTATION_PLACEMENT],
+            annotation_before)
+        # release through the NEW manager: claims made by the old one drain
+        stop_notebook(api, "default", "nb")
+        mgr2.settle(max_seconds=600.0)
+        released = pool_status(api)
+        self.assertFalse(any(e.get("claimedBy")
+                             for e in released["slices"].values()))
+        self.assertNotIn(
+            C.ANNOTATION_PLACEMENT,
+            api.get("Notebook", "default", "nb").metadata.annotations)
+
+    def test_culling_reclamation_resells_the_slice(self):
+        """A stopped notebook's slice drains back Ready with its nodes
+        intact, and the next notebook claims the SAME slice as a hit."""
+        api, cluster, clock, mgr, metrics, _ = self._prewarmed(warm_size=1)
+        api.create(Notebook.new("first", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()
+        claimed = {sid for sid, e in pool_status(api)["slices"].items()
+                   if e.get("claimedBy") == "default/first"}
+        self.assertEqual(len(claimed), 1)
+        stop_notebook(api, "default", "first")
+        mgr.settle(max_seconds=600.0)
+        st = pool_status(api)
+        sid = claimed.pop()
+        self.assertEqual(st["slices"][sid]["state"], "Ready")
+        nodes_before = st["slices"][sid]["nodes"]
+        for n in nodes_before:  # capacity stayed provisioned (resold)
+            self.assertIsNotNone(api.try_get("Node", "", n))
+        api.create(Notebook.new("second", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()
+        st = pool_status(api)
+        self.assertEqual(st["slices"][sid]["claimedBy"], "default/second")
+        self.assertEqual(
+            api.get("Notebook", "default", "second")
+            .body["status"]["sliceHealth"], "Healthy")
+
+    def test_release_waits_for_checkpoint_on_cull(self):
+        """Reclamation precedence: while the slice still reads Stopping
+        (workers draining — a pre-cull checkpoint may be flushing), the
+        claim and the intent stay put; only Stopped releases."""
+        api = ApiServer()
+        clock = FakeClock()
+        cfg = scheduler_env()
+        metrics = NotebookMetrics(api)
+        sched = SliceScheduler(api, cfg, metrics, clock=clock)
+        nb = Notebook.new("nb", "default", tpu=SPEC,
+                          annotations={C.STOP_ANNOTATION: "true"})
+        nb.metadata.annotations[C.ANNOTATION_PLACEMENT] = json.dumps(
+            {"v": 1, "slices": {"0": {"pool": "warm-x"}}})
+        api.create(nb.obj)
+        api.create(KubeObject(
+            api_version="kubeflow.org/v1", kind=C.WARMPOOL_KIND,
+            metadata=ObjectMeta(name=POOL_NAME),
+            body={"spec": {"accelerator": "v5e", "topology": "4x4"},
+                  "status": {"slices": {"ws-0001": {
+                      "state": "Claimed", "pool": "warm-x",
+                      "claimedBy": "default/nb", "claimedSlice": 0}}}}))
+        from kubeflow_tpu.kube import Request
+
+        for health in ("Stopping", "Degraded"):
+            live = api.get("Notebook", "default", "nb")
+            live.status = {"sliceHealth": health}
+            api.update_status(live)
+            sched.reconcile(Request("default", "nb"))
+            st = pool_status(api)
+            self.assertEqual(st["slices"]["ws-0001"]["claimedBy"],
+                             "default/nb", health)
+            self.assertIn(
+                C.ANNOTATION_PLACEMENT,
+                api.get("Notebook", "default", "nb").metadata.annotations)
+        live = api.get("Notebook", "default", "nb")
+        live.status = {"sliceHealth": "Stopped"}
+        api.update_status(live)
+        sched.reconcile(Request("default", "nb"))
+        self.assertIsNone(
+            pool_status(api)["slices"]["ws-0001"].get("claimedBy"))
+        self.assertNotIn(
+            C.ANNOTATION_PLACEMENT,
+            api.get("Notebook", "default", "nb").metadata.annotations)
+
+    def test_orphan_claim_gc_on_notebook_delete(self):
+        api, cluster, clock, mgr, metrics, _ = self._prewarmed(warm_size=1)
+        api.create(Notebook.new("nb", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()
+        self.assertTrue(any(e.get("claimedBy") == "default/nb"
+                            for e in pool_status(api)["slices"].values()))
+        api.delete("Notebook", "default", "nb")
+        mgr.settle(max_seconds=600.0)
+        self.assertFalse(any(e.get("claimedBy")
+                             for e in pool_status(api)["slices"].values()))
+
+    def test_autoscaler_grows_on_misses_and_decays_back(self):
+        cfg = scheduler_env(warm_size=1, shapes="v5e:4x4",
+                            extra={"WARMPOOL_DECAY_S": "60"})
+        api, cluster, clock, mgr, metrics = make_env(cfg=cfg)
+        mgr.settle(max_seconds=600.0)
+        # 3 arrivals vs pool of 1: 1 hit + 2 misses -> target grows to 3
+        for i in range(3):
+            api.create(Notebook.new(f"nb-{i}", "default", tpu=SPEC).obj)
+        mgr.run_until_idle()  # growth is immediate (event-driven)
+        st = pool_status(api)
+        self.assertEqual((st["hits"], st["misses"]), (1, 2))
+        self.assertEqual(st["target"], 3)
+        mgr.settle(max_seconds=1200.0)
+        # stop everything: slices drain back idle; with zero misses across
+        # the cooldown the target decays one step per WARMPOOL_DECAY_S all
+        # the way back to the base, retiring the idle excess
+        for i in range(3):
+            stop_notebook(api, "default", f"nb-{i}")
+        mgr.settle(max_seconds=1200.0)
+        st = pool_status(api)
+        self.assertEqual(st["target"], 1)
+        idle = [e for e in st["slices"].values()
+                if e.get("state") == "Ready" and not e.get("claimedBy")]
+        self.assertEqual(len(idle), 1)
+
+    def test_autoscaler_growth_bounded_by_max(self):
+        cfg = scheduler_env(warm_size=1, shapes="v5e:4x4",
+                            extra={"WARMPOOL_MAX_SIZE": "2"})
+        api, cluster, clock, mgr, metrics = make_env(cfg=cfg)
+        mgr.settle(max_seconds=600.0)
+        for i in range(6):
+            api.create(Notebook.new(f"nb-{i}", "default", tpu=SPEC).obj)
+        mgr.settle(max_seconds=1200.0)
+        self.assertLessEqual(pool_status(api)["target"], 2)
+
+    def test_unmanaged_shape_retires_released_capacity(self):
+        """Warm pool off for the shape: a released slice is torn back down
+        (the cold path) instead of idling warm."""
+        api, cluster, clock, mgr, metrics = make_env()  # no WARMPOOL_SHAPES
+        api.create(Notebook.new("nb", "default", tpu=SPEC).obj)
+        mgr.settle(max_seconds=600.0)
+        nodes = [n.name for n in api.list("Node")]
+        self.assertEqual(len(nodes), V5E_4X4.num_hosts)
+        stop_notebook(api, "default", "nb")
+        mgr.settle(max_seconds=600.0)
+        self.assertEqual(pool_status(api).get("slices"), {})
+        self.assertEqual([n.name for n in api.list("Node")], [])
+
+    def test_warmpool_size_gauge_in_scrape(self):
+        api, cluster, clock, mgr, metrics, _ = self._prewarmed(warm_size=2)
+        body = metrics.scrape()
+        self.assertIn(
+            'notebook_warmpool_size{shape="v5e-4x4",state="Ready"} 2', body)
+        self.assertIn(
+            'notebook_warmpool_size{shape="v5e-4x4",state="Claimed"} 0',
+            body)
+        self.assertIn("notebook_schedule_attempts_total", body)
+
+
+# -- FakeCluster satellites ----------------------------------------------------
+class TestUncordonRetry(unittest.TestCase):
+    def test_cordon_uncordon_reschedules_pending_pods(self):
+        """Regression (satellite): pods left Pending by a cordon must be
+        retried the moment the node is uncordoned — not whenever an
+        unrelated node/capacity event happens by."""
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("n1", allocatable={"cpu": "8", "memory": "32Gi"})
+        cluster.cordon_node("n1")
+        sts = KubeObject(
+            api_version="apps/v1", kind="StatefulSet",
+            metadata=ObjectMeta(name="s", namespace="d"),
+            body={"spec": {"replicas": 1, "template": {
+                "spec": {"containers": [{"name": "c"}]}}}})
+        api.create(sts)
+        pod = api.get("Pod", "d", "s-0")
+        self.assertEqual(pod.body["status"]["phase"], "Pending")
+        self.assertFalse(pod.spec.get("nodeName"))
+        cluster.uncordon_node("n1")
+        pod = api.get("Pod", "d", "s-0")
+        self.assertEqual(pod.spec.get("nodeName"), "n1")
+        self.assertEqual(pod.body["status"]["phase"], "Running")
+
+    def test_uncordon_of_unknown_or_uncordoned_node_is_noop(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.uncordon_node("ghost")  # must not raise
+        cluster.add_node("n1")
+        cluster.uncordon_node("n1")
+
+
+class TestIncrementalUsedMap(unittest.TestCase):
+    """Satellite: FakeCluster._schedule reads an incrementally-maintained
+    per-node used map instead of re-summing every pod per candidate node;
+    the map must stay equivalent to the brute-force recount through any
+    sequence of binds/deletes/rebinds."""
+
+    @staticmethod
+    def _brute_force(api, node_name):
+        used: dict[str, float] = {}
+        from kubeflow_tpu.kube import parse_quantity
+
+        for p in api.list("Pod"):
+            if p.spec.get("nodeName") != node_name:
+                continue
+            for c in p.spec.get("containers", []):
+                for res, q in (c.get("resources", {})
+                               .get("requests") or {}).items():
+                    used[res] = used.get(res, 0.0) + parse_quantity(q)
+        return used
+
+    def _assert_equivalent(self, api, cluster, nodes):
+        for name in nodes:
+            self.assertEqual(cluster.node_used(name),
+                             self._brute_force(api, name), name)
+
+    def test_randomized_equivalence(self):
+        rng = random.Random(20260804)
+        api = ApiServer()
+        cluster = FakeCluster(api, auto_ready=False)
+        node_names = [f"n{i}" for i in range(4)]
+        for name in node_names:
+            cluster.add_node(name, allocatable={"cpu": "64",
+                                                "memory": "256Gi",
+                                                "google.com/tpu": "8"})
+        live: list[str] = []
+        counter = 0
+        for step in range(300):
+            op = rng.random()
+            if op < 0.5 or not live:
+                counter += 1
+                name = f"p{counter}"
+                res = rng.choice([{"cpu": "1"}, {"google.com/tpu": "4"},
+                                  {"cpu": "2", "memory": "1Gi"}, {}])
+                pod = KubeObject(
+                    api_version="v1", kind="Pod",
+                    metadata=ObjectMeta(name=name, namespace="d"),
+                    body={"spec": {
+                        "containers": [{"name": "c",
+                                        "resources": {"requests": res}}]}})
+                if rng.random() < 0.7:
+                    pod.spec["nodeName"] = rng.choice(node_names)
+                api.create(pod)
+                live.append(name)
+            elif op < 0.75:
+                name = rng.choice(live)
+                pod = api.get("Pod", "d", name)
+                pod.spec["nodeName"] = rng.choice(node_names)
+                api.update(pod)
+            else:
+                name = live.pop(rng.randrange(len(live)))
+                api.delete("Pod", "d", name)
+            if step % 10 == 0:
+                self._assert_equivalent(api, cluster, node_names)
+        self._assert_equivalent(api, cluster, node_names)
+
+    def test_scheduler_respects_incremental_capacity(self):
+        """End-to-end: binding through the kubelet path keeps capacity
+        accounting exact — the third 4-chip pod that would overflow the
+        8-chip node goes Pending."""
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("tpu-a", labels={
+            C.GKE_TPU_ACCELERATOR_LABEL: "acc",
+            C.GKE_TPU_TOPOLOGY_LABEL: "t"},
+            allocatable={"cpu": "8", "memory": "8Gi", "google.com/tpu": "8"})
+        for i in range(3):
+            sts = KubeObject(
+                api_version="apps/v1", kind="StatefulSet",
+                metadata=ObjectMeta(name=f"s{i}", namespace="d"),
+                body={"spec": {"replicas": 1, "template": {"spec": {
+                    "nodeSelector": {C.GKE_TPU_ACCELERATOR_LABEL: "acc",
+                                     C.GKE_TPU_TOPOLOGY_LABEL: "t"},
+                    "containers": [{"name": "c", "resources": {
+                        "requests": {"google.com/tpu": "4"}}}]}}}})
+            api.create(sts)
+        phases = sorted(
+            p.body["status"]["phase"] for p in api.list("Pod", namespace="d"))
+        self.assertEqual(phases, ["Pending", "Running", "Running"])
+        self.assertEqual(cluster.node_used("tpu-a")["google.com/tpu"], 8.0)
+        # freeing one slot lets exactly the pending pod in
+        running = [p.name for p in api.list("Pod", namespace="d")
+                   if p.body["status"]["phase"] == "Running"]
+        api.delete("Pod", "d", running[0])
+        api.delete("StatefulSet", "d", running[0][:-2])
+        self.assertEqual(cluster.node_used("tpu-a")["google.com/tpu"], 8.0)
+
+
+if __name__ == "__main__":
+    unittest.main()
