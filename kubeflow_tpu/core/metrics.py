@@ -161,6 +161,9 @@ class NotebookMetrics:
         # last snapshot of the manager's cumulative totals, so each scrape
         # feeds the counters exactly the delta since the previous scrape
         self._counter_snapshots: dict[tuple[str, str], float] = {}
+        # shape labels emitted by the last warm-pool census — a deleted
+        # pool's series must be driven to 0, not left at its last value
+        self._warmpool_shapes: set[str] = set()
 
     def attach_manager(self, manager) -> None:
         self.manager = manager
@@ -217,9 +220,11 @@ class NotebookMetrics:
             pools = self.api.list(C.WARMPOOL_KIND)
         except Exception:  # noqa: BLE001 — a real-cluster backend without
             pools = []     # the CRD must not break the scrape
+        seen_shapes: set[str] = set()
         for pool in pools:
             shape = "%s-%s" % (pool.spec.get("accelerator", ""),
                                pool.spec.get("topology", ""))
+            seen_shapes.add(shape)
             counts = {state: 0 for state in C.WARMSLICE_STATES}
             for e in (pool.body.get("status", {}).get("slices")
                       or {}).values():
@@ -230,6 +235,12 @@ class NotebookMetrics:
                     counts[state] += 1
             for state, n in counts.items():
                 self.warmpool_size.labels(shape, state).set(n)
+        # a TPUWarmPool deleted between scrapes would otherwise leave its
+        # shape's series frozen at the last census — drive them to 0
+        for shape in self._warmpool_shapes - seen_shapes:
+            for state in C.WARMSLICE_STATES:
+                self.warmpool_size.labels(shape, state).set(0)
+        self._warmpool_shapes = seen_shapes
         if self.manager is not None:
             stats = self.manager.queue_stats()
             for name in stats["controllers"]:

@@ -345,7 +345,7 @@ class SliceScheduler:
                 # bypass: cost-function placement on pre-existing capacity
                 # outside any warm pool
                 gp = self.policy.place(
-                    shape, self._inventory(nb, shape, st))
+                    shape, self._inventory(shape, st))
                 if gp is not None:
                     st["seq"] += 1
                     sid = f"ws-{st['seq']:04d}"
@@ -508,15 +508,17 @@ class SliceScheduler:
         e.pop("claimedSlice", None)
 
     # -- capacity inventory ----------------------------------------------------
-    def _inventory(self, nb: Notebook, shape: SliceShape,
+    def _inventory(self, shape: SliceShape,
                    pool_status: dict) -> list[NodeCapacity]:
         """Schedulable capacity for bypass placement: nodes matching the
         shape's accelerator/topology labels, grouped by node pool, with
-        free chips net of bound pods AND standing reservations (other
-        notebooks' pool entries whose pods have not bound yet).  Nodes
-        owned by any warm pool are excluded — warm capacity moves only
-        through claims."""
-        key = f"{nb.namespace}/{nb.name}"
+        free chips net of bound pods AND standing reservations — every
+        claimed pool entry whose pods have not bound yet, INCLUDING the
+        claiming notebook's own entries: during one _place pass over a
+        multi-slice gang, slice N must see slice N-1's assignment as
+        taken or the gang double-books the same nodes.  Nodes owned by
+        any warm pool are excluded — warm capacity moves only through
+        claims."""
         reader = self.cache if self.cache is not None else self.api
         warm_pools: set[str] = set()
         reservations: dict[str, float] = {}
@@ -552,8 +554,6 @@ class SliceScheduler:
                 if not e.get("external"):
                     warm_pools.add(e.get("pool", ""))
                 claimant = e.get("claimedBy", "")
-                if claimant == key:
-                    continue
                 for node in e.get("nodes") or []:
                     already = bound_by_nb.get((node, claimant), 0.0) \
                         if claimant else 0.0

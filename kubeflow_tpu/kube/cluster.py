@@ -172,11 +172,16 @@ class FakeCluster:
         return names
 
     def deprovision_slice(self, pool: str) -> None:
-        """Tear a warm slice's node set back down (autoscaler shrink)."""
+        """Tear a warm slice's node set back down (autoscaler shrink).
+        Nodes still carrying bound pods are left standing: callers only
+        retire idle slices, but a shared/user-created pool label must
+        never let a teardown yank nodes out from under running pods (and
+        silently wreck their used-resources accounting)."""
         with self.api.fault_exempt():
             doomed = [
                 n.name for n in self.api.list("Node")
                 if n.metadata.labels.get(_GKE_NODEPOOL_LABEL) == pool
+                and not self._node_used.get(n.name)
             ]
             for name in doomed:
                 try:

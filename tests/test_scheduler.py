@@ -215,6 +215,33 @@ class TestGangGate(unittest.TestCase):
             {"v": 1, "slices": {"0": {"pool": "p"}}})
         self.assertFalse(placement_covers(nb, 2))  # slice 1 missing
 
+    def test_multi_slice_bypass_never_double_books_nodes(self):
+        """Regression: placing slice N of a gang must see the capacity
+        claimed for slices 0..N-1 of the SAME notebook in the same pass
+        as taken.  Two exact-fit pools + a 2-slice notebook used to land
+        both slices on one pool (same node list twice — half the pods
+        bound, notebook wedged Degraded while the other pool sat idle)."""
+        api, cluster, clock, mgr, metrics = make_env()
+        for prefix in ("ext-a", "ext-b"):
+            cluster.add_tpu_slice_nodes(
+                V5E_4X4.accelerator.gke_label, "4x4", 4, 4,
+                name_prefix=prefix)
+        api.create(Notebook.new(
+            "nb", "default", tpu=TPUSpec("v5e", "4x4", 2)).obj)
+        mgr.run_until_idle()  # bypass placement: no fake time needed
+        nb = api.get("Notebook", "default", "nb")
+        slices = placement_of(nb.metadata.annotations)
+        self.assertEqual(len(slices), 2)
+        self.assertNotEqual(slices["0"]["pool"], slices["1"]["pool"])
+        self.assertFalse(
+            set(slices["0"]["nodes"]) & set(slices["1"]["nodes"]))
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Healthy")
+        bound = [p.spec["nodeName"]
+                 for p in api.list("Pod", namespace="default")
+                 if p.spec.get("nodeName")]
+        self.assertEqual(len(bound), 2 * V5E_4X4.num_hosts)
+        self.assertEqual(len(set(bound)), 2 * V5E_4X4.num_hosts)
+
     def test_bypass_places_on_preexisting_capacity(self):
         """Pre-existing (unmanaged) node pools are claimed through the
         cost-function bypass path: no warm pool, no provision delay."""
@@ -431,6 +458,18 @@ class TestWarmPool(unittest.TestCase):
             body)
         self.assertIn("notebook_schedule_attempts_total", body)
 
+    def test_warmpool_size_gauge_zeroes_after_pool_delete(self):
+        """A deleted TPUWarmPool's shape series must read 0 on the next
+        scrape, not freeze at its last non-zero census."""
+        api, cluster, clock, mgr, metrics, _ = self._prewarmed(warm_size=2)
+        self.assertIn(
+            'notebook_warmpool_size{shape="v5e-4x4",state="Ready"} 2',
+            metrics.scrape())
+        api.delete(C.WARMPOOL_KIND, "", POOL_NAME)
+        self.assertIn(
+            'notebook_warmpool_size{shape="v5e-4x4",state="Ready"} 0',
+            metrics.scrape())
+
 
 # -- FakeCluster satellites ----------------------------------------------------
 class TestUncordonRetry(unittest.TestCase):
@@ -462,6 +501,34 @@ class TestUncordonRetry(unittest.TestCase):
         cluster.uncordon_node("ghost")  # must not raise
         cluster.add_node("n1")
         cluster.uncordon_node("n1")
+
+
+class TestDeprovisionGuard(unittest.TestCase):
+    def test_deprovision_skips_nodes_with_bound_pods(self):
+        """deprovision_slice keys off the nodepool label alone; a node in
+        the doomed pool that still hosts bound pods (a user-created pool
+        sharing the label) must survive the teardown."""
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        for i in range(2):
+            cluster.add_node(
+                f"shared-{i}",
+                labels={C.GKE_NODEPOOL_LABEL: "shared"},
+                allocatable={"cpu": "8", "google.com/tpu": "4"})
+        pod = KubeObject(
+            api_version="v1", kind="Pod",
+            metadata=ObjectMeta(name="p", namespace="d"),
+            body={"spec": {
+                "nodeName": "shared-0",
+                "containers": [{"name": "c", "resources": {
+                    "requests": {"google.com/tpu": "4"}}}]}})
+        api.create(pod)
+        cluster.deprovision_slice("shared")
+        self.assertEqual([n.name for n in api.list("Node")], ["shared-0"])
+        # once the pod is gone the node is reclaimable again
+        api.delete("Pod", "d", "p")
+        cluster.deprovision_slice("shared")
+        self.assertEqual(api.list("Node"), [])
 
 
 class TestIncrementalUsedMap(unittest.TestCase):
