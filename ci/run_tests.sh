@@ -91,4 +91,16 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   echo "== loadtest bursty warm-pool smoke =="
   python loadtest/convergence.py --bursty 24 --bursts 3 --warm-size 8 \
     --tpu v5e:4x4 --check-warm-budget ci/warmpool_budget.json
+  # fleet-scale convergence gate: 10k notebooks must converge at the same
+  # reconciles/notebook as the 200-notebook smoke (within tolerance),
+  # reach a zero-write steady state, and stay under the committed
+  # wall-clock + p99 event->reconcile-start ceilings (ci/fleet_budget.json).
+  # On a budget failure the run re-executes under cProfile and dumps the
+  # top-25 cumulative listing so the regression is diagnosable from CI
+  # output alone.
+  echo "== loadtest fleet convergence (10k) =="
+  python loadtest/convergence.py --count 10000 \
+    --check-budget ci/fleet_budget.json \
+    --out "${FLEET_RESULT_OUT:-/tmp/fleet_result.json}" \
+    --profile-on-fail "${FLEET_PROFILE_OUT:-/tmp/fleet_profile_top25.txt}"
 fi

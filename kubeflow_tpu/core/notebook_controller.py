@@ -257,9 +257,12 @@ class NotebookReconciler:
                         self.metrics.fail_creation.labels(req.namespace).inc()
                         raise
                 else:
-                    if rh.copy_statefulset_fields(desired, found):
-                        found = self.api.update(found)
-                    live = found
+                    # cache reads are shared frozen snapshots: drift
+                    # correction mutates a private copy, never the cache
+                    candidate = found.deepcopy()
+                    if rh.copy_statefulset_fields(desired, candidate):
+                        candidate = self.api.update(candidate)
+                    live = candidate
             except Exception as err:  # noqa: BLE001 — aggregated below
                 errors.append(err)
                 continue

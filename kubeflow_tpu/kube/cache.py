@@ -32,8 +32,19 @@ history window was compacted away (410 Gone), relists every primed kind
 against the live store.  Priming and relists are recovery machinery, not
 client traffic, and run fault-exempt.
 
-All query results are deepcopies — callers may mutate them freely, exactly
-as with `ApiServer.list()`.
+The cache subscribes FILTERED (store.py kinds= filter) and widens its own
+kind set lazily — the first read, indexer, or aggregate over a kind adds
+it to the subscription before priming, so a kind nobody caches costs the
+dispatch path nothing.
+
+Read contract (matches ApiServer): `get` returns a PRIVATE copy — mutate
+and update() freely.  `list`/`select`/`by_index` return the cached frozen
+objects themselves with no per-object copy — READ-ONLY; mutating one
+without a fresh get() + update() is a bug.
+
+Incremental aggregates (`add_aggregate`) maintain per-group sums updated
+O(changed) on each watch event, so metric census scrapes never rescan the
+object maps (let alone the apiserver).
 """
 
 from __future__ import annotations
@@ -81,6 +92,10 @@ class InformerCache:
                 "Indexed cache lookups by index and hit/miss outcome "
                 "(miss = the read fell back to a brute-force scan)",
                 labels=("index", "result"))
+        # incremental aggregates: kind -> name -> fn(obj)->{group: value};
+        # (kind, name) -> group -> running sum (updated alongside indexes)
+        self._agg_fns: dict[str, dict[str, Callable[[KubeObject], dict]]] = {}
+        self._aggs: dict[tuple[str, str], dict[str, float]] = {}
         # watch-resume state (in-memory backend only; the KubeClient's
         # reflector informers own their drop/relist recovery and never
         # disconnect this plain-callback watcher)
@@ -89,8 +104,15 @@ class InformerCache:
         self.relists = 0
         self.last_rv = 0
         self._conn_lock = threading.Lock()
+        # kinds this cache asked the store to stream (grown lazily; only
+        # meaningful on the filtered in-memory backend)
+        self._watched: set[str] = set()
+        self._filtered = hasattr(api, "update_watch_kinds")
         if hasattr(api, "subscribe"):
-            api.subscribe(self)
+            if self._filtered:
+                api.subscribe(self, kinds=[])
+            else:
+                api.subscribe(self)
         else:
             api.watch(self)
 
@@ -129,16 +151,21 @@ class InformerCache:
 
     def ensure_connected(self) -> None:
         """Reconnect after an injected watch drop — resume from the last
-        seen resourceVersion, or relist every primed kind on 410 Gone."""
+        seen resourceVersion, or relist every primed kind on 410 Gone.
+        The resume keeps the kind filter: per-kind history rings mean
+        churn on kinds this cache never asked for cannot evict its
+        window."""
         if self.connected:
             return
         with self._conn_lock:
             if self.connected:
                 return
+            kinds_filter = sorted(self._watched) if self._filtered else None
             try:
-                self.api.subscribe(self, since_rv=self.last_rv)
+                self.api.subscribe(self, since_rv=self.last_rv,
+                                   kinds=kinds_filter)
             except GoneError:
-                self.api.subscribe(self)
+                self.api.subscribe(self, kinds=kinds_filter)
                 self.relists += 1
                 with self._lock:
                     kinds = sorted(self._primed)
@@ -146,12 +173,28 @@ class InformerCache:
                     self._sync_kind(kind, prune=True)
             self.connected = True
 
+    def _ensure_watched(self, kind: str) -> None:
+        """Add `kind` to the filtered subscription BEFORE any prime/index
+        touches it, so no event can slip between snapshot and stream."""
+        if not self._filtered:
+            return
+        with self._lock:
+            if kind in self._watched:
+                return
+            self._watched.add(kind)
+            kinds = sorted(self._watched)
+        if self.connected:
+            self.api.update_watch_kinds(self, kinds)
+
     # -- indexer registration -------------------------------------------------
     def add_indexer(self, kind: str, name: str, fn: IndexFn) -> None:
         """Register an index over `kind`; `fn(obj)` returns the index keys
         the object files under.  Idempotent by (kind, name): a second
         registration under the same name is a no-op, so setup functions may
-        register shared indexes without coordinating."""
+        register shared indexes without coordinating.  Registration primes
+        the kind (and adds it to the filtered subscription) so the index is
+        complete and stays maintained."""
+        self._ensure_primed(kind)
         with self._lock:
             per_kind = self._indexers.setdefault(kind, {})
             if name in per_kind:
@@ -162,6 +205,35 @@ class InformerCache:
                 for k in fn(obj):
                     idx.setdefault(k, set()).add(key)
             self._indexes[(kind, name)] = idx
+
+    def add_aggregate(self, kind: str, name: str,
+                      fn: Callable[[KubeObject], dict]) -> str:
+        """Register an incremental aggregate over `kind`: `fn(obj)` returns
+        {group_key: float} contributions, and the cache keeps per-group
+        running sums updated on every watch event — O(changed) per event,
+        O(groups) per read, never a rescan.  Idempotent by (kind, name).
+        The metric census (core.metrics) reads its gauges off these."""
+        self._ensure_primed(kind)
+        with self._lock:
+            per_kind = self._agg_fns.setdefault(kind, {})
+            if name in per_kind:
+                return name
+            per_kind[name] = fn
+            sums: dict[str, float] = {}
+            for obj in self._objects.get(kind, {}).values():
+                for k, v in fn(obj).items():
+                    sums[k] = sums.get(k, 0.0) + v
+            self._aggs[(kind, name)] = sums
+        return name
+
+    def aggregate(self, kind: str, name: str) -> dict[str, float]:
+        """Current per-group sums of a registered aggregate.  Raises
+        KeyError for an unregistered aggregate (same loud-failure contract
+        as by_index)."""
+        with self._lock:
+            if name not in self._agg_fns.get(kind, {}):
+                raise KeyError(f"no aggregate {name!r} registered for {kind}")
+            return dict(self._aggs.get((kind, name), {}))
 
     def add_namespace_index(self, kind: str) -> str:
         self.add_indexer(kind, "namespace", lambda o: [o.namespace])
@@ -214,7 +286,8 @@ class InformerCache:
              ) -> list[KubeObject]:
         """Cache-backed list; namespace-scoped listings go through the
         namespace index when one is registered (hit), else scan the kind
-        map (miss)."""
+        map (miss).  Returns the cached objects themselves — READ-ONLY
+        frozen snapshots (see module doc); no per-object copy."""
         self._ensure_primed(kind)
         with self._lock:
             store = self._objects.get(kind, {})
@@ -231,14 +304,13 @@ class InformerCache:
             if label_selector:
                 objs = [o for o in objs
                         if match_labels(o.metadata.labels, label_selector)]
-            return sorted((o.deepcopy() for o in objs),
-                          key=lambda o: (o.namespace, o.name))
+            return sorted(objs, key=lambda o: (o.namespace, o.name))
 
     def select(self, kind: str, namespace: Optional[str],
                label_selector: Optional[dict[str, str]]) -> list[KubeObject]:
         """Label-selector lookup.  Served from the exact-key-set label
         index when one is registered for the selector's keys (hit), else a
-        brute-force filtered scan (miss)."""
+        brute-force filtered scan (miss).  Read-only results, as list()."""
         if not label_selector:
             return self.list(kind, namespace)
         key_tuple = tuple(sorted(label_selector))
@@ -258,13 +330,13 @@ class InformerCache:
                         if (namespace is None or k[0] == namespace)
                         and match_labels(o.metadata.labels, label_selector)]
                 self._count(name, "miss")
-            return sorted((o.deepcopy() for o in objs),
-                          key=lambda o: (o.namespace, o.name))
+            return sorted(objs, key=lambda o: (o.namespace, o.name))
 
     def by_index(self, kind: str, index: str, key: str) -> list[KubeObject]:
         """Objects filed under `key` in a registered index.  Raises
         KeyError for an unregistered index — a silent brute-scan fallback
-        here would hide a missing setup-time registration forever."""
+        here would hide a missing setup-time registration forever.
+        Read-only results, as list()."""
         self._ensure_primed(kind)
         with self._lock:
             if index not in self._indexers.get(kind, {}):
@@ -272,13 +344,14 @@ class InformerCache:
             store = self._objects.get(kind, {})
             hits = self._indexes.get((kind, index), {}).get(key, set())
             self._count(index, "hit")
-            return sorted((store[k].deepcopy() for k in hits if k in store),
+            return sorted((store[k] for k in hits if k in store),
                           key=lambda o: (o.namespace, o.name))
 
     def stats(self) -> dict:
         with self._lock:
             return {
                 "primed_kinds": sorted(self._primed),
+                "watched_kinds": sorted(self._watched),
                 "objects": {k: len(v) for k, v in self._objects.items()},
                 "indexes": {f"{kind}/{name}": len(idx)
                             for (kind, name), idx in self._indexes.items()},
@@ -305,6 +378,7 @@ class InformerCache:
                             del idx[k]
             for k in fn(new):
                 idx.setdefault(k, set()).add(key)
+        self._reaggregate(kind, old, new)
 
     def _deindex(self, kind: str, key: tuple[str, str],
                  old: KubeObject) -> None:
@@ -316,11 +390,34 @@ class InformerCache:
                     bucket.discard(key)
                     if not bucket:
                         del idx[k]
+        self._reaggregate(kind, old, None)
+
+    def _reaggregate(self, kind: str,
+                     old: Optional[KubeObject],
+                     new: Optional[KubeObject]) -> None:
+        """O(changed) aggregate maintenance: subtract the old object's
+        contributions, add the new one's.  Contributions are exact small
+        counts, so the +/- arithmetic stays float-exact."""
+        for name, fn in self._agg_fns.get(kind, {}).items():
+            sums = self._aggs.setdefault((kind, name), {})
+            if old is not None:
+                for k, v in fn(old).items():
+                    left = sums.get(k, 0.0) - v
+                    if abs(left) < 1e-9:
+                        sums.pop(k, None)
+                    else:
+                        sums[k] = left
+            if new is not None:
+                for k, v in fn(new).items():
+                    sums[k] = sums.get(k, 0.0) + v
 
     def _ensure_primed(self, kind: str) -> None:
         with self._lock:
             if kind in self._primed:
                 return
+        # widen the filtered subscription FIRST: events landing between
+        # the filter change and the snapshot merge via the rv guards
+        self._ensure_watched(kind)
         self._sync_kind(kind, prune=False)
         with self._lock:
             self._primed.add(kind)

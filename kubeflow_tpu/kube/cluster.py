@@ -14,6 +14,7 @@ odh notebook_controller.go:155-186).
 from __future__ import annotations
 
 import copy
+import threading
 from typing import Optional
 
 from .errors import NotFoundError
@@ -79,12 +80,29 @@ class FakeCluster:
         # accounted pod's (node, requests) so re-deliveries stay idempotent.
         self._node_used: dict[str, dict[str, float]] = {}
         self._bound: dict[tuple[str, str], tuple[str, dict[str, float]]] = {}
-        api.watch(self._on_event)
+        # incremental kubelet indexes, maintained from the same event
+        # stream: pods per owning StatefulSet (so STS reconcile/status is
+        # O(its pods), never a namespace scan) and the unschedulable
+        # Pending set (so a capacity change retries exactly the starved
+        # pods instead of sweeping the fleet)
+        self._sts_pods: dict[tuple[str, str], set[str]] = {}
+        self._pending: set[tuple[str, str]] = set()
+        # one lock serializes the whole data-plane handler: watch fan-out
+        # delivers from whichever worker thread committed the write, and
+        # the kubelet's maps must see those deliveries one at a time
+        # (reentrant: handlers issue writes whose events nest on the same
+        # thread)
+        self._mutex = threading.RLock()
+        # the data plane only reacts to these kinds — register filtered so
+        # Notebook/Service/Event churn never reaches it
+        api.watch(self._on_event,
+                  kinds=["StatefulSet", "Pod", "Node", "ServiceAccount"])
         # prime the accounting for pods that predate this cluster (a data
         # plane attached to an already-populated store)
         with api.fault_exempt():
             for pod in api.list("Pod"):
                 self._account_pod(pod)
+                self._index_pod(pod)
 
     # -- node inventory --------------------------------------------------------
     def add_node(
@@ -193,8 +211,9 @@ class FakeCluster:
     def fail_pod(self, namespace: str, name: str, reason: str = "TPUUnhealthy") -> None:
         """Chaos hook: mark a pod failed (analog of the operator-chaos harness,
         chaos/knowledge/workbenches.yaml)."""
-        with self.api.fault_exempt():
-            self._fail_pod(namespace, name, reason)
+        with self._mutex:
+            with self.api.fault_exempt():
+                self._fail_pod(namespace, name, reason)
 
     def _fail_pod(self, namespace: str, name: str, reason: str) -> None:
         pod = self.api.get("Pod", namespace, name)
@@ -220,7 +239,7 @@ class FakeCluster:
         CrashLoopBackOff — pod phase stays Running but the container
         waits out restart backoffs forever and the pod never turns
         Ready (the state core.selfheal classifies as crash-loop)."""
-        with self.api.fault_exempt():
+        with self._mutex, self.api.fault_exempt():
             pod = self.api.get("Pod", namespace, name)
             pod.status = {
                 "phase": "Running",
@@ -279,18 +298,20 @@ class FakeCluster:
             # retry NOW, not whenever the next unrelated node/capacity event
             # happens to land (a no-op update notifies no watcher, so the
             # Node-MODIFIED retry path alone cannot be relied on)
-            self._retry_pending_pods()
+            with self._mutex:
+                self._retry_pending_pods()
 
     def mark_running(self, namespace: str, name: str) -> None:
         """Drive a created-but-not-yet-Ready pod to Running/Ready by hand —
         the auto_ready=False escape hatch failover drills use to freeze the
         cluster mid-recreate and resume it under a different manager."""
-        with self.api.fault_exempt():
-            pod = self.api.try_get("Pod", namespace, name)
-            if pod is None or not pod.spec.get("nodeName"):
-                return
-            self._mark_running(pod)
-            self._sync_sts_status_for_pod(pod)
+        with self._mutex:
+            with self.api.fault_exempt():
+                pod = self.api.try_get("Pod", namespace, name)
+                if pod is None or not pod.spec.get("nodeName"):
+                    return
+                self._mark_running(pod)
+                self._sync_sts_status_for_pod(pod)
 
     # -- session-state data plane ----------------------------------------------
     def attach_session_store(self, store,
@@ -417,13 +438,12 @@ class FakeCluster:
         Failed — a permanently broken slice (bad host, torn interconnect).
         Self-healing must exhaust its restart budget on it, not churn
         forever.  Existing pods fail immediately."""
-        self._poisoned[(namespace, name)] = reason
-        with self.api.fault_exempt():
-            for pod in self.api.list("Pod", namespace=namespace):
-                ref = pod.metadata.controller_owner()
-                if ref is not None and ref.kind == "StatefulSet" \
-                        and ref.name == name:
-                    self._fail_pod(namespace, pod.name, reason)
+        with self._mutex:
+            self._poisoned[(namespace, name)] = reason
+            with self.api.fault_exempt():
+                for pod_name in sorted(
+                        self._sts_pods.get((namespace, name), ())):
+                    self._fail_pod(namespace, pod_name, reason)
 
     def heal_statefulset(self, namespace: str, name: str) -> None:
         """Undo poison_statefulset: the next slice restart comes up
@@ -432,8 +452,34 @@ class FakeCluster:
 
     # -- event loop ------------------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
-        with self.api.fault_exempt():
-            self._handle_event(ev)
+        with self._mutex:
+            with self.api.fault_exempt():
+                self._handle_event(ev)
+
+    def _index_pod(self, pod: KubeObject) -> None:
+        """Fold one live pod into the kubelet indexes (idempotent)."""
+        key = (pod.namespace, pod.name)
+        owner = pod.metadata.controller_owner()
+        if owner is not None and owner.kind == "StatefulSet":
+            self._sts_pods.setdefault(
+                (pod.namespace, owner.name), set()).add(pod.name)
+        phase = pod.body.get("status", {}).get("phase")
+        if phase == "Pending" and not pod.spec.get("nodeName"):
+            self._pending.add(key)
+        else:
+            self._pending.discard(key)
+
+    def _unindex_pod(self, pod: KubeObject) -> None:
+        key = (pod.namespace, pod.name)
+        self._pending.discard(key)
+        owner = pod.metadata.controller_owner()
+        if owner is not None and owner.kind == "StatefulSet":
+            skey = (pod.namespace, owner.name)
+            pods = self._sts_pods.get(skey)
+            if pods is not None:
+                pods.discard(pod.name)
+                if not pods:
+                    del self._sts_pods[skey]
 
     def _handle_event(self, ev: WatchEvent) -> None:
         kind = ev.obj.kind
@@ -445,6 +491,7 @@ class FakeCluster:
         elif kind == "Pod":
             if ev.type == EventType.DELETED:
                 self._unaccount_pod(ev.obj)
+                self._unindex_pod(ev.obj)
                 self._failed_pods.discard((ev.obj.namespace, ev.obj.name))
                 owner = ev.obj.metadata.controller_owner()
                 if owner is not None and owner.kind == "StatefulSet":
@@ -455,6 +502,7 @@ class FakeCluster:
                 # used-resources map is current before the write that bound
                 # the pod even returns to its caller
                 self._account_pod(ev.obj)
+                self._index_pod(ev.obj)
         elif kind == "Node" and ev.type in (EventType.ADDED, EventType.MODIFIED):
             self._retry_pending_pods()
         elif kind == "ServiceAccount" and ev.type == EventType.ADDED:
@@ -470,19 +518,18 @@ class FakeCluster:
             pod_name = f"{name}-{ordinal}"
             if self.api.try_get("Pod", namespace, pod_name) is None:
                 self._create_pod(sts, ordinal)
-        # scale-down: delete pods beyond want (highest ordinal first)
+        # scale-down: delete pods beyond want (highest ordinal first) —
+        # off the incremental owner index, O(this STS's pods)
+        owned = self._sts_pods.get((namespace, name), set())
         extra = [
-            p
-            for p in self.api.list("Pod", namespace=namespace)
-            if (ref := p.metadata.controller_owner()) is not None
-            and ref.kind == "StatefulSet"
-            and ref.name == name
-            and _ordinal_of(p.name, name) is not None
-            and _ordinal_of(p.name, name) >= want
+            pod_name for pod_name in owned
+            if _ordinal_of(pod_name, name) is not None
+            and _ordinal_of(pod_name, name) >= want
         ]
-        for p in sorted(extra, key=lambda p: -(_ordinal_of(p.name, name) or 0)):
+        for pod_name in sorted(
+                extra, key=lambda n: -(_ordinal_of(n, name) or 0)):
             try:
-                self.api.delete("Pod", namespace, p.name)
+                self.api.delete("Pod", namespace, pod_name)
             except NotFoundError:
                 pass
         self._sync_sts_status(namespace, name)
@@ -637,8 +684,15 @@ class FakeCluster:
 
     def _retry_pending_pods(self) -> None:
         """Re-run scheduling for pods that previously found no fitting node
-        (real kube-scheduler retries on Node add / capacity change)."""
-        for pod in self.api.list("Pod"):
+        (real kube-scheduler retries on Node add / capacity change).  Walks
+        the incrementally-maintained Pending set, never the whole fleet;
+        each candidate is re-fetched so the mutation happens on a private
+        copy (listed objects are read-only shared snapshots)."""
+        for ns, pod_name in sorted(self._pending):
+            pod = self.api.try_get("Pod", ns, pod_name)
+            if pod is None:
+                self._pending.discard((ns, pod_name))
+                continue
             status = pod.body.get("status", {})
             if status.get("phase") != "Pending" or pod.spec.get("nodeName"):
                 continue
@@ -665,13 +719,11 @@ class FakeCluster:
         sts = self.api.try_get("StatefulSet", namespace, name)
         if sts is None:
             return
-        pods = [
-            p
-            for p in self.api.list("Pod", namespace=namespace)
-            if (ref := p.metadata.controller_owner()) is not None
-            and ref.kind == "StatefulSet"
-            and ref.name == name
-        ]
+        pods = []
+        for pod_name in sorted(self._sts_pods.get((namespace, name), ())):
+            pod = self.api.try_get("Pod", namespace, pod_name)
+            if pod is not None:
+                pods.append(pod)
         ready = sum(
             1
             for p in pods

@@ -255,7 +255,12 @@ class _WatchSession:
     (ApiServer.drop_watch_connections) can resume exactly where it left
     off via subscribe(since_rv); if the history window was compacted away
     (410 Gone) it reconnects live-only and RELISTS — enqueueing every
-    primary object, the client-go reflector's relist in controller terms."""
+    primary object, the client-go reflector's relist in controller terms.
+
+    The session registers FILTERED: it asks the apiserver only for the
+    kinds some registered controller watches (`kinds`, kept current by
+    Manager.register/unregister via update_watch_kinds), so an event on
+    an uninteresting kind never invokes this callback at all."""
 
     def __init__(self, mgr: "Manager") -> None:
         self.mgr = mgr
@@ -263,6 +268,10 @@ class _WatchSession:
         self.connected = True
         self.drops = 0
         self.relists = 0
+        # current kind filter (None until first registration: nothing is
+        # interesting yet, but resume semantics want the full stream shape
+        # only for watched kinds anyway)
+        self.kinds: list[str] = []
 
     def __call__(self, ev: WatchEvent) -> None:
         rv = ev.obj.metadata.resource_version
@@ -277,17 +286,23 @@ class _WatchSession:
         self.drops += 1
         self.connected = False
 
+    def set_kinds(self, kinds: list[str]) -> None:
+        self.kinds = list(kinds)
+        update = getattr(self.mgr.api, "update_watch_kinds", None)
+        if update is not None and self.connected:
+            update(self, self.kinds)
+
     def reconnect(self) -> None:
         api = self.mgr.api
         try:
-            api.subscribe(self, since_rv=self.last_rv)
+            api.subscribe(self, since_rv=self.last_rv, kinds=self.kinds)
         except GoneError:
             # resume window compacted away (410): reconnect live and
             # relist so no state transition is missed (level-triggered
             # reconcilers re-derive everything from current state).  The
             # relist itself is recovery machinery, not client traffic —
             # exempt from an active fault plan
-            api.subscribe(self)
+            api.subscribe(self, kinds=self.kinds)
             self.relists += 1
             exempt = getattr(api, "fault_exempt", None)
             if exempt is not None:
@@ -375,6 +390,24 @@ class Manager:
             "workqueue_work_duration_seconds",
             "How long processing a request from the workqueue takes",
             labels=("controller",))
+        # control-plane reaction latency, the NotebookOS headline number:
+        # the clock delta from the watch event that caused an enqueue to
+        # the moment its reconcile starts.  Only event-caused enqueues are
+        # stamped (resyncs and retry promotions are not reactions); the
+        # first cause wins while a key stays queued.
+        self.event_to_reconcile = self.metrics_registry.histogram(
+            "notebook_event_to_reconcile_seconds",
+            "Latency from the enqueue-cause watch event to reconcile start",
+            labels=("controller",),
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+                     120.0))
+        # per-key cause stamps: (clock time, monotonic wall time) of the
+        # event that put the key in the queue
+        self._cause_stamps: dict[tuple[str, Request], tuple[float, float]] = {}
+        # exact wall-clock samples for percentile reporting (FakeClock runs
+        # collapse the injected-clock delta to ~0, so the loadtest reads
+        # real reaction time from here); bounded for long-lived managers
+        self._event_latency: deque[float] = deque(maxlen=1 << 18)
         # indexed informer cache: the reconcilers' read path (hot-path
         # lookups go through registered indexes instead of api.list scans);
         # subscribes to the same watch stream as the manager, BEFORE the
@@ -394,11 +427,13 @@ class Manager:
         self._threads: list[threading.Thread] = []
         if hasattr(api, "subscribe"):
             # in-memory ApiServer: a resumable session that survives
-            # injected watch-stream drops (kube.faults)
+            # injected watch-stream drops (kube.faults), registered with an
+            # (initially empty) kind filter that register() keeps current
             self._watch_session: Optional[_WatchSession] = _WatchSession(self)
-            api.watch(self._watch_session)
+            api.watch(self._watch_session, kinds=[])
         else:
             # KubeClient: its reflector informers own drop/relist recovery
+            # and are already per-kind streams
             self._watch_session = None
             api.watch(self._on_event)
 
@@ -431,6 +466,11 @@ class Manager:
         )
         with self._lock:
             self._queues.setdefault(name, deque())
+        # widen (never replays) the session's kind filter to cover the new
+        # controller's For/Owns/Watches set — a kind no controller watches
+        # never reaches _on_event at all
+        if self._watch_session is not None:
+            self._watch_session.set_kinds(self.watched_kinds())
 
     def unregister(self, name: str) -> None:
         """Remove a controller and drop its queued/delayed work.  An
@@ -448,17 +488,24 @@ class Manager:
             dropped = [k for k in self._retries if k[0] == name]
             self._retries = {k: v for k, v in self._retries.items()
                              if k[0] != name}
-            for d in (self._enqueued_at, self._trace_ids, self._attempt_seq):
+            for d in (self._enqueued_at, self._trace_ids, self._attempt_seq,
+                      self._cause_stamps):
                 for k in [k for k in d if k[0] == name]:
                     del d[k]
         for k in dropped:
             self._limiter.forget(k)
+        if self._watch_session is not None:
+            self._watch_session.set_kinds(self.watched_kinds())
 
     # -- event -> requests ----------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
+        # one cause stamp per delivery: the (clock, wall) instant of the
+        # event whose requests are about to enqueue, feeding the
+        # event->reconcile-start reaction-latency metric
+        cause = (self.clock.now(), time.monotonic())
         for reg in self._registrations:
             for req in self._requests_for(reg, ev):
-                self._enqueue(reg.name, req)
+                self._enqueue(reg.name, req, cause=cause)
 
     def _requests_for(self, reg: _Registration, ev: WatchEvent) -> list[Request]:
         obj = ev.obj
@@ -481,7 +528,8 @@ class Manager:
         return out
 
     def _enqueue(self, reg_name: str, req: Request,
-                 enqueued_at: Optional[float] = None) -> None:
+                 enqueued_at: Optional[float] = None,
+                 cause: Optional[tuple[float, float]] = None) -> None:
         with self._lock:
             key = (reg_name, req)
             if key in self._queued:
@@ -498,6 +546,10 @@ class Manager:
             self._enqueued_at.setdefault(
                 key,
                 self.clock.now() if enqueued_at is None else enqueued_at)
+            if cause is not None:
+                # first cause wins while the key stays dirty: the reaction
+                # latency is measured from the event the fleet REACTED to
+                self._cause_stamps.setdefault(key, cause)
 
     def enqueue(self, reg_name: str, req: Request) -> None:
         """Manual enqueue (tests, resync ticks)."""
@@ -552,7 +604,16 @@ class Manager:
             self._processing.add(key)
             self._inflight_started[key] = self.clock.now()
             enqueued_at = self._enqueued_at.pop(key, None)
+            cause = self._cause_stamps.pop(key, None)
             tid = self._trace_ids.get(key, "")
+        if cause is not None:
+            # event -> reconcile-start: the injected-clock delta feeds the
+            # deterministic histogram; the wall-clock delta feeds the exact
+            # percentile samples the loadtest reports
+            self.event_to_reconcile.labels(key[0]).observe(
+                max(self.clock.now() - cause[0], 0.0))
+            self._event_latency.append(
+                max(time.monotonic() - cause[1], 0.0))
         if enqueued_at is not None:
             # a retry's queue wait belongs to its live retry chain: exemplar
             # the observation with that trace so a fat queue-duration bucket
@@ -952,6 +1013,13 @@ class Manager:
     @property
     def dropped_errors(self) -> list[tuple[str, Request, BaseException]]:
         return list(self._errors)
+
+    def event_latency_samples(self) -> list[float]:
+        """Wall-clock event->reconcile-start latencies (seconds) of up to
+        the last 2^18 event-caused reconciles, oldest first — the loadtest
+        computes exact p50/p99 from these."""
+        with self._lock:
+            return list(self._event_latency)
 
     # -- readiness ------------------------------------------------------------
     @property

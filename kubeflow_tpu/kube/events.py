@@ -32,6 +32,9 @@ class EventRecorder:
                 and ev.body.get("message") == message
                 and ev.body.get("type") == etype
             ):
+                # listed objects are read-only shared snapshots: bump the
+                # count on a private copy
+                ev = ev.deepcopy()
                 ev.body["count"] = int(ev.body.get("count", 1)) + 1
                 return self.api.update(ev)
         body = {

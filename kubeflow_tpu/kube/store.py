@@ -7,16 +7,45 @@ same semantics — optimistic concurrency on resourceVersion, admission chain in
 the write path, finalizer-gated deletion, owner-reference garbage collection,
 watch fan-out) in a deterministic, dependency-free form suitable for pytest
 and for running the whole stack standalone.
+
+Fleet-scale internals (the 10k-notebook convergence gate forced them):
+
+  - **Sharded per kind.**  Each kind owns a shard — its own lock, object
+    map, and bounded watch-history ring (`WATCH_HISTORY_SIZE` events per
+    kind) — so 8+ workers converging Notebooks never serialize behind Pod
+    churn, and a chatty kind cannot evict another kind's resume window.
+  - **Filtered watch dispatch.**  `watch`/`subscribe` take `kinds=` and
+    `namespace=` filters; dispatch goes through a per-kind subscriber
+    index, so an event only ever touches interested watchers.  The
+    `watch_dispatch_counts()` audit (exported as
+    `apiserver_watch_dispatch_total{kind,result}`) proves the fan-out
+    reduction: `skipped` counts the callbacks an unfiltered broadcast
+    would have made but the index didn't.
+  - **Copy-on-write reads.**  Committed objects are immutable — every
+    write path replaces, never mutates, the stored object — so `list`
+    returns the stored objects themselves with NO per-object deepcopy,
+    and watch events carry one shared frozen object to every watcher.
+    The contract: objects handed out by `list` (and by watch callbacks)
+    are READ-ONLY; mutating one without going through a fresh `get()` +
+    `update()` is a bug.  `get` still returns a private copy, so the
+    universal mutate-then-update pattern keeps working unchanged.
+  - **Apply fast path.**  A server-side apply whose manifest digest and
+    target resourceVersion both match the previous apply by the same
+    field manager short-circuits before any merge machinery runs — a
+    GitOps loop re-applying unchanged config costs one dict lookup.
 """
 
 from __future__ import annotations
 
 import copy
+import hashlib
+import json
+import os
 import threading
-from contextlib import contextmanager
+from contextlib import ExitStack, contextmanager
 from dataclasses import dataclass
 from enum import Enum
-from typing import Callable, Optional
+from typing import Callable, Iterable, Optional
 
 from collections import deque
 
@@ -29,6 +58,16 @@ from .errors import (
     NotFoundError,
 )
 from .meta import KubeObject, new_uid, now_iso
+
+DEFAULT_WATCH_HISTORY_SIZE = 2048
+
+
+def _default_history_size() -> int:
+    try:
+        return max(1, int(os.environ.get("WATCH_HISTORY_SIZE", "")
+                          or DEFAULT_WATCH_HISTORY_SIZE))
+    except ValueError:
+        return DEFAULT_WATCH_HISTORY_SIZE
 
 
 class EventType(Enum):
@@ -100,25 +139,60 @@ def match_labels(labels: dict[str, str], selector: Optional[dict[str, str]]) -> 
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+class _KindShard:
+    """Per-kind store partition: object map + watch-history ring under one
+    lock, so writes to different kinds never contend."""
+
+    __slots__ = ("lock", "objects", "history", "floor")
+
+    def __init__(self, history_size: int) -> None:
+        self.lock = threading.RLock()
+        self.objects: dict[tuple[str, str], KubeObject] = {}
+        self.history: deque[WatchEvent] = deque(maxlen=history_size)
+        # resourceVersions <= the floor have been evicted from this kind's
+        # history: a resume from below it cannot prove nothing was missed
+        # for this kind -> 410
+        self.floor = 0
+
+
+@dataclass
+class _WatchEntry:
+    """One registered watcher with its delivery filter.  `kinds=None`
+    means every kind (legacy unfiltered broadcast); namespace=None means
+    every namespace."""
+
+    fn: Callable[[WatchEvent], None]
+    kinds: Optional[frozenset]
+    namespace: Optional[str]
+
+
 class ApiServer:
     """Thread-safe in-memory object store with k8s write-path semantics."""
 
-    def __init__(self) -> None:
-        self._lock = threading.RLock()
-        # kind -> (namespace, name) -> KubeObject
-        self._objects: dict[str, dict[tuple[str, str], KubeObject]] = {}
+    def __init__(self, history_size: Optional[int] = None) -> None:
+        self.history_size = history_size if history_size is not None \
+            else _default_history_size()
+        # kind -> shard (object map + history ring, per-kind lock)
+        self._shards: dict[str, _KindShard] = {}
+        self._shards_lock = threading.RLock()
+        # rv/name counters (globally ordered; own lock so a shard-lock
+        # holder can allocate without touching other shards)
+        self._rv_lock = threading.Lock()
         self._rv_counter = 0
         self._name_counter = 0
-        self._watchers: list[Callable[[WatchEvent], None]] = []
+        # watcher registry + per-kind dispatch index.  Lock ordering:
+        # _shards_lock > shard.lock (sorted by kind) > _watch_lock; the
+        # rv/audit locks are leaves and never acquire anything.
+        self._watch_lock = threading.RLock()
+        self._watch_entries: list[_WatchEntry] = []
+        self._kind_index: dict[str, list[_WatchEntry]] = {}
+        self._unfiltered: list[_WatchEntry] = []
+        # (kind, "delivered"|"skipped") -> count: the fan-out audit.
+        # skipped = registered watchers an unfiltered broadcast would have
+        # called for the event but the per-kind index did not.
+        self._dispatch_counts: dict[tuple[str, str], int] = {}
         self._mutating: list[AdmissionHook] = []
         self._validating: list[AdmissionHook] = []
-        # bounded event history so watches can resume from a resourceVersion
-        # (the apiserver's etcd watch cache; too-old rv -> 410 Gone and the
-        # client relists, exactly client-go reflector behavior)
-        self._history: deque[WatchEvent] = deque(maxlen=2048)
-        # resourceVersions <= the floor have been evicted from the history:
-        # a resume from below it cannot prove nothing was missed -> 410
-        self._history_floor = 0
         # fault injection (kube.faults): a plan gates top-level verb entry;
         # re-entrant internals and watch-driven components run at depth > 0
         # and are exempt (thread-local so threaded managers stay correct)
@@ -127,11 +201,25 @@ class ApiServer:
         # bounded audit trail of top-level client writes (AuditRecord);
         # shares the depth gate with fault injection, so only controller
         # traffic is recorded — never the store's own re-entry
+        self._audit_lock = threading.Lock()
         self._audit_log: deque[AuditRecord] = deque(maxlen=8192)
         # per-(verb, kind) counters over ALL top-level client verbs, reads
         # included (the audit log keeps write detail; these stay O(verbs x
         # kinds) so a load test can budget total API traffic cheaply)
         self._verb_counts: dict[tuple[str, str], int] = {}
+        # apply fast path: (kind, ns, name) -> field_manager ->
+        # (manifest digest, resulting rv); see apply()
+        self._apply_lock = threading.Lock()
+        self._applied_digests: dict[
+            tuple[str, str, str], dict[str, tuple[str, int]]] = {}
+
+    # -- shards ---------------------------------------------------------------
+    def _shard(self, kind: str) -> _KindShard:
+        with self._shards_lock:
+            shard = self._shards.get(kind)
+            if shard is None:
+                shard = self._shards[kind] = _KindShard(self.history_size)
+            return shard
 
     # -- fault injection ------------------------------------------------------
     def install_fault_plan(self, plan) -> None:
@@ -170,7 +258,7 @@ class ApiServer:
         audited = depth == 0 and verb in ("create", "update", "patch",
                                           "delete")
         if depth == 0:
-            with self._lock:
+            with self._audit_lock:
                 key = (verb, kind)
                 self._verb_counts[key] = self._verb_counts.get(key, 0) + 1
         try:
@@ -183,14 +271,14 @@ class ApiServer:
             yield directives
         except BaseException as err:
             if audited:
-                with self._lock:
+                with self._audit_lock:
                     self._audit_log.append(AuditRecord(
                         verb, kind, namespace, name, ok=False,
                         error=str(err), rv=self._rv_counter))
             raise
         else:
             if audited:
-                with self._lock:
+                with self._audit_lock:
                     self._audit_log.append(AuditRecord(
                         verb, kind, namespace, name, ok=True,
                         rv=self._rv_counter))
@@ -204,7 +292,7 @@ class ApiServer:
         """The recorded top-level client writes, oldest first, optionally
         filtered.  Chaos tests read this to prove client-side invariants
         (e.g. slice-atomicity of recovery restarts)."""
-        with self._lock:
+        with self._audit_lock:
             return [
                 r for r in self._audit_log
                 if (verb is None or r.verb == verb)
@@ -213,7 +301,7 @@ class ApiServer:
             ]
 
     def clear_audit_log(self) -> None:
-        with self._lock:
+        with self._audit_lock:
             self._audit_log.clear()
 
     def verb_counts(self) -> dict[tuple[str, str], int]:
@@ -221,12 +309,135 @@ class ApiServer:
         call, reads included.  The loadtest convergence benchmark budgets
         API traffic against this; `fault_exempt` harness calls and internal
         re-entry are never counted."""
-        with self._lock:
+        with self._audit_lock:
             return dict(self._verb_counts)
 
     def clear_verb_counts(self) -> None:
-        with self._lock:
+        with self._audit_lock:
             self._verb_counts.clear()
+
+    # -- watch / admission registration --------------------------------------
+    @property
+    def _watchers(self) -> list[Callable[[WatchEvent], None]]:
+        """Registered callbacks (test-only introspection surface; the
+        registry itself lives in filtered _WatchEntry records)."""
+        with self._watch_lock:
+            return [e.fn for e in self._watch_entries]
+
+    @staticmethod
+    def _kindset(kinds) -> Optional[frozenset]:
+        if kinds is None:
+            return None
+        return frozenset(kinds)
+
+    def _register_entry(self, entry: _WatchEntry) -> None:
+        # caller holds _watch_lock
+        self._watch_entries.append(entry)
+        if entry.kinds is None:
+            self._unfiltered.append(entry)
+        else:
+            for k in entry.kinds:
+                self._kind_index.setdefault(k, []).append(entry)
+
+    def _deregister_entry(self, entry: _WatchEntry) -> None:
+        # caller holds _watch_lock
+        self._watch_entries.remove(entry)
+        if entry.kinds is None:
+            self._unfiltered.remove(entry)
+        else:
+            for k in entry.kinds:
+                bucket = self._kind_index.get(k)
+                if bucket is not None:
+                    if entry in bucket:
+                        bucket.remove(entry)
+                    if not bucket:
+                        del self._kind_index[k]
+
+    def watch(self, fn: Callable[[WatchEvent], None],
+              kinds: Optional[Iterable[str]] = None,
+              namespace: Optional[str] = None) -> None:
+        """Register a live watcher.  `kinds` restricts delivery to those
+        kinds (None = every kind); `namespace` restricts to one namespace.
+        Watch callbacks receive SHARED frozen objects — they must never
+        mutate the event or anything it references."""
+        entry = _WatchEntry(fn, self._kindset(kinds), namespace or None)
+        with self._watch_lock:
+            self._register_entry(entry)
+
+    def unwatch(self, fn: Callable[[WatchEvent], None]) -> None:
+        with self._watch_lock:
+            for entry in list(self._watch_entries):
+                if entry.fn is fn:
+                    self._deregister_entry(entry)
+
+    def update_watch_kinds(self, fn: Callable[[WatchEvent], None],
+                           kinds: Optional[Iterable[str]]) -> None:
+        """Re-filter an already-registered watcher (forward-only: past
+        events of newly added kinds are not replayed — new consumers prime
+        with list_with_rv, which is exactly what the informer cache does)."""
+        kindset = self._kindset(kinds)
+        with self._watch_lock:
+            for entry in self._watch_entries:
+                if entry.fn is fn:
+                    self._deregister_entry(entry)
+                    entry.kinds = kindset
+                    self._register_entry(entry)
+                    return
+
+    def subscribe(self, fn: Callable[[WatchEvent], None],
+                  since_rv: Optional[int] = None,
+                  kinds: Optional[Iterable[str]] = None,
+                  namespace: Optional[str] = None) -> None:
+        """Register a watcher, first replaying history newer than `since_rv`
+        atomically (no events can be missed between replay and live stream).
+        since_rv=None starts live-only; raises GoneError when since_rv
+        predates the retained window of ANY kind the watcher asked for —
+        per-kind rings mean Pod churn can never evict a Notebook-only
+        subscriber's resume window."""
+        kindset = self._kindset(kinds)
+        if since_rv is None:
+            self.watch(fn, kinds=kinds, namespace=namespace)
+            return
+        entry = _WatchEntry(fn, kindset, namespace or None)
+        with self._shards_lock:
+            relevant = sorted(
+                k for k in self._shards
+                if kindset is None or k in kindset)
+            with ExitStack() as stack:
+                shards = []
+                for k in relevant:
+                    shard = self._shards[k]
+                    stack.enter_context(shard.lock)
+                    shards.append(shard)
+                # a resume below any relevant eviction floor cannot prove
+                # nothing was missed (events <= floor left that kind's
+                # window — sliding eviction or a reset_watch_history
+                # compaction)
+                floor = max((s.floor for s in shards), default=0)
+                if since_rv < floor:
+                    raise GoneError(
+                        f"resourceVersion {since_rv} is too old "
+                        f"(history starts at {floor + 1})"
+                    )
+                replay: list[WatchEvent] = []
+                for shard in shards:
+                    for ev in shard.history:
+                        if ev.obj.metadata.resource_version <= since_rv:
+                            continue
+                        if entry.namespace is not None and \
+                                ev.obj.namespace != entry.namespace:
+                            continue
+                        replay.append(ev)
+                # rv order across kinds: per-kind rings are merged back
+                # into the global commit order
+                replay.sort(key=lambda ev: ev.obj.metadata.resource_version)
+                with self._watch_lock:
+                    # prev rides along: resumed selector-filtered watches
+                    # need it to synthesize edit-in/edit-out transitions
+                    # that happened while they were away
+                    for ev in replay:
+                        fn(ev)
+                    self._register_entry(entry)
 
     def drop_watch_connections(self) -> int:
         """Disconnect every RESUMABLE watcher (one with an
@@ -235,108 +446,121 @@ class ApiServer:
         data plane, test listeners) stay connected: a stream drop models
         the client side of the watch, and a consumer with no resume
         protocol would just silently go deaf.  Returns how many dropped."""
-        with self._lock:
-            dropped = [w for w in self._watchers
-                       if hasattr(w, "on_watch_dropped")]
-            self._watchers = [w for w in self._watchers
-                              if not hasattr(w, "on_watch_dropped")]
-        for w in dropped:
-            w.on_watch_dropped()
+        with self._watch_lock:
+            dropped = [e for e in self._watch_entries
+                       if hasattr(e.fn, "on_watch_dropped")]
+            for e in dropped:
+                self._deregister_entry(e)
+        for e in dropped:
+            e.fn.on_watch_dropped()
         return len(dropped)
 
     def reset_watch_history(self) -> None:
-        """Evict the whole watch-resume window (etcd compaction): any
-        subsequent resume from a pre-reset resourceVersion gets 410 Gone
-        and must relist."""
-        with self._lock:
-            self._history.clear()
-            self._history_floor = self._rv_counter
+        """Evict the whole watch-resume window of every kind (etcd
+        compaction): any subsequent resume from a pre-reset resourceVersion
+        gets 410 Gone and must relist.  Each shard's floor rises to the
+        compaction point under that shard's lock, so a concurrent filtered
+        subscribe either completes against the pre-compaction window or
+        sees the raised floor and 410s — an evicted rv is never silently
+        skipped in a replay."""
+        with self._shards_lock:
+            shards = list(self._shards.values())
+        with self._rv_lock:
+            rv = self._rv_counter
+        for shard in shards:
+            with shard.lock:
+                shard.history.clear()
+                shard.floor = max(shard.floor, rv)
+
+    def watch_dispatch_counts(self) -> dict[tuple[str, str], int]:
+        """Cumulative (kind, "delivered"|"skipped") dispatch audit.
+        delivered = callbacks actually invoked for events of the kind;
+        skipped = callbacks an unfiltered broadcast would have invoked but
+        the per-kind index did not.  Exported by core.metrics as
+        apiserver_watch_dispatch_total."""
+        with self._watch_lock:
+            return dict(self._dispatch_counts)
 
     def _stale_of(self, kind: str, namespace: str,
                   name: str) -> Optional[KubeObject]:
         """The most recent PREVIOUS version of an object still in the watch
         history — what a lagging apiserver cache would serve."""
-        for ev in reversed(self._history):
-            o = ev.obj
-            if (o.kind, o.namespace, o.name) == (kind, namespace, name) \
-                    and ev.prev is not None:
-                return ev.prev.deepcopy()
+        shard = self._shard(kind)
+        with shard.lock:
+            for ev in reversed(shard.history):
+                o = ev.obj
+                if (o.namespace, o.name) == (namespace, name) \
+                        and ev.prev is not None:
+                    return ev.prev.deepcopy()
         return None
-
-    # -- watch / admission registration --------------------------------------
-    def watch(self, fn: Callable[[WatchEvent], None]) -> None:
-        with self._lock:
-            self._watchers.append(fn)
-
-    def unwatch(self, fn: Callable[[WatchEvent], None]) -> None:
-        with self._lock:
-            if fn in self._watchers:
-                self._watchers.remove(fn)
-
-    def subscribe(self, fn: Callable[[WatchEvent], None],
-                  since_rv: Optional[int] = None) -> None:
-        """Register a watcher, first replaying history newer than `since_rv`
-        atomically (no events can be missed between replay and live stream).
-        since_rv=None starts live-only; raises GoneError when since_rv
-        predates the retained window."""
-        with self._lock:
-            if since_rv is not None:
-                # a resume below the eviction floor cannot prove nothing was
-                # missed (events <= floor left the window — sliding eviction
-                # or a reset_watch_history compaction)
-                if since_rv < self._history_floor:
-                    raise GoneError(
-                        f"resourceVersion {since_rv} is too old "
-                        f"(history starts at {self._history_floor + 1})"
-                    )
-                for ev in self._history:
-                    if ev.obj.metadata.resource_version > since_rv:
-                        # prev rides along: resumed selector-filtered
-                        # watches need it to synthesize edit-in/edit-out
-                        # transitions that happened while they were away
-                        fn(WatchEvent(ev.type, ev.obj.deepcopy(),
-                                      prev=ev.prev))
-            self._watchers.append(fn)
 
     @property
     def resource_version(self) -> int:
-        with self._lock:
+        with self._rv_lock:
             return self._rv_counter
 
     def register_admission(self, hook: AdmissionHook) -> None:
-        with self._lock:
+        with self._watch_lock:
             (self._mutating if hook.mutating else self._validating).append(hook)
 
     def _notify(self, ev: WatchEvent) -> None:
-        # history append + fan-out under the (reentrant) lock so subscribe()'s
-        # replay-then-register is atomic with live delivery; callbacks must
-        # only enqueue or re-enter this ApiServer (same thread, RLock-safe)
-        with self._lock:
-            if len(self._history) == self._history.maxlen and self._history:
+        """Append to the kind's history ring and dispatch to interested
+        watchers only.  Ring append + watcher snapshot are atomic with a
+        subscribe()'s replay-then-register (both hold shard.lock then
+        _watch_lock), so an event is delivered to a resuming watcher
+        exactly once — via replay or live, never both.  The event carries
+        ONE shared frozen object: no per-watcher deepcopy; callbacks must
+        only read it, and may only enqueue or re-enter this ApiServer."""
+        kind = ev.obj.kind
+        ev.obj.frozen = True
+        if ev.prev is not None:
+            ev.prev.frozen = True
+        shard = self._shard(kind)
+        with shard.lock:
+            hist = shard.history
+            if hist.maxlen is not None and len(hist) == hist.maxlen and hist:
                 # about to evict the oldest event: resumes at or below its
-                # rv can no longer be proven complete
-                self._history_floor = max(
-                    self._history_floor,
-                    self._history[0].obj.metadata.resource_version)
-            self._history.append(
-                WatchEvent(ev.type, ev.obj.deepcopy(), prev=ev.prev))
-            watchers = list(self._watchers)
-        for fn in watchers:
-            fn(WatchEvent(ev.type, ev.obj.deepcopy(), prev=ev.prev))
+                # rv can no longer be proven complete for this kind
+                shard.floor = max(
+                    shard.floor,
+                    hist[0].obj.metadata.resource_version)
+            hist.append(ev)
+            with self._watch_lock:
+                entries = self._kind_index.get(kind, ())
+                ns = ev.obj.namespace
+                interested = [
+                    e for e in entries
+                    if e.namespace is None or e.namespace == ns]
+                interested += [
+                    e for e in self._unfiltered
+                    if e.namespace is None or e.namespace == ns]
+                d = self._dispatch_counts
+                delivered = len(interested)
+                d[(kind, "delivered")] = \
+                    d.get((kind, "delivered"), 0) + delivered
+                d[(kind, "skipped")] = \
+                    d.get((kind, "skipped"), 0) + \
+                    (len(self._watch_entries) - delivered)
+        for e in interested:
+            e.fn(ev)
 
     def _next_rv(self) -> int:
-        self._rv_counter += 1
-        return self._rv_counter
+        with self._rv_lock:
+            self._rv_counter += 1
+            return self._rv_counter
 
     # -- reads ----------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> KubeObject:
+        """Read one object.  Returns a PRIVATE copy — mutate it and
+        update() it freely (the universal controller pattern)."""
         with self._fault_scope("get", kind, namespace, name) as faults:
             if faults and faults.get("stale"):
                 stale = self._stale_of(kind, namespace, name)
                 if stale is not None:
                     return stale
-            with self._lock:
-                obj = self._objects.get(kind, {}).get((namespace, name))
+            shard = self._shard(kind)
+            with shard.lock:
+                obj = shard.objects.get((namespace, name))
                 if obj is None:
                     raise NotFoundError(f"{kind} {namespace}/{name} not found")
                 return obj.deepcopy()
@@ -353,16 +577,30 @@ class ApiServer:
         namespace: Optional[str] = None,
         label_selector: Optional[dict[str, str]] = None,
     ) -> list[KubeObject]:
+        """List objects of a kind.  Returns the stored objects themselves
+        (copy-on-write contract): they are frozen shared snapshots —
+        READ-ONLY.  To mutate one, get() a private copy and update() it;
+        mutating a listed object in place is a bug (it would corrupt every
+        other reader's view and defeat the store's no-op detection)."""
         with self._fault_scope("list", kind, namespace or ""):
-            with self._lock:
-                out = []
-                for (ns, _), obj in self._objects.get(kind, {}).items():
-                    if namespace is not None and ns != namespace:
-                        continue
-                    if not match_labels(obj.metadata.labels, label_selector):
-                        continue
-                    out.append(obj.deepcopy())
-                return sorted(out, key=lambda o: (o.namespace, o.name))
+            shard = self._shard(kind)
+            with shard.lock:
+                return self._list_locked(shard, namespace, label_selector)
+
+    @staticmethod
+    def _list_locked(shard: _KindShard, namespace: Optional[str],
+                     label_selector: Optional[dict[str, str]]
+                     ) -> list[KubeObject]:
+        out = []
+        for (ns, _), obj in shard.objects.items():
+            if namespace is not None and ns != namespace:
+                continue
+            if label_selector and not match_labels(
+                    obj.metadata.labels, label_selector):
+                continue
+            out.append(obj)
+        out.sort(key=lambda o: (o.namespace, o.name))
+        return out
 
     def list_with_rv(
         self,
@@ -372,22 +610,37 @@ class ApiServer:
     ) -> tuple[list[KubeObject], int]:
         """List + the cluster resourceVersion as one atomic snapshot, so a
         list-then-watch client cannot miss events that land between the list
-        and reading the rv (the apiserver returns both in one response)."""
-        with self._lock:
-            return self.list(kind, namespace, label_selector), self._rv_counter
+        and reading the rv (the apiserver returns both in one response).
+        Same read-only contract as list()."""
+        with self._fault_scope("list", kind, namespace or ""):
+            shard = self._shard(kind)
+            with shard.lock:
+                objs = self._list_locked(shard, namespace, label_selector)
+                with self._rv_lock:
+                    return objs, self._rv_counter
 
     # -- admission ------------------------------------------------------------
     def _admit(
         self, op: str, old: Optional[KubeObject], obj: KubeObject
     ) -> KubeObject:
+        # hooks receive private copies (old may be the frozen stored
+        # object; a hook must never be able to corrupt the store)
+        old_copy: Optional[KubeObject] = None
+
+        def old_for_hook() -> Optional[KubeObject]:
+            nonlocal old_copy
+            if old is not None and old_copy is None:
+                old_copy = old.deepcopy()
+            return old_copy
+
         for hook in self._mutating:
             if obj.kind in hook.kinds and op in hook.operations:
-                mutated = hook.handler(op, old, obj.deepcopy())
+                mutated = hook.handler(op, old_for_hook(), obj.deepcopy())
                 if mutated is not None:
                     obj = mutated
         for hook in self._validating:
             if obj.kind in hook.kinds and op in hook.operations:
-                hook.handler(op, old, obj.deepcopy())  # raises AdmissionDenied
+                hook.handler(op, old_for_hook(), obj.deepcopy())  # raises AdmissionDenied
         return obj
 
     # -- writes ---------------------------------------------------------------
@@ -398,10 +651,11 @@ class ApiServer:
 
     def _create(self, obj: KubeObject) -> KubeObject:
         obj = obj.deepcopy()
-        with self._lock:
-            if not obj.metadata.name and obj.metadata.generate_name:
+        if not obj.metadata.name and obj.metadata.generate_name:
+            with self._rv_lock:
                 self._name_counter += 1
-                obj.metadata.name = f"{obj.metadata.generate_name}{self._name_counter:05x}"
+                seq = self._name_counter
+            obj.metadata.name = f"{obj.metadata.generate_name}{seq:05x}"
         if not obj.metadata.name:
             raise InvalidError("metadata.name or generateName required")
         # admission OUTSIDE the store lock (as the apiserver runs webhook
@@ -409,10 +663,10 @@ class ApiServer:
         # re-enter this ApiServer from another thread.  Mutating hooks may
         # rewrite metadata, and the store must key the post-admission identity.
         obj = self._admit("CREATE", None, obj)
-        with self._lock:
-            key = (obj.metadata.namespace, obj.metadata.name)
-            kind_store = self._objects.setdefault(obj.kind, {})
-            if key in kind_store:
+        shard = self._shard(obj.kind)
+        key = (obj.metadata.namespace, obj.metadata.name)
+        with shard.lock:
+            if key in shard.objects:
                 raise AlreadyExistsError(
                     f"{obj.kind} {key[0]}/{key[1]} already exists"
                 )
@@ -420,15 +674,14 @@ class ApiServer:
             obj.metadata.resource_version = self._next_rv()
             obj.metadata.generation = 1
             obj.metadata.creation_timestamp = now_iso()
-            kind_store[key] = obj
-            stored = obj.deepcopy()
-        self._notify(WatchEvent(EventType.ADDED, stored))
+            shard.objects[key] = obj  # canonical: frozen from here on
+        self._notify(WatchEvent(EventType.ADDED, obj))
         # real k8s GC collects dependents whose owners are already gone (a
         # reconciler racing a cascade delete can create one — the GC's
         # attemptToDeleteItem handles exactly this); doing it synchronously
         # at create keeps the in-memory cluster deterministic
-        self._collect_dangling_owners(stored)
-        return stored
+        self._collect_dangling_owners(obj)
+        return obj.deepcopy()
 
     def _collect_dangling_owners(self, obj: KubeObject) -> None:
         """Strip ownerReferences whose owner no longer exists (by uid);
@@ -445,14 +698,16 @@ class ApiServer:
             except NotFoundError:
                 return  # someone else deleted it; done
             refs = current.metadata.owner_references
-            with self._lock:
-                live = [
-                    r for r in refs
-                    if (owner := self._objects.get(r.kind, {}).get(
-                        (current.namespace, r.name))) is not None
-                    and owner.metadata.uid == r.uid
-                    and owner.metadata.deletion_timestamp is None
-                ]
+            live = []
+            for r in refs:
+                owner_shard = self._shard(r.kind)
+                with owner_shard.lock:
+                    owner = owner_shard.objects.get(
+                        (current.namespace, r.name))
+                if owner is not None \
+                        and owner.metadata.uid == r.uid \
+                        and owner.metadata.deletion_timestamp is None:
+                    live.append(r)
             if len(live) == len(refs):
                 return
             try:
@@ -496,12 +751,12 @@ class ApiServer:
 
     def _update_once(self, obj: KubeObject, subresource: str) -> KubeObject:
         key = (obj.metadata.namespace, obj.metadata.name)
-        with self._lock:
-            kind_store = self._objects.setdefault(obj.kind, {})
-            old = kind_store.get(key)
+        shard = self._shard(obj.kind)
+        with shard.lock:
+            old = shard.objects.get(key)
             if old is None:
                 raise NotFoundError(f"{obj.kind} {key[0]}/{key[1]} not found")
-            old = old.deepcopy()
+        # `old` is the frozen canonical object — read-only from here on
         if not obj.metadata.resource_version:
             # real-apiserver semantics: an empty resourceVersion on update
             # means "no precondition" — the write replaces unconditionally
@@ -534,8 +789,8 @@ class ApiServer:
                 merged.metadata.generation = old.metadata.generation + 1
             else:
                 merged.metadata.generation = old.metadata.generation
-        with self._lock:
-            current = self._objects.get(obj.kind, {}).get(key)
+        with shard.lock:
+            current = shard.objects.get(key)
             if current is None:
                 raise NotFoundError(f"{obj.kind} {key[0]}/{key[1]} not found")
             if current.metadata.resource_version != old.metadata.resource_version:
@@ -550,16 +805,18 @@ class ApiServer:
             # no-op writes don't bump resourceVersion or wake watchers —
             # otherwise level-triggered loops (status sync) self-oscillate
             merged.metadata.resource_version = old.metadata.resource_version
-            if merged.to_dict() == old.to_dict():
+            merged.frozen = False
+            if merged.same_as(old):
                 return old.deepcopy()
             merged.metadata.resource_version = self._next_rv()
-            kind_store[key] = merged
-            stored = merged.deepcopy()
-        self._notify(WatchEvent(EventType.MODIFIED, stored, prev=old))
+            shard.objects[key] = merged  # canonical: frozen from here on
+        self._notify(WatchEvent(EventType.MODIFIED, merged, prev=old))
         # finalizer removal on a deleting object may complete the delete
-        if stored.metadata.deletion_timestamp and not stored.metadata.finalizers:
-            self._finalize_delete(stored.kind, stored.namespace, stored.name)
-        return stored
+        if merged.metadata.deletion_timestamp and not merged.metadata.finalizers:
+            self._finalize_delete(merged.kind, merged.namespace, merged.name)
+            # the caller's view: the object as this update committed it
+            return merged.deepcopy()
+        return merged.deepcopy()
 
     def update_status(self, obj: KubeObject) -> KubeObject:
         return self.update(obj, subresource="status")
@@ -604,6 +861,15 @@ class ApiServer:
         return self._patch_with_retry(
             kind, namespace, name, apply_smp, view_out, view_in)
 
+    @staticmethod
+    def _manifest_digest(applied: dict) -> str:
+        """Content digest of an apply manifest (canonical JSON), keying the
+        apply fast path."""
+        return hashlib.sha256(
+            json.dumps(applied, sort_keys=True,
+                       separators=(",", ":"),
+                       default=str).encode()).hexdigest()
+
     def apply(
         self, kind: str, namespace: str, name: str, applied: dict,
         field_manager: str, force: bool = False,
@@ -614,7 +880,14 @@ class ApiServer:
         owning managers in the message); same conflict retry and
         cross-version view hooks as the other patch verbs.
         `return_created=True` returns (obj, created) so the wire layer can
-        answer 201 for the create path without a racy pre-lookup."""
+        answer 201 for the create path without a racy pre-lookup.
+
+        Fast path: when this field manager's previous apply of this object
+        had the SAME manifest digest and the object still sits at the rv
+        that apply produced, the whole merge machinery is skipped — the
+        call is a proven no-op (a GitOps loop re-applying unchanged config
+        on a timer costs one dict lookup per tick).  Any other writer
+        bumping the object's rv invalidates the short-circuit."""
         with self._fault_scope("patch", kind, namespace, name):
             return self._apply(kind, namespace, name, applied, field_manager,
                                force, view_out, view_in, return_created)
@@ -635,6 +908,23 @@ class ApiServer:
             raise InvalidError("fieldManager is required for apply")
         api_version = applied.get("apiVersion", "")
         applied = sanitize_applied(applied)
+        # digest short-circuit (cross-version views excluded: the same
+        # manifest can mean different stored state per view route)
+        digest = ""
+        obj_key = (kind, namespace, name)
+        if view_out is None and view_in is None:
+            digest = self._manifest_digest(applied)
+            with self._apply_lock:
+                prior = self._applied_digests.get(
+                    obj_key, {}).get(field_manager)
+            if prior is not None and prior[0] == digest:
+                shard = self._shard(kind)
+                with shard.lock:
+                    cur = shard.objects.get((namespace, name))
+                    if cur is not None and \
+                            cur.metadata.resource_version == prior[1]:
+                        out = cur.deepcopy()
+                        return (out, False) if return_created else out
         last: Exception | None = None
         for _ in range(16):
             try:
@@ -658,6 +948,8 @@ class ApiServer:
                     obj = view_in(obj)
                 try:
                     created = self.create(obj)
+                    self._record_apply(obj_key, field_manager, digest,
+                                       created.metadata.resource_version)
                     return (created, True) if return_created else created
                 except AlreadyExistsError as err:
                     last = err
@@ -676,15 +968,18 @@ class ApiServer:
             if view_in is not None:
                 merged = view_in(merged)
             merged.metadata.resource_version = current.metadata.resource_version
-            if merged.to_dict() == current.to_dict():
+            if merged.same_as(current):
                 # semantic no-op apply (apply_update preserved the
                 # managedFields timestamp for the unchanged field set):
                 # skip the write path entirely — no admission callout, no
-                # RV bump, no watch wakeup.  A GitOps loop re-applying the
-                # same config on a timer costs the cluster nothing.
+                # RV bump, no watch wakeup.
+                self._record_apply(obj_key, field_manager, digest,
+                                   current.metadata.resource_version)
                 return (current, False) if return_created else current
             try:
                 updated = self.update(merged)
+                self._record_apply(obj_key, field_manager, digest,
+                                   updated.metadata.resource_version)
                 return (updated, False) if return_created else updated
             except ConflictError as err:
                 last = err
@@ -694,6 +989,14 @@ class ApiServer:
                 last = err
         assert last is not None
         raise last
+
+    def _record_apply(self, obj_key: tuple[str, str, str],
+                      field_manager: str, digest: str, rv: int) -> None:
+        if not digest:
+            return
+        with self._apply_lock:
+            self._applied_digests.setdefault(
+                obj_key, {})[field_manager] = (digest, rv)
 
     def json_patch(
         self, kind: str, namespace: str, name: str, ops: list,
@@ -756,35 +1059,44 @@ class ApiServer:
             self._delete(kind, namespace, name)
 
     def _delete(self, kind: str, namespace: str, name: str) -> None:
-        with self._lock:
-            obj = self._objects.get(kind, {}).get((namespace, name))
+        shard = self._shard(kind)
+        key = (namespace, name)
+        with shard.lock:
+            obj = shard.objects.get(key)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             if obj.metadata.finalizers:
                 if obj.metadata.deletion_timestamp is None:
-                    prev = obj.deepcopy()
-                    obj.metadata.deletion_timestamp = now_iso()
-                    obj.metadata.resource_version = self._next_rv()
-                    stored = obj.deepcopy()
+                    # replace, never mutate: `obj` is frozen shared state
+                    updated = obj.deepcopy()
+                    updated.metadata.deletion_timestamp = now_iso()
+                    updated.metadata.resource_version = self._next_rv()
+                    shard.objects[key] = updated
+                    prev = obj
                 else:
                     return  # already terminating
             else:
-                stored = None
-        if stored is not None:
-            self._notify(WatchEvent(EventType.MODIFIED, stored, prev=prev))
+                updated = None
+        if updated is not None:
+            self._notify(WatchEvent(EventType.MODIFIED, updated, prev=prev))
             return
         self._finalize_delete(kind, namespace, name)
 
     def _finalize_delete(self, kind: str, namespace: str, name: str) -> None:
-        with self._lock:
-            obj = self._objects.get(kind, {}).pop((namespace, name), None)
+        shard = self._shard(kind)
+        with shard.lock:
+            obj = shard.objects.pop((namespace, name), None)
             if obj is None:
                 return
             # deletion bumps the cluster resourceVersion (as in etcd) so the
-            # DELETED watch event is ordered in the history window
-            obj.metadata.resource_version = self._next_rv()
-        self._notify(WatchEvent(EventType.DELETED, obj.deepcopy()))
-        self._garbage_collect(obj)
+            # DELETED watch event is ordered in the history window; the
+            # popped canonical object stays untouched for anyone holding it
+            deleted = obj.deepcopy()
+            deleted.metadata.resource_version = self._next_rv()
+        with self._apply_lock:
+            self._applied_digests.pop((kind, namespace, name), None)
+        self._notify(WatchEvent(EventType.DELETED, deleted))
+        self._garbage_collect(deleted)
 
     def _garbage_collect(self, owner: KubeObject) -> None:
         """Background-cascade GC, matching real k8s semantics: drop the
@@ -792,9 +1104,11 @@ class ApiServer:
         owner is gone (same namespace only, as in real k8s GC)."""
         to_delete: list[tuple[str, str, str]] = []
         to_strip: list[KubeObject] = []
-        with self._lock:
-            for kind, kind_store in self._objects.items():
-                for (ns, name), obj in kind_store.items():
+        with self._shards_lock:
+            shards = list(self._shards.items())
+        for kind, shard in shards:
+            with shard.lock:
+                for (ns, name), obj in shard.objects.items():
                     if ns != owner.namespace:
                         continue
                     refs = obj.metadata.owner_references
@@ -825,11 +1139,13 @@ class ApiServer:
         self.update(obj)
 
     def dump(self) -> dict[str, list[dict]]:
-        with self._lock:
-            return {
-                kind: [o.to_dict() for o in store.values()]
-                for kind, store in self._objects.items()
-            }
+        with self._shards_lock:
+            shards = list(self._shards.items())
+        out: dict[str, list[dict]] = {}
+        for kind, shard in shards:
+            with shard.lock:
+                out[kind] = [o.to_dict() for o in shard.objects.values()]
+        return out
 
 
 def _json_merge(base: dict, patch: dict) -> dict:

@@ -835,7 +835,11 @@ class _WireHandler(BaseHTTPRequestHandler):
             events.put(ev)
 
         try:
-            self.api.subscribe(on_event, since_rv=since_rv)
+            # filtered at the dispatch index: this stream only ever costs
+            # the store a callback for events of its own kind/namespace
+            self.api.subscribe(on_event, since_rv=since_rv,
+                               kinds=[rt.info.kind],
+                               namespace=rt.namespace or None)
         except GoneError as err:
             self._send_error_status(err)
             return

@@ -234,11 +234,11 @@ def build_manager(
     `api` may be a KubeClient (real cluster) or None (in-memory standalone);
     both expose the same read/write/watch surface."""
     real_cluster = api is not None
+    core_cfg = core_cfg or CoreConfig.from_env()
     if api is None:
-        api = ApiServer()
+        api = ApiServer(history_size=core_cfg.watch_history_size)
     cluster = FakeCluster(api) if (with_fake_cluster and not real_cluster) else None
     mgr = Manager(api)
-    core_cfg = core_cfg or CoreConfig.from_env()
     odh_cfg = odh_cfg or OdhConfig.from_env()
     metrics = NotebookMetrics(api)
     # the fake cluster doubles as the warm-pool provisioner (cloud-provider

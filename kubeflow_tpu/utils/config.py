@@ -97,6 +97,12 @@ class CoreConfig:
     # parallel reconcile workers (controller-runtime MaxConcurrentReconciles
     # analog, shared across controllers): per-key serialization always holds
     workqueue_workers: int = 1              # WORKQUEUE_WORKERS
+    # per-kind watch-history ring size on the in-memory ApiServer
+    # (kube/store.py): each kind retains this many events for
+    # subscribe(since_rv) resume; a resume older than a kind's retained
+    # window gets 410 Gone and relists.  Sized per kind, so one chatty
+    # kind cannot evict another's resume window.
+    watch_history_size: int = 2048          # WATCH_HISTORY_SIZE
     # slice-atomic self-healing (core.selfheal): budgeted recovery of
     # disrupted TPU slices.  Backoff between slice restarts is exponential
     # (base * 2^n, capped); at most recovery_max_attempts restarts within a
@@ -158,6 +164,7 @@ class CoreConfig:
             workqueue_qps=float(_int(env, "WORKQUEUE_QPS", 10)),
             workqueue_burst=_int(env, "WORKQUEUE_BURST", 100),
             workqueue_workers=max(1, _int(env, "WORKQUEUE_WORKERS", 1)),
+            watch_history_size=max(1, _int(env, "WATCH_HISTORY_SIZE", 2048)),
             enable_self_healing=_bool(env, "ENABLE_SELF_HEALING", True),
             recovery_backoff_base_s=_float(
                 env, "RECOVERY_BACKOFF_BASE_S", 10.0),
