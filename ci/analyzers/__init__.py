@@ -1,0 +1,161 @@
+"""Repo-native invariant analyzers: `python -m ci.analyzers`.
+
+The fleet-scale control plane (PRs 5-8) rests on four contracts that were
+unwritten until each was violated once:
+
+  - **clock**: all time flows through `utils/clock.py` (`Clock`), so
+    FakeClock loadtests and soaks stay deterministic.  Direct
+    `time.time()`/`time.monotonic()`/`datetime.now()`/`time.sleep()`
+    calls outside the Clock are flagged (`clock_discipline`).
+  - **cow**: objects handed out by `list()`/`list_with_rv()`/`select()`/
+    `by_index()` are frozen shared snapshots; mutating one in place
+    without an intervening `.deepcopy()`/`get()` is the bug class PR 8
+    fixed by hand in three places (`cow_contract`).
+  - **locks**: the store/cluster/cache locks nest in one global order;
+    a static acquisition-order graph over `with <lock>` nesting must be
+    acyclic (`lock_order`).
+  - **hotpath**: reconciler/controller bodies read the InformerCache,
+    never `api.list()` — O(its objects) per reconcile, not O(cluster)
+    (`hot_path`).
+
+Same zero-dependency ethos as `ci/lint.py`: stdlib `ast` only, runs in
+the hermetic image.  Exceptions live in `allowlist.py` and every entry
+carries a reason string; an entry that matches nothing fails the run
+(stale exceptions are rot).  The runtime half of the gate is
+`kubeflow_tpu/utils/invariants.py` (INVARIANTS_STRICT=1 deep-freeze +
+lock tracking in the threaded suites); see docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent.parent
+TARGETS = ["kubeflow_tpu", "tests", "ci", "conformance", "examples",
+           "loadtest", "bench.py", "__graft_entry__.py"]
+
+
+@dataclass
+class Violation:
+    check: str      # analyzer id: clock | cow | locks | hotpath
+    path: str       # repo-relative posix path ("" for project-wide)
+    line: int
+    context: str    # enclosing qualname (or edge/cycle descriptor)
+    message: str
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}" if self.path else "(project)"
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{where}: {self.check}:{ctx} {self.message}"
+
+
+@dataclass
+class Module:
+    """One parsed source file, shared across analyzers (parse once)."""
+
+    path: Path
+    rel: str
+    src: str
+    tree: ast.AST
+    # lineno -> enclosing function/method qualname, filled lazily
+    _qualnames: dict = field(default_factory=dict)
+
+    def qualname_at(self, lineno: int) -> str:
+        if not self._qualnames:
+            self._index_qualnames()
+        best = ""
+        best_span = None
+        for (lo, hi), name in self._qualnames.items():
+            if lo <= lineno <= hi:
+                span = hi - lo
+                if best_span is None or span < best_span:
+                    best, best_span = name, span
+        return best
+
+    def _index_qualnames(self) -> None:
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qn = f"{prefix}.{child.name}" if prefix else child.name
+                    end = getattr(child, "end_lineno", child.lineno)
+                    if not isinstance(child, ast.ClassDef):
+                        self._qualnames[(child.lineno, end)] = qn
+                    walk(child, qn)
+                else:
+                    walk(child, prefix)
+
+        self._qualnames[(0, 0)] = ""  # sentinel so the index is non-empty
+        walk(self.tree, "")
+
+
+def dotted(node) -> str:
+    """Best-effort dotted rendering of a Name/Attribute chain
+    ('self.api.list'); '' for anything dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        return ""  # call in the chain: not a static path
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def iter_modules() -> list[Module]:
+    mods = []
+    for t in TARGETS:
+        p = ROOT / t
+        paths = [p] if p.is_file() else sorted(p.rglob("*.py")) \
+            if p.is_dir() else []
+        for path in paths:
+            src = path.read_text()
+            rel = path.relative_to(ROOT).as_posix()
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError:
+                continue  # ci/lint.py owns syntax failures
+            mods.append(Module(path, rel, src, tree))
+    return mods
+
+
+def run_all(modules=None) -> tuple[list[Violation], dict]:
+    """Run every analyzer; returns (unallowed violations, stats).
+    Allowlisted violations are filtered here; allowlist entries that
+    matched nothing come back as violations themselves."""
+    from . import allowlist, clock_discipline, cow_contract, hot_path, \
+        lock_order
+
+    if modules is None:
+        modules = iter_modules()
+    raw: list[Violation] = []
+    for mod in modules:
+        raw.extend(clock_discipline.analyze(mod))
+        raw.extend(cow_contract.analyze(mod))
+        raw.extend(hot_path.analyze(mod))
+    raw.extend(lock_order.analyze_project(modules))
+
+    kept, allowed, stale = allowlist.apply(
+        raw, scanned_paths=[m.rel for m in modules])
+    kept.extend(stale)
+    stats = {
+        "files": len(modules),
+        "violations": len(kept),
+        "allowed": len(allowed),
+    }
+    return kept, stats
+
+
+def main() -> int:
+    violations, stats = run_all()
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.check)):
+        print(v.render())
+    print(f"analyzers: {stats['files']} files, "
+          f"{stats['violations']} violations "
+          f"({stats['allowed']} allowlisted)")
+    return 1 if violations else 0

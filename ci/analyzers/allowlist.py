@@ -1,0 +1,136 @@
+"""Justified exceptions to the invariant analyzers.
+
+Every entry names the check, where it applies, and WHY the violation is
+intentional — an allowlist entry without a real reason is a bug filed
+against the author.  Matching:
+
+  - `path` is a repo-relative prefix ("tests/" covers the directory,
+    "kubeflow_tpu/kube/controller.py" one file);
+  - `context` matches the violation's enclosing qualname exactly, or
+    "*" for any context in the path (for lock cycles, the context is the
+    rendered cycle string).
+
+Entries that match nothing fail the run: stale exceptions rot into
+blanket ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import Violation
+
+
+@dataclass(frozen=True)
+class Allow:
+    check: str
+    path: str       # repo-relative path prefix
+    context: str    # exact qualname / cycle descriptor, or "*"
+    reason: str
+
+
+ALLOWLIST: tuple[Allow, ...] = (
+    # -- clock discipline ----------------------------------------------------
+    Allow("clock", "kubeflow_tpu/utils/clock.py", "*",
+          "the Clock abstraction itself — the one sanctioned home of "
+          "direct time calls"),
+    Allow("clock", "kubeflow_tpu/utils/tracing.py", "_now",
+          "documented fallback when no clock has been pinned via "
+          "set_clock(); every manager path pins one"),
+    Allow("clock", "kubeflow_tpu/kube/controller.py", "Manager._on_event",
+          "intentionally real monotonic: event-cause stamps measure true "
+          "wall latency so the fleet loadtest reports real p99 "
+          "event->reconcile-start even under FakeClock"),
+    Allow("clock", "kubeflow_tpu/kube/controller.py", "Manager._pop",
+          "pairs with the _on_event cause stamp (real wall latency "
+          "observation, not control logic)"),
+    Allow("clock", "kubeflow_tpu/kube/controller.py", "Manager._process_item",
+          "real monotonic attempt stamps feed "
+          "FlightRecorder.overlapping_attempts(), the per-key concurrency "
+          "audit — logical FakeClock time would alias attempts"),
+    Allow("clock", "kubeflow_tpu/kube/meta.py", "now_iso",
+          "creationTimestamp stamp at store commit; the store is "
+          "deliberately clockless and no control logic reads the stamp "
+          "back (culling reads annotations, which flow off the Clock)"),
+    Allow("clock", "kubeflow_tpu/tpu/device_plugin.py", "main",
+          "real kubelet-registration daemon retry loop on a real node — "
+          "there is no test timeline to keep deterministic"),
+    Allow("clock", "kubeflow_tpu/models/train.py", "timed_steps",
+          "measures real XLA step wall time (tokens/sec, MFU) — the "
+          "measurement IS the product"),
+    Allow("clock", "bench.py", "*",
+          "benchmark harness: real wall time is the reported metric"),
+    Allow("clock", "ci/", "*",
+          "decode/MFU sweep harnesses time real device execution"),
+    Allow("clock", "loadtest/", "*",
+          "loadtests report real wall throughput (reconciles/sec) "
+          "alongside the FakeClock logical timeline"),
+    Allow("clock", "conformance/behavior.py", "wait",
+          "polls a real external apiserver process for convergence"),
+    Allow("clock", "examples/", "*",
+          "examples drive real subprocesses/clusters and poll them on "
+          "the wall clock"),
+    Allow("clock", "tests/", "*",
+          "wall-clock deadlines around REAL threads (leader election, "
+          "wire servers, worker pools) — a FakeClock cannot advance "
+          "another thread's progress; logical-time tests already inject "
+          "FakeClock via fixtures"),
+    # -- COW / frozen contract -----------------------------------------------
+    Allow("cow", "tests/test_analyzers.py", "*",
+          "the sanitizer's own test suite seeds deliberate "
+          "mutate-after-list violations inside pytest.raises blocks to "
+          "prove strict mode raises"),
+    # -- lock discipline -----------------------------------------------------
+    Allow("locks", "kubeflow_tpu/kube/store.py",
+          "store.<instance>.lock->store.<instance>.lock",
+          "multi-shard acquisition in subscribe() takes sibling shard "
+          "locks in sorted-by-kind order under _shards_lock; the runtime "
+          "LockTracker enforces the rank order under INVARIANTS_STRICT"),
+    # -- hot-path scan ban ---------------------------------------------------
+    Allow("hotpath", "kubeflow_tpu/core/scheduler.py",
+          "SliceScheduler._inventory",
+          "TPUWarmPool claim bookkeeping needs read-your-writes "
+          "freshness for optimistic-concurrency claims, and pools are "
+          "O(shapes), not O(fleet) — a cache read would retry more, "
+          "not less"),
+)
+
+
+def apply(violations: list[Violation], scanned_paths=None
+          ) -> tuple[list[Violation], list[Violation], list[Violation]]:
+    """(kept, allowed, stale-entry violations).  `scanned_paths` (repo-
+    relative paths actually analyzed) scopes staleness: an entry whose
+    path prefix matches no scanned file targets a tree absent from this
+    reduced context (the Dockerfile build copies only kubeflow_tpu+ci)
+    and is skipped, not reported stale."""
+    used: set[Allow] = set()
+    kept: list[Violation] = []
+    allowed: list[Violation] = []
+    for v in violations:
+        hit = None
+        for entry in ALLOWLIST:
+            if entry.check != v.check:
+                continue
+            if not v.path.startswith(entry.path):
+                continue
+            if entry.context != "*" and entry.context != v.context:
+                continue
+            hit = entry
+            break
+        if hit is None:
+            kept.append(v)
+        else:
+            used.add(hit)
+            allowed.append(v)
+    stale = []
+    for entry in ALLOWLIST:
+        if entry in used:
+            continue
+        if scanned_paths is not None and \
+                not any(p.startswith(entry.path) for p in scanned_paths):
+            continue  # the entry's whole target tree was not scanned
+        stale.append(Violation(
+            "allowlist", entry.path, 0, entry.context,
+            f"stale allowlist entry for check {entry.check!r} "
+            f"(reason: {entry.reason}) matches no violation — remove it"))
+    return kept, allowed, stale

@@ -1,0 +1,78 @@
+"""Clock discipline: direct time reads/sleeps outside utils/clock.py.
+
+Everything that observes or spends time must go through an injected
+`Clock` (utils/clock.py) so FakeClock suites and loadtests control the
+timeline.  Flagged call forms (module aliases resolved per file):
+
+  - time.time() / time.monotonic() / time.monotonic_ns() /
+    time.perf_counter() / time.sleep()
+  - datetime.now() / datetime.utcnow() / date.today()
+    (datetime module or class spelling)
+  - argless time.gmtime() / time.localtime() (implicit "now" reads)
+
+`time.time` referenced WITHOUT a call (e.g. a `time_fn=time.time`
+injectable default) is deliberately not flagged — that is the injection
+idiom, not a hardwired read.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Module, Violation, dotted
+
+CHECK = "clock"
+
+_TIME_FNS = {"time", "monotonic", "monotonic_ns", "perf_counter", "sleep"}
+_IMPLICIT_NOW = {"gmtime", "localtime"}
+_DT_FNS = {"now", "utcnow", "today"}
+
+
+def _import_aliases(tree: ast.AST) -> tuple[set, set, set]:
+    """(names bound to the time module, names bound to the datetime
+    module, names bound to the datetime/date classes)."""
+    time_mods, dt_mods, dt_classes = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_mods.add(a.asname or "time")
+                elif a.name == "datetime":
+                    dt_mods.add(a.asname or "datetime")
+        elif isinstance(node, ast.ImportFrom) and node.module == "datetime":
+            for a in node.names:
+                if a.name in ("datetime", "date"):
+                    dt_classes.add(a.asname or a.name)
+    return time_mods, dt_mods, dt_classes
+
+
+def analyze(mod: Module) -> list[Violation]:
+    time_mods, dt_mods, dt_classes = _import_aliases(mod.tree)
+    if not (time_mods or dt_mods or dt_classes):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        func = node.func
+        recv = dotted(func.value)
+        attr = func.attr
+        flagged = None
+        if recv in time_mods:
+            if attr in _TIME_FNS:
+                flagged = f"{recv}.{attr}()"
+            elif attr in _IMPLICIT_NOW and not node.args \
+                    and not node.keywords:
+                flagged = f"{recv}.{attr}() with no argument (implicit now)"
+        elif attr in _DT_FNS:
+            if recv in dt_classes or \
+                    any(recv in (f"{m}.datetime", f"{m}.date")
+                        for m in dt_mods):
+                flagged = f"{recv}.{attr}()"
+        if flagged:
+            out.append(Violation(
+                CHECK, mod.rel, node.lineno, mod.qualname_at(node.lineno),
+                f"direct time call {flagged} — route through the injected "
+                "Clock (utils/clock.py) or allowlist with a reason"))
+    return out

@@ -1,0 +1,278 @@
+"""COW/frozen contract: in-place mutation of shared store snapshots.
+
+`api.list()` / `list_with_rv()` / `cache.list()` / `select()` /
+`by_index()` return the frozen committed objects themselves (PR 8's
+zero-copy read path).  Mutating one corrupts every other reader's view
+and defeats no-op write suppression — the exact bug class PR 8 fixed by
+hand in events.py, notebook_controller.py and cluster.py.
+
+Intraprocedural taint dataflow, deliberately conservative:
+
+  - a name bound from a freezing call is **container-tainted** (the
+    returned list is a private container holding SHARED objects —
+    sorting/appending the list itself is fine);
+  - iterating or subscripting a container-tainted name yields
+    **object-tainted** names; attribute/subscript paths off an
+    object-tainted name (``labels = o.metadata.labels``) stay tainted;
+  - flagged: assignment/del/augassign through a path rooted at an
+    object-tainted name, mutator method calls (.append/.update/
+    .setdefault/.pop/...) on such a path, and mutations reaching an
+    element THROUGH a container (``objs[0].status[...] = x``);
+  - any rebind through a call (``o = o.deepcopy()``, ``o = api.get(...)``)
+    clears the taint — deepcopy/get are the sanctioned escape hatches.
+
+Receivers considered freezing: a dotted chain ending in api/cache/
+store/reader/client (``self.api.list``, ``cache.select``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Module, Violation, dotted
+
+CHECK = "cow"
+
+_FREEZING_METHODS = {"list", "list_with_rv", "select", "by_index"}
+_API_RECEIVERS = {"api", "cache", "store", "reader", "client"}
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault", "pop",
+             "popitem", "clear", "remove", "sort", "reverse", "add",
+             "discard"}
+_SEQ_WRAPPERS = {"sorted", "list", "reversed", "tuple"}
+
+
+def _is_freezing_call(node) -> str:
+    """'' or the method name when `node` is a frozen-snapshot read."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _FREEZING_METHODS):
+        return ""
+    recv = dotted(node.func.value)
+    if recv and recv.split(".")[-1].lower() in _API_RECEIVERS:
+        return node.func.attr
+    return ""
+
+
+def _root_name(node):
+    """Root ast.Name of an Attribute/Subscript chain, with the step kinds
+    walked ('attr'/'sub'), outermost last.  None root for dynamic."""
+    steps = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            steps.append("attr")
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            steps.append("sub")
+            node = node.value
+        else:
+            break
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(steps))
+    return None, []
+
+
+class _FunctionChecker:
+    def __init__(self, mod: Module, qualname: str):
+        self.mod = mod
+        self.qualname = qualname
+        self.containers: set[str] = set()
+        self.objects: set[str] = set()
+        self.out: list[Violation] = []
+
+    # -- taint computation ---------------------------------------------------
+    def _value_taint(self, value) -> str:
+        """'container' | 'object' | '' for an RHS expression."""
+        if _is_freezing_call(value):
+            return "container"
+        if isinstance(value, ast.Name):
+            if value.id in self.containers:
+                return "container"
+            if value.id in self.objects:
+                return "object"
+            return ""
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+                and value.func.id in _SEQ_WRAPPERS and value.args:
+            if self._value_taint(value.args[0]) == "container":
+                return "container"
+            return ""
+        if isinstance(value, ast.Subscript):
+            inner = self._value_taint(value.value)
+            if inner == "container":
+                return "object"   # element extraction
+            if inner == "object":
+                return "object"   # subtree of a shared object
+            return ""
+        if isinstance(value, ast.Attribute):
+            root, _ = _root_name(value)
+            if root in self.objects:
+                return "object"   # subtree handle (o.metadata.labels)
+            return ""
+        return ""
+
+    def _bind(self, target, taint: str) -> None:
+        if isinstance(target, ast.Name):
+            self.containers.discard(target.id)
+            self.objects.discard(target.id)
+            if taint == "container":
+                self.containers.add(target.id)
+            elif taint == "object":
+                self.objects.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # only list_with_rv-style unpack taints: (objs, rv) = ...
+            for el in target.elts:
+                self._bind(el, "")
+
+    def _flag(self, node, what: str) -> None:
+        self.out.append(Violation(
+            CHECK, self.mod.rel, node.lineno, self.qualname,
+            f"{what} mutates a frozen shared snapshot from "
+            "list()/list_with_rv()/select()/by_index() — deepcopy() or "
+            "get() a private copy first"))
+
+    def _check_mutation_path(self, node, what: str) -> bool:
+        """True when `node` (an Attribute/Subscript path) reaches shared
+        state: rooted at an object-tainted name, or passing through an
+        element of a container-tainted name."""
+        root, steps = _root_name(node)
+        if root is None:
+            return False
+        if root in self.objects:
+            self._flag(node, what)
+            return True
+        # objs[0].status[...] — through-the-container element mutation:
+        # the first step subscripts the container and the path continues
+        if root in self.containers and len(steps) >= 2 and steps[0] == "sub":
+            self._flag(node, what)
+            return True
+        return False
+
+    # -- statement walk (source order, unions across branches) ---------------
+    def run(self, body) -> None:
+        self._visit_body(body)
+
+    def _visit_body(self, stmts) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            taint = self._value_taint(stmt.value)
+            tuple_src = isinstance(stmt.value, ast.Call) and \
+                isinstance(stmt.value.func, ast.Attribute) and \
+                stmt.value.func.attr == "list_with_rv" and \
+                _is_freezing_call(stmt.value)
+            for target in stmt.targets:
+                if isinstance(target, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation_path(target, "assignment")
+                    self._scan_expr(target.value)
+                elif tuple_src and isinstance(target, (ast.Tuple, ast.List)) \
+                        and target.elts:
+                    # objs, rv = api.list_with_rv(...): first element is
+                    # the frozen container
+                    self._bind(target.elts[0], "container")
+                    for el in target.elts[1:]:
+                        self._bind(el, "")
+                else:
+                    self._bind(target, taint)
+        elif isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value)
+            if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                self._check_mutation_path(stmt.target, "augmented assignment")
+            elif isinstance(stmt.target, ast.Name):
+                self._bind(stmt.target, "")
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+                if isinstance(stmt.target, ast.Name):
+                    self._bind(stmt.target, self._value_taint(stmt.value))
+                elif isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation_path(stmt.target, "assignment")
+        elif isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation_path(target, "del")
+        elif isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            taint = self._value_taint(stmt.iter)
+            self._bind(stmt.target,
+                       "object" if taint == "container" else "")
+            # two passes: taint introduced late in the body applies to
+            # earlier statements on the next iteration
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            self._visit_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._visit_body(stmt.body)
+            for h in stmt.handlers:
+                self._visit_body(h.body)
+            self._visit_body(stmt.orelse)
+            self._visit_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # nested scopes are analyzed separately
+        # everything else: no taint effect
+
+    def _scan_expr(self, expr) -> None:
+        """Find mutator-method calls on tainted paths anywhere in an
+        expression (comprehension bodies included, with their loop vars
+        tainted when iterating a tainted source)."""
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                                 ast.DictComp)):
+                for gen in node.generators:
+                    if self._value_taint(gen.iter) == "container" and \
+                            isinstance(gen.target, ast.Name):
+                        self.objects.add(gen.target.id)
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS):
+                continue
+            recv = node.func.value
+            if isinstance(recv, (ast.Attribute, ast.Subscript)):
+                self._check_mutation_path(
+                    recv, f".{node.func.attr}() call")
+            elif isinstance(recv, ast.Name) and recv.id in self.objects:
+                self._flag(node, f".{node.func.attr}() call")
+
+
+def analyze(mod: Module) -> list[Violation]:
+    out: list[Violation] = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                checker = _FunctionChecker(mod, qn)
+                checker.run(child.body)
+                # the loop-body double pass can report a site twice
+                seen = {(v.line, v.message) for v in out}
+                for v in checker.out:
+                    if (v.line, v.message) not in seen:
+                        seen.add((v.line, v.message))
+                        out.append(v)
+                walk(child, qn)
+            elif isinstance(child, ast.ClassDef):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                walk(child, qn)
+            else:
+                walk(child, prefix)
+
+    walk(mod.tree, "")
+    return out

@@ -1,0 +1,108 @@
+"""Hot-path scan ban: reconciler bodies read the cache, not api.list.
+
+PR 5 moved every reconcile hot path onto the InformerCache so one event
+costs O(its objects), not O(cluster); PR 8's 10k-notebook gate depends
+on it.  This analyzer flags `api.list(...)` / `api.list_with_rv(...)` /
+`api.select(...)` calls (receiver chain ending in `.api`) inside methods
+of reconciler-shaped classes (name ending in Reconciler / Controller /
+Manager / Scheduler) UNLESS the call sits under an `if`/ternary whose
+test mentions the cache — the sanctioned cache-less fallback pattern:
+
+    if self.cache is not None:
+        return self.cache.select(...)
+    return self.api.list(...)
+
+Anything else is either a real regression (fix it) or a justified
+exception (allowlist it with the reason).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Module, Violation, dotted
+
+CHECK = "hotpath"
+
+_CLASS_SUFFIXES = ("Reconciler", "Controller", "Manager", "Scheduler")
+_SCAN_METHODS = {"list", "list_with_rv", "select"}
+
+
+def _mentions_cache(test) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and "cache" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "cache" in node.attr.lower():
+            return True
+    return False
+
+
+def analyze(mod: Module) -> list[Violation]:
+    if not mod.rel.startswith("kubeflow_tpu/"):
+        return []
+    out = []
+
+    def scan_class(cls: ast.ClassDef, prefix: str):
+        qn = f"{prefix}.{cls.name}" if prefix else cls.name
+        # parent chain per node so we can look for cache-guarded ancestors
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(cls):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        # early-return guard regions: after `if <cache...>: ... return`,
+        # the rest of the block IS the cache-less fallback
+        guarded_lines: set[int] = set()
+        for parent in ast.walk(cls):
+            body = getattr(parent, "body", None)
+            for block in (body, getattr(parent, "orelse", None)):
+                if not isinstance(block, list):
+                    continue
+                for i, stmt in enumerate(block):
+                    if isinstance(stmt, ast.If) \
+                            and _mentions_cache(stmt.test) \
+                            and stmt.body \
+                            and isinstance(stmt.body[-1],
+                                           (ast.Return, ast.Raise)):
+                        for later in block[i + 1:]:
+                            end = getattr(later, "end_lineno", later.lineno)
+                            guarded_lines.update(
+                                range(later.lineno, end + 1))
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SCAN_METHODS):
+                continue
+            recv = dotted(node.func.value)
+            if not recv or recv.split(".")[-1] != "api":
+                continue
+            guarded = node.lineno in guarded_lines
+            cur = node
+            while not guarded and cur in parents:
+                cur = parents[cur]
+                if isinstance(cur, (ast.If, ast.IfExp)) and \
+                        _mentions_cache(cur.test):
+                    guarded = True
+                    break
+                if isinstance(cur, ast.ClassDef):
+                    break
+            if guarded:
+                continue
+            out.append(Violation(
+                CHECK, mod.rel, node.lineno, mod.qualname_at(node.lineno),
+                f"{recv}.{node.func.attr}() inside {cls.name} — hot paths "
+                "read the InformerCache (cache.list/select/by_index); "
+                "guard an intentional fallback on cache availability or "
+                "allowlist with a reason"))
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                if child.name.endswith(_CLASS_SUFFIXES):
+                    scan_class(child, prefix)
+                walk(child, f"{prefix}.{child.name}" if prefix
+                     else child.name)
+            else:
+                walk(child, prefix)
+
+    walk(mod.tree, "")
+    return out

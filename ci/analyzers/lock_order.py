@@ -1,0 +1,305 @@
+"""Lock discipline: the static acquisition-order graph must be acyclic.
+
+Builds a directed graph over `with <lock>` nesting in the concurrency
+core (kube/store.py, kube/cluster.py, kube/cache.py, kube/controller.py):
+an edge A -> B means "B was acquired while A was held".  Nesting is
+tracked through:
+
+  - literal `with self._x_lock:` statements (a with-item whose last
+    attribute matches lock/mutex naming);
+  - `ExitStack.enter_context(<lock>)` calls (the store's sorted
+    multi-shard acquisition), held for the rest of the enclosing block;
+  - one-level-and-transitive call propagation: a call to a same-module
+    function/method (`self.f()`, bare `f()`) or to the known cross-module
+    receivers (`self.api.*` -> ApiServer, `self.cache.*` ->
+    InformerCache) under a held lock contributes every lock the callee
+    (transitively) acquires.
+
+Lock identity is (module, class, attr) — `self._lock` in Manager and in
+BucketRateLimiter are distinct nodes; non-self receivers (`shard.lock`)
+fold to (module, '', attr), which conservatively merges all instances of
+a shard-style lock into one node.  A cycle — including the self-edge
+from nested same-class acquisition — fails unless allowlisted with a
+reason (the runtime LockTracker then enforces the documented rank
+order).  Dynamic dispatch (watch callbacks) is out of static reach; the
+INVARIANTS_STRICT LockTracker covers it at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Module, Violation, dotted
+
+CHECK = "locks"
+
+#: modules the lock graph is built over (repo-relative posix paths)
+LOCK_MODULES = (
+    "kubeflow_tpu/kube/store.py",
+    "kubeflow_tpu/kube/cluster.py",
+    "kubeflow_tpu/kube/cache.py",
+    "kubeflow_tpu/kube/controller.py",
+)
+
+#: cross-module receiver resolution: attribute name -> class the object
+#: is an instance of (kept in sync with the constructor wiring)
+_RECEIVER_CLASSES = {
+    "api": "ApiServer",
+    "cache": "InformerCache",
+    "cluster": "FakeCluster",
+}
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _short(rel: str) -> str:
+    return rel.rsplit("/", 1)[-1].removesuffix(".py")
+
+
+def _is_lock_expr(expr) -> bool:
+    if isinstance(expr, ast.Attribute):
+        last = expr.attr.lower()
+    elif isinstance(expr, ast.Name):
+        last = expr.id.lower()
+    else:
+        return False
+    return any(p in last for p in _LOCKISH)
+
+
+class _ModuleGraph:
+    """Per-project lock graph builder."""
+
+    def __init__(self, modules: dict[str, Module]):
+        self.modules = modules
+        # (module, cls, fn) -> list of (held_locks_tuple, lock_node)
+        self.acquisitions: dict[tuple, list] = {}
+        # (module, cls, fn) -> list of (held_locks_tuple, callee_key)
+        self.calls: dict[tuple, list] = {}
+        # function key -> set of lock nodes acquired directly
+        self.direct: dict[tuple, set] = {}
+        self.classes: dict[str, set[tuple]] = {}  # ClassName -> {fn keys}
+        self.sites: dict[tuple, tuple] = {}       # edge -> (rel, line)
+
+    def _lock_node(self, expr, module: str, cls: str) -> tuple:
+        path = dotted(expr)
+        if path.startswith("self."):
+            return (module, cls, path.split(".")[-1])
+        return (module, "", path.split(".")[-1] if path else "<dynamic>")
+
+    def _callee_key(self, call, module: str, cls: str):
+        func = call.func
+        if isinstance(func, ast.Name):
+            return (module, "", func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = dotted(func.value)
+        if recv == "self":
+            return (module, cls, func.attr)
+        last = recv.split(".")[-1] if recv else ""
+        target_cls = _RECEIVER_CLASSES.get(last)
+        if target_cls is not None:
+            owner = self._class_module(target_cls)
+            if owner is not None:
+                return (owner, target_cls, func.attr)
+        return None
+
+    def _class_module(self, cls: str):
+        for rel, mod in self.modules.items():
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name == cls:
+                    return _short(rel)
+        return None
+
+    # -- per-function traversal ----------------------------------------------
+    def scan_function(self, mod: Module, cls: str, fn: ast.FunctionDef):
+        module = _short(mod.rel)
+        key = (module, cls, fn.name)
+        self.acquisitions.setdefault(key, [])
+        self.calls.setdefault(key, [])
+        self.direct.setdefault(key, set())
+        self.classes.setdefault(cls, set()).add(key)
+        self._visit_body(mod, key, fn.body, ())
+
+    def _record_acquire(self, mod, key, held, node_expr, lineno,
+                        in_loop=False):
+        module, cls, _ = key
+        lock = self._lock_node(node_expr, module, cls)
+        self.acquisitions[key].append((held, lock, mod.rel, lineno))
+        if in_loop:
+            # an acquisition inside a loop re-acquires the same lock
+            # class on the next pass while instances from earlier passes
+            # are still held — a self-edge the order contract must cover
+            self.acquisitions[key].append(((lock,), lock, mod.rel, lineno))
+        self.direct[key].add(lock)
+        return held + (lock,)
+
+    def _visit_body(self, mod, key, stmts, held, in_loop=False):
+        for stmt in stmts:
+            held = self._visit_stmt(mod, key, stmt, held, in_loop)
+
+    def _visit_stmt(self, mod, key, stmt, held, in_loop=False):
+        """Returns the held set for SUBSEQUENT statements in the same
+        block (grows on enter_context acquisitions)."""
+        if isinstance(stmt, ast.With):
+            inner = held
+            for item in stmt.items:
+                if _is_lock_expr(item.context_expr):
+                    inner = self._record_acquire(
+                        mod, key, inner, item.context_expr, stmt.lineno)
+                else:
+                    self._scan_calls(mod, key, item.context_expr, inner)
+            self._visit_body(mod, key, stmt.body, inner, in_loop)
+            return held
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return held  # nested scope: scanned separately
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) \
+                and isinstance(stmt.value.func, ast.Attribute) \
+                and stmt.value.func.attr == "enter_context" \
+                and stmt.value.args \
+                and _is_lock_expr(stmt.value.args[0]):
+            return self._record_acquire(
+                mod, key, held, stmt.value.args[0], stmt.lineno,
+                in_loop=in_loop)
+        # compound statements: recurse into bodies with the current held
+        loops = isinstance(stmt, (ast.For, ast.While))
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub:
+                self._visit_body(mod, key, sub, held, in_loop or loops)
+        for h in getattr(stmt, "handlers", ()) or ():
+            self._visit_body(mod, key, h.body, held, in_loop)
+        # expressions hanging off this statement: record calls under held
+        for attr in ("value", "test", "iter", "targets"):
+            sub = getattr(stmt, attr, None)
+            if sub is None:
+                continue
+            for node in sub if isinstance(sub, list) else [sub]:
+                if isinstance(node, ast.AST):
+                    self._scan_calls(mod, key, node, held)
+        return held
+
+    def _scan_calls(self, mod, key, expr, held):
+        module, cls, _ = key
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                callee = self._callee_key(node, module, cls)
+                if callee is not None:
+                    self.calls[key].append((held, callee))
+
+    # -- propagation + cycle check -------------------------------------------
+    def edges(self) -> tuple[dict, dict]:
+        # transitive lock footprint per function
+        footprint = {k: set(v) for k, v in self.direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, calls in self.calls.items():
+                for _, callee in calls:
+                    extra = footprint.get(callee)
+                    if extra and not extra <= footprint[key]:
+                        footprint[key] |= extra
+                        changed = True
+        graph: dict[tuple, set[tuple]] = {}
+        sites: dict[tuple, tuple] = {}
+
+        def add_edge(a, b, rel, line):
+            if a == b:
+                pass  # self-edges recorded too (multi-instance nesting)
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+            sites.setdefault((a, b), (rel, line))
+        for key, acqs in self.acquisitions.items():
+            for held, lock, rel, line in acqs:
+                for h in held:
+                    add_edge(h, lock, rel, line)
+        for key, calls in self.calls.items():
+            for held, callee in calls:
+                if not held:
+                    continue
+                for lock in footprint.get(callee, ()):
+                    for h in held:
+                        add_edge(h, lock, "", 0)
+        return graph, sites
+
+
+def _render(node: tuple) -> str:
+    module, cls, attr = node
+    return f"{module}.{cls or '<instance>'}.{attr}"
+
+
+def _find_cycles(graph: dict) -> list[list]:
+    """Every elementary cycle is overkill; report one cycle per SCC with
+    size > 1, plus self-edges."""
+    cycles = []
+    for a, succs in sorted(graph.items()):
+        if a in succs:
+            cycles.append([a, a])
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    cycles.extend(sccs)
+    return cycles
+
+
+def analyze_project(modules) -> list[Violation]:
+    by_rel = {m.rel: m for m in modules if m.rel in LOCK_MODULES}
+    if not by_rel:
+        return []
+    g = _ModuleGraph(by_rel)
+    for rel, mod in sorted(by_rel.items()):
+        def scan(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    g.scan_function(mod, cls, child)
+                    scan(child, cls)
+                elif isinstance(child, ast.ClassDef):
+                    scan(child, child.name)
+                else:
+                    scan(child, cls)
+        scan(mod.tree, "")
+    graph, sites = g.edges()
+    out = []
+    for cycle in _find_cycles(graph):
+        if len(cycle) > 2 or cycle[0] != cycle[-1]:
+            cycle = cycle + [cycle[0]]  # close the loop for readability
+        desc = "->".join(_render(n) for n in cycle)
+        rel, line = "", 0
+        for a, b in zip(cycle, cycle[1:]):
+            if (a, b) in sites and sites[(a, b)][0]:
+                rel, line = sites[(a, b)]
+                break
+        out.append(Violation(
+            CHECK, rel, line, desc,
+            f"lock acquisition-order cycle: {desc} — a consistent global "
+            "order is required (see ARCHITECTURE.md lock ordering)"))
+    return out

@@ -32,14 +32,20 @@ SEED="${CHAOS_SOAK_SEED:-20260804}"
 # hold identically in threaded mode, and the soaks additionally assert no
 # per-key concurrent reconcile via the flight recorder's overlap check
 WORKERS="${WORKQUEUE_WORKERS:-8}"
+# the soaks run with the runtime concurrency sanitizer ON: committed
+# store snapshots are deep-frozen (a mutate-after-list raises at the
+# mutation site with the active trace id) and every store/cluster/cache
+# lock is order-tracked (an inversion raises instead of deadlocking) —
+# utils/invariants.py, docs/STATIC_ANALYSIS.md
+STRICT="${INVARIANTS_STRICT:-1}"
 if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} workers=${WORKERS} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
     SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" MIGRATE_SOAK_ROUNDS="$MIGRATE_ROUNDS" \
-    WORKQUEUE_WORKERS="$WORKERS" \
+    WORKQUEUE_WORKERS="$WORKERS" INVARIANTS_STRICT="$STRICT" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
       tests/test_chaos.py::TestSliceRecoverySoak \
       tests/test_chaos.py::TestMigrationRecoverySoak -q "$@"; then

@@ -16,6 +16,10 @@ that needs no third-party tooling so the gate also runs in hermetic images:
     names (conservative: undecorated plain functions without *args /
     **kwargs only) — the locally-runnable slice of what mypy's
     call-checking provides
+  - plain class-method call signatures, same conservative rules: when a
+    local variable is bound from a direct constructor call and never
+    rebound, its method calls (and the constructor call itself, against
+    __init__) are arity/keyword-checked against the exact class
   - Prometheus metric naming conventions at registration sites
     (`.counter("...")` / `.gauge("...")` / `.histogram("...")` calls):
     a `*_total` name must register a counter, and a `*_seconds` name a
@@ -187,12 +191,34 @@ def check_metric_names(tree: ast.AST) -> list[tuple[int, str]]:
     return problems
 
 
-def _collect_signatures() -> dict:
-    """module path ('kubeflow_tpu.models.generate') -> {fn_name: spec}
-    for CHECKABLE module-level functions: no decorators, no *args /
-    **kwargs, not nested.  spec = (min_pos, max_pos, kwonly_required,
-    all_kw_names)."""
+def _fn_spec(node: "ast.FunctionDef", drop_self: bool = False):
+    """(min_pos, max_pos, kwonly_required, all_kw_names, pos_names) for a
+    CHECKABLE function: no decorators, no *args / **kwargs (the caller
+    filters); drop_self strips the bound first arg for methods."""
+    a = node.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    if drop_self and pos:
+        pos = pos[1:]
+    n_default = len(a.defaults)
+    kwonly = [p.arg for p in a.kwonlyargs]
+    kwonly_required = {
+        p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+        if d is None}
+    return (len(pos) - n_default, len(pos),
+            kwonly_required, set(pos) | set(kwonly), pos)
+
+
+def _collect_signatures() -> tuple[dict, dict]:
+    """(module_sigs, method_sigs):
+    module_sigs: module path ('kubeflow_tpu.models.generate') ->
+    {fn_name: spec} for CHECKABLE module-level functions: no decorators,
+    no *args / **kwargs, not nested.
+    method_sigs: module path -> {ClassName: {method_name: spec}} for
+    plain instance methods under the same conservative rules (self
+    dropped from the spec; staticmethod/classmethod/property carry
+    decorators, so they are excluded by the no-decorator rule)."""
     sigs: dict[str, dict] = {}
+    method_sigs: dict[str, dict] = {}
     pkg = ROOT / "kubeflow_tpu"
     for path in sorted(pkg.rglob("*.py")):
         rel = path.relative_to(ROOT).with_suffix("")
@@ -204,24 +230,70 @@ def _collect_signatures() -> dict:
         except SyntaxError:
             continue
         table = {}
+        classes: dict[str, dict] = {}
         for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                methods = {}
+                for item in node.body:
+                    if not isinstance(item, ast.FunctionDef) \
+                            or item.decorator_list:
+                        continue
+                    a = item.args
+                    if a.vararg or a.kwarg:
+                        continue
+                    if not (a.posonlyargs + a.args) or \
+                            (a.posonlyargs + a.args)[0].arg != "self":
+                        continue
+                    methods[item.name] = _fn_spec(item, drop_self=True)
+                if methods:
+                    classes[node.name] = methods
+                continue
             if not isinstance(node, ast.FunctionDef) or node.decorator_list:
                 continue
             a = node.args
             if a.vararg or a.kwarg:
                 continue
-            pos = [p.arg for p in a.posonlyargs + a.args]
-            n_default = len(a.defaults)
-            kwonly = [p.arg for p in a.kwonlyargs]
-            kwonly_required = {
-                p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
-                if d is None}
-            table[node.name] = (len(pos) - n_default, len(pos),
-                                kwonly_required, set(pos) | set(kwonly),
-                                pos)
+            table[node.name] = _fn_spec(node)
         if table:
             sigs[module] = table
-    return sigs
+        if classes:
+            method_sigs[module] = classes
+    return sigs, method_sigs
+
+
+def _check_callsite(name: str, spec, node: "ast.Call"):
+    """Shared arity/keyword validation for one call site against a spec
+    from _fn_spec.  Returns [(line, msg), ...]."""
+    problems = []
+    min_pos, max_pos, kwonly_required, all_kw, pos_names = spec
+    if any(isinstance(a, ast.Starred) for a in node.args) or \
+            any(k.arg is None for k in node.keywords):
+        return problems  # *args / **kwargs at the call site: not checkable
+    n_pos = len(node.args)
+    kw_names = {k.arg for k in node.keywords}
+    if n_pos > max_pos:
+        problems.append(
+            (node.lineno,
+             f"call to {name}(): {n_pos} positional args, "
+             f"definition takes at most {max_pos}"))
+    if n_pos + len(kw_names & set(pos_names)) < min_pos:
+        problems.append(
+            (node.lineno,
+             f"call to {name}(): too few arguments "
+             f"(needs {min_pos} required positional)"))
+    unknown = kw_names - all_kw
+    if unknown:
+        problems.append(
+            (node.lineno,
+             f"call to {name}(): unknown keyword(s) "
+             f"{sorted(unknown)}"))
+    missing = kwonly_required - kw_names
+    if missing:
+        problems.append(
+            (node.lineno,
+             f"call to {name}(): missing required keyword-only "
+             f"arg(s) {sorted(missing)}"))
+    return problems
 
 
 class CallChecker(ast.NodeVisitor):
@@ -265,47 +337,159 @@ class CallChecker(ast.NodeVisitor):
         spec = self.targets.get(node.func.id)
         if spec is None:
             return
-        name, (min_pos, max_pos, kwonly_required, all_kw, pos_names) = spec
-        if any(isinstance(a, ast.Starred) for a in node.args) or \
-                any(k.arg is None for k in node.keywords):
-            return  # *args / **kwargs at the call site: not checkable
-        n_pos = len(node.args)
-        kw_names = {k.arg for k in node.keywords}
-        if n_pos > max_pos:
-            self.problems.append(
-                (node.lineno,
-                 f"call to {name}(): {n_pos} positional args, "
-                 f"definition takes at most {max_pos}"))
-        if n_pos + len(kw_names & set(pos_names)) < min_pos:
-            self.problems.append(
-                (node.lineno,
-                 f"call to {name}(): too few arguments "
-                 f"(needs {min_pos} required positional)"))
-        unknown = kw_names - all_kw
-        if unknown:
-            self.problems.append(
-                (node.lineno,
-                 f"call to {name}(): unknown keyword(s) "
-                 f"{sorted(unknown)}"))
-        missing = kwonly_required - kw_names
-        if missing:
-            self.problems.append(
-                (node.lineno,
-                 f"call to {name}(): missing required keyword-only "
-                 f"arg(s) {sorted(missing)}"))
+        name, sig = spec
+        self.problems.extend(_check_callsite(name, sig, node))
 
 
-def check_calls(path: Path, sigs: dict, tree: ast.AST) -> list[str]:
+class MethodCallChecker:
+    """Arity checking for PLAIN CLASS METHODS, the class-method analog of
+    CallChecker.  The exact class of a receiver is only known statically
+    when the variable was bound from a direct constructor call in the
+    SAME scope (`mgr = Manager(...)` ... `mgr.start(...)`) and never
+    rebound in between — so that's precisely what gets checked, plus the
+    constructor call itself against `__init__`.  Same conservative rules
+    as the function checker: undecorated classes, undecorated methods
+    with a literal `self` first arg, no *args/**kwargs on either side.
+    Method lookup is exact-class only (no MRO walk): a method the class
+    inherits is skipped, and subclass overrides can't mislead because
+    the constructor names the exact class."""
+
+    def __init__(self, method_sigs: dict, tree: ast.AST, path: Path):
+        self.problems: list[tuple[int, str]] = []
+        # class name visible in this file -> {method: spec}
+        self.classes: dict[str, dict] = {}
+        for node in tree.body:
+            if not isinstance(node, ast.ImportFrom) or not node.module:
+                continue
+            table = method_sigs.get(node.module)
+            if table is None and node.level:
+                cands = [m for m in method_sigs
+                         if m.endswith("." + node.module)]
+                table = method_sigs[cands[0]] if len(cands) == 1 else None
+            if not table:
+                continue
+            for alias in node.names:
+                if alias.name in table:
+                    self.classes[alias.asname or alias.name] = \
+                        table[alias.name]
+        # classes defined in THIS file (any target dir, tests included)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and not node.decorator_list:
+                methods = {}
+                for item in node.body:
+                    if not isinstance(item, ast.FunctionDef) \
+                            or item.decorator_list:
+                        continue
+                    a = item.args
+                    if a.vararg or a.kwarg:
+                        continue
+                    if not (a.posonlyargs + a.args) or \
+                            (a.posonlyargs + a.args)[0].arg != "self":
+                        continue
+                    methods[item.name] = _fn_spec(item, drop_self=True)
+                if methods:
+                    self.classes[node.name] = methods
+
+    def check(self, tree: ast.AST) -> None:
+        self._check_scope(tree.body)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_scope(node.body)
+
+    def _check_scope(self, body) -> None:
+        bindings: dict[str, tuple[str, dict]] = {}  # var -> (cls, methods)
+        self._walk(body, bindings)
+
+    def _walk(self, stmts, bindings) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                bindings.pop(stmt.name, None)
+                continue  # nested scope: checked on its own
+            self._scan_calls(stmt, bindings)
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._rebind(t, stmt.value, bindings)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(stmt.target, ast.Name):
+                    bindings.pop(stmt.target.id, None)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._invalidate(stmt.target, bindings)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        self._invalidate(item.optional_vars, bindings)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    self._invalidate(t, bindings)
+            # recurse into compound bodies with the same binding map
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and isinstance(sub, list):
+                    self._walk(sub, bindings)
+            for h in getattr(stmt, "handlers", ()) or ():
+                if h.name:
+                    bindings.pop(h.name, None)
+                self._walk(h.body, bindings)
+
+    def _rebind(self, target, value, bindings) -> None:
+        if not isinstance(target, ast.Name):
+            self._invalidate(target, bindings)
+            return
+        cls = None
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+            cls = value.func.id if value.func.id in self.classes else None
+        if cls is not None:
+            bindings[target.id] = (cls, self.classes[cls])
+        else:
+            bindings.pop(target.id, None)
+
+    def _invalidate(self, target, bindings) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                bindings.pop(node.id, None)
+
+    def _scan_calls(self, stmt, bindings) -> None:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # constructor arity against __init__
+            if isinstance(func, ast.Name) and func.id in self.classes:
+                init = self.classes[func.id].get("__init__")
+                if init is not None:
+                    self.problems.extend(
+                        _check_callsite(func.id, init, node))
+            # bound-method call on a constructor-typed local
+            if isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name):
+                bound = bindings.get(func.value.id)
+                if bound is None:
+                    continue
+                cls, methods = bound
+                spec = methods.get(func.attr)
+                if spec is None:
+                    continue  # inherited or dynamic: out of scope
+                self.problems.extend(_check_callsite(
+                    f"{cls}.{func.attr}", spec, node))
+
+
+def check_calls(path: Path, sigs: dict, method_sigs: dict,
+                tree: ast.AST) -> list[str]:
     rel = path.relative_to(ROOT)
     checker = CallChecker(sigs, tree)
     checker.visit(tree)
-    return [f"{rel}:{line}: {msg}" for line, msg in checker.problems]
+    problems = list(checker.problems)
+    mchecker = MethodCallChecker(method_sigs, tree, path)
+    mchecker.check(tree)
+    problems.extend(mchecker.problems)
+    return [f"{rel}:{line}: {msg}" for line, msg in sorted(problems)]
 
 
 def main() -> int:
     failures = []
     count = 0
-    sigs = _collect_signatures()
+    sigs, method_sigs = _collect_signatures()
     for path in iter_files():
         count += 1
         try:
@@ -316,7 +500,7 @@ def main() -> int:
                             f"syntax error: {err.msg}")
             continue
         failures.extend(check(path, tree))
-        failures.extend(check_calls(path, sigs, tree))
+        failures.extend(check_calls(path, sigs, method_sigs, tree))
     for f in failures:
         print(f)
     print(f"lint: {count} files, {len(failures)} problems")

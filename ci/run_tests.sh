@@ -19,6 +19,11 @@ done
 set -- ${FILTERED+"${FILTERED[@]}"}
 
 python ci/lint.py
+# invariant analyzers (ci/analyzers): clock discipline, COW/frozen
+# contract, lock-order graph, hot-path scan ban — zero unexplained
+# violations; exceptions live in ci/analyzers/allowlist.py with reasons
+# (docs/STATIC_ANALYSIS.md)
+python -m ci.analyzers
 if command -v ruff >/dev/null 2>&1; then
   RUFF="ruff"
 elif python -c "import ruff" 2>/dev/null; then

@@ -52,6 +52,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..utils import invariants
 from .errors import GoneError
 from .meta import KubeObject
 from .store import EventType, WatchEvent, match_labels
@@ -74,7 +75,8 @@ class InformerCache:
 
     def __init__(self, api, registry=None) -> None:
         self.api = api
-        self._lock = threading.Lock()
+        self._lock = invariants.tracked(
+            threading.Lock(), "InformerCache._lock")
         # kind -> (namespace, name) -> KubeObject
         self._objects: dict[str, dict[tuple[str, str], KubeObject]] = {}
         self._primed: set[str] = set()
@@ -103,7 +105,8 @@ class InformerCache:
         self.drops = 0
         self.relists = 0
         self.last_rv = 0
-        self._conn_lock = threading.Lock()
+        self._conn_lock = invariants.tracked(
+            threading.Lock(), "InformerCache._conn_lock")
         # kinds this cache asked the store to stream (grown lazily; only
         # meaningful on the filtered in-memory backend)
         self._watched: set[str] = set()

@@ -16,6 +16,9 @@ import ipaddress
 import os
 import ssl
 from dataclasses import dataclass
+from typing import Optional
+
+from ..utils.clock import Clock
 
 
 @dataclass
@@ -67,13 +70,17 @@ def mint_serving_cert(
     dns_names: tuple[str, ...] = ("localhost",),
     ip_addresses: tuple[str, ...] = ("127.0.0.1",),
     valid_days: int = 7,
+    clock: Optional[Clock] = None,
 ) -> CertBundle:
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.x509.oid import NameOID
 
-    now = datetime.datetime.now(datetime.timezone.utc)
+    # injected clock: cert validity anchors to the caller's time source
+    # (a real Clock in production; tests can mint from a FakeClock)
+    now = datetime.datetime.fromtimestamp(
+        (clock or Clock()).now(), datetime.timezone.utc)
     not_after = now + datetime.timedelta(days=valid_days)
 
     ca_key = ec.generate_private_key(ec.SECP256R1())

@@ -22,7 +22,6 @@ import socket
 import ssl
 import tempfile
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 from urllib.parse import urlencode, urlsplit
@@ -62,6 +61,7 @@ _ERR_BY_CODE = {
 
 
 # canonical namespace detection lives in utils.config (odh main.go:127-139)
+from ..utils.clock import Clock  # noqa: E402
 from ..utils.config import detect_namespace  # noqa: E402  (re-export)
 
 
@@ -131,13 +131,17 @@ class RestConfig:
 
 
 class RateLimiter:
-    """Token bucket — client-go's flowcontrol.NewTokenBucketRateLimiter."""
+    """Token bucket — client-go's flowcontrol.NewTokenBucketRateLimiter.
+    Time flows through the injected Clock (clock discipline): a real Clock
+    sleeps; a FakeClock advances, so tests never block."""
 
-    def __init__(self, qps: float, burst: int) -> None:
+    def __init__(self, qps: float, burst: int,
+                 clock: Optional[Clock] = None) -> None:
         self.qps = qps
         self.burst = max(burst, 1)
+        self.clock = clock or Clock()
         self._tokens = float(self.burst)
-        self._last = time.monotonic()
+        self._last = self.clock.monotonic()
         self._lock = threading.Lock()
 
     def acquire(self) -> None:
@@ -145,7 +149,7 @@ class RateLimiter:
             return
         while True:
             with self._lock:
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 self._tokens = min(self.burst,
                                    self._tokens + (now - self._last) * self.qps)
                 self._last = now
@@ -153,7 +157,7 @@ class RateLimiter:
                     self._tokens -= 1
                     return
                 wait = (1 - self._tokens) / self.qps
-            time.sleep(wait)
+            self.clock.sleep(wait)
 
 
 @dataclass

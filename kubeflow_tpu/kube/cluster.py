@@ -17,6 +17,7 @@ import copy
 import threading
 from typing import Optional
 
+from ..utils import invariants
 from .errors import NotFoundError
 from .meta import KubeObject, ObjectMeta, set_controller_reference
 from .store import ApiServer, EventType, WatchEvent
@@ -92,7 +93,8 @@ class FakeCluster:
         # the kubelet's maps must see those deliveries one at a time
         # (reentrant: handlers issue writes whose events nest on the same
         # thread)
-        self._mutex = threading.RLock()
+        self._mutex = invariants.tracked(
+            threading.RLock(), "FakeCluster._mutex")
         # the data plane only reacts to these kinds — register filtered so
         # Notebook/Service/Event churn never reaches it
         api.watch(self._on_event,

@@ -28,7 +28,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
-from ..utils import tracing
+from ..utils import invariants, tracing
 from ..utils.clock import Clock
 from ..utils.flightrecorder import FlightRecorder
 from ..utils.metrics import Registry
@@ -159,7 +159,8 @@ class ItemExponentialBackoff:
         self.jitter = jitter
         self._rng = random.Random(seed)
         self._failures: dict[object, int] = {}
-        self._lock = threading.Lock()
+        self._lock = invariants.tracked(
+            threading.Lock(), "ItemExponentialBackoff._lock")
 
     def when(self, item) -> float:
         with self._lock:
@@ -191,7 +192,8 @@ class BucketRateLimiter:
         self.clock = clock or Clock()
         self._tokens = float(self.burst)
         self._last = self.clock.now()
-        self._lock = threading.Lock()
+        self._lock = invariants.tracked(
+            threading.Lock(), "BucketRateLimiter._lock")
 
     def when(self, item) -> float:
         if self.qps <= 0:
@@ -348,7 +350,8 @@ class Manager:
         self.flight_recorder = flight_recorder or FlightRecorder()
         self._limiter = rate_limiter or default_rate_limiter(self.clock)
         self._registrations: list[_Registration] = []
-        self._lock = threading.Lock()
+        self._lock = invariants.tracked(
+            threading.Lock(), "Manager._lock")
         # per-controller FIFO deques, popped round-robin (fairness across
         # registrations); _queued is the dirty set — the single source of
         # truth for "this key has pending work"

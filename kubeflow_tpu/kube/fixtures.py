@@ -90,7 +90,8 @@ class FixtureRunner:
 
     def __init__(self, server: str, token: str = "",
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 timeout_s: float = 10.0, real: bool = False) -> None:
+                 timeout_s: float = 10.0, real: bool = False,
+                 clock=None) -> None:
         self.server = server.rstrip("/")
         self.token = token
         self.ctx = ssl_context
@@ -98,6 +99,12 @@ class FixtureRunner:
         # real=True: the target is a genuine apiserver — steps with an
         # `expect_real` block assert it instead of `expect`
         self.real = real
+        # retry pacing (clock discipline: a FakeClock makes retry loops
+        # instant in tests; a real Clock sleeps between attempts)
+        if clock is None:
+            from ..utils.clock import Clock
+            clock = Clock()
+        self.clock = clock
 
     # -- transport ------------------------------------------------------------
     def _request(self, method: str, path: str, body: Any = None,
@@ -167,17 +174,15 @@ class FixtureRunner:
         """One step, with optional retry_s — real-cluster effects the
         in-memory store applies synchronously (GC cascades, finalizer
         completion) are asynchronous on a genuine apiserver."""
-        import time
-
-        deadline = time.monotonic() + float(step.get("retry_s", 0))
+        deadline = self.clock.monotonic() + float(step.get("retry_s", 0))
         while True:
             try:
                 self._attempt_step(fixture, idx, step, variables)
                 return
             except FixtureFailure:
-                if time.monotonic() >= deadline:
+                if self.clock.monotonic() >= deadline:
                     raise
-                time.sleep(0.25)
+                self.clock.sleep(0.25)
 
     def _attempt_step(self, fixture: dict, idx: int, step: dict,
                       variables: dict[str, Any]) -> None:

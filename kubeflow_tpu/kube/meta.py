@@ -23,6 +23,16 @@ def now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+def _empty_view() -> dict:
+    """Empty spec/status view of a frozen object: plain dict normally, a
+    mutation-trapping FrozenDict under INVARIANTS_STRICT."""
+    from ..utils import invariants
+
+    if invariants.strict_enabled():
+        return invariants.EMPTY_FROZEN_DICT
+    return {}
+
+
 def copy_tree(x):
     """Deep copy of a JSON-shaped tree (dicts/lists/scalars).
 
@@ -179,10 +189,12 @@ class KubeObject:
     def spec(self) -> dict:
         # a frozen (shared) object must not grow a skeleton key from a
         # mere read — return an empty view instead of mutating the body
+        # (under INVARIANTS_STRICT a trapping view, so a write to the
+        # empty view raises instead of silently vanishing)
         s = self.body.get("spec")
         if s is None:
             if self.frozen:
-                return {}
+                return _empty_view()
             s = self.body.setdefault("spec", {})
         return s
 
@@ -195,7 +207,7 @@ class KubeObject:
         s = self.body.get("status")
         if s is None:
             if self.frozen:
-                return {}
+                return _empty_view()
             s = self.body.setdefault("status", {})
         return s
 

@@ -49,6 +49,7 @@ from typing import Callable, Iterable, Optional
 
 from collections import deque
 
+from ..utils import invariants
 from .errors import (
     AlreadyExistsError,
     ConflictError,
@@ -57,7 +58,7 @@ from .errors import (
     InvalidError,
     NotFoundError,
 )
-from .meta import KubeObject, new_uid, now_iso
+from .meta import KubeObject, copy_tree, new_uid, now_iso
 
 DEFAULT_WATCH_HISTORY_SIZE = 2048
 
@@ -145,8 +146,12 @@ class _KindShard:
 
     __slots__ = ("lock", "objects", "history", "floor")
 
-    def __init__(self, history_size: int) -> None:
-        self.lock = threading.RLock()
+    def __init__(self, history_size: int, kind: str = "") -> None:
+        # rank = kind: under INVARIANTS_STRICT the LockTracker enforces
+        # that multi-shard acquisition (subscribe replay) follows the
+        # documented sorted-by-kind order
+        self.lock = invariants.tracked(
+            threading.RLock(), "ApiServer.shard.lock", rank=kind)
         self.objects: dict[tuple[str, str], KubeObject] = {}
         self.history: deque[WatchEvent] = deque(maxlen=history_size)
         # resourceVersions <= the floor have been evicted from this kind's
@@ -172,18 +177,25 @@ class ApiServer:
     def __init__(self, history_size: Optional[int] = None) -> None:
         self.history_size = history_size if history_size is not None \
             else _default_history_size()
+        # INVARIANTS_STRICT=1: commit-time deep-freeze + lock-order
+        # tracking (utils.invariants); read once — the strict suites set
+        # the env var before constructing the ApiServer
+        self._strict = invariants.strict_enabled()
         # kind -> shard (object map + history ring, per-kind lock)
         self._shards: dict[str, _KindShard] = {}
-        self._shards_lock = threading.RLock()
+        self._shards_lock = invariants.tracked(
+            threading.RLock(), "ApiServer._shards_lock")
         # rv/name counters (globally ordered; own lock so a shard-lock
         # holder can allocate without touching other shards)
-        self._rv_lock = threading.Lock()
+        self._rv_lock = invariants.tracked(
+            threading.Lock(), "ApiServer._rv_lock")
         self._rv_counter = 0
         self._name_counter = 0
         # watcher registry + per-kind dispatch index.  Lock ordering:
         # _shards_lock > shard.lock (sorted by kind) > _watch_lock; the
         # rv/audit locks are leaves and never acquire anything.
-        self._watch_lock = threading.RLock()
+        self._watch_lock = invariants.tracked(
+            threading.RLock(), "ApiServer._watch_lock")
         self._watch_entries: list[_WatchEntry] = []
         self._kind_index: dict[str, list[_WatchEntry]] = {}
         self._unfiltered: list[_WatchEntry] = []
@@ -201,7 +213,8 @@ class ApiServer:
         # bounded audit trail of top-level client writes (AuditRecord);
         # shares the depth gate with fault injection, so only controller
         # traffic is recorded — never the store's own re-entry
-        self._audit_lock = threading.Lock()
+        self._audit_lock = invariants.tracked(
+            threading.Lock(), "ApiServer._audit_lock")
         self._audit_log: deque[AuditRecord] = deque(maxlen=8192)
         # per-(verb, kind) counters over ALL top-level client verbs, reads
         # included (the audit log keeps write detail; these stay O(verbs x
@@ -209,7 +222,8 @@ class ApiServer:
         self._verb_counts: dict[tuple[str, str], int] = {}
         # apply fast path: (kind, ns, name) -> field_manager ->
         # (manifest digest, resulting rv); see apply()
-        self._apply_lock = threading.Lock()
+        self._apply_lock = invariants.tracked(
+            threading.Lock(), "ApiServer._apply_lock")
         self._applied_digests: dict[
             tuple[str, str, str], dict[str, tuple[str, int]]] = {}
 
@@ -218,7 +232,8 @@ class ApiServer:
         with self._shards_lock:
             shard = self._shards.get(kind)
             if shard is None:
-                shard = self._shards[kind] = _KindShard(self.history_size)
+                shard = self._shards[kind] = _KindShard(
+                    self.history_size, kind)
             return shard
 
     # -- fault injection ------------------------------------------------------
@@ -515,6 +530,12 @@ class ApiServer:
         ev.obj.frozen = True
         if ev.prev is not None:
             ev.prev.frozen = True
+        if self._strict:
+            # mutation-trapping wrappers over the shared trees: any
+            # escaped write raises at the mutation site (utils.invariants)
+            invariants.deep_freeze(ev.obj)
+            if ev.prev is not None:
+                invariants.deep_freeze(ev.prev)
         shard = self._shard(kind)
         with shard.lock:
             hist = shard.history
@@ -770,12 +791,15 @@ class ApiServer:
             )
         if subresource == "status":
             merged = old.deepcopy()
-            merged.body["status"] = copy.deepcopy(obj.body.get("status", {}))
+            merged.body["status"] = copy_tree(obj.body.get("status", {}))
         else:
             merged = obj
             # status writes only through the status subresource
+            # (copy_tree, not copy.deepcopy: the latter would preserve the
+            # strict-mode FrozenDict wrappers of `old` into a private
+            # object that must stay mutable)
             if "status" in old.body:
-                merged.body["status"] = copy.deepcopy(old.body["status"])
+                merged.body["status"] = copy_tree(old.body["status"])
             elif "status" in merged.body:
                 del merged.body["status"]
             # admission outside the lock (see create()); the commit below

@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
+from ..utils.clock import Clock
 from .errors import (
     ApiError,
     GoneError,
@@ -179,6 +180,9 @@ class _WireHandler(BaseHTTPRequestHandler):
     api: ApiServer = None  # type: ignore[assignment]
     scheme: Scheme = None  # type: ignore[assignment]
     token: Optional[str] = None
+    # injectable time source for audit-trail timestamps (clock discipline:
+    # the wire layer never reads the wall clock directly)
+    clock: Clock = Clock()
     # multi-version kinds: (obj_dict, desired_apiVersion) -> obj_dict.  A
     # real apiserver calls the CRD's conversion webhook here; wiring a
     # RemoteConverter (odh/webhook_server.py) reproduces that callout.
@@ -208,7 +212,8 @@ class _WireHandler(BaseHTTPRequestHandler):
         if self._audit_fh is None:
             return
         line = json.dumps({
-            "ts": datetime.now(timezone.utc).isoformat(),
+            "ts": datetime.fromtimestamp(
+                self.clock.now(), timezone.utc).isoformat(),
             "verb": self.command,
             "path": self.path,
             "code": int(code) if str(code).isdigit() else str(code),
@@ -941,13 +946,15 @@ class KubeApiWireServer:
                  host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None,
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 converter=None, audit_log: Optional[str] = None) -> None:
+                 converter=None, audit_log: Optional[str] = None,
+                 clock: Optional[Clock] = None) -> None:
         self.api = api
         # audit_log: path for a JSONL request trail (ts/verb/path/code) —
         # the debugging knob envtest exposes via the apiserver audit log
         self._audit_fh = open(audit_log, "a") if audit_log else None
         handler = type("Handler", (_WireHandler,), {
             "api": api, "scheme": scheme or DEFAULT_SCHEME, "token": token,
+            "clock": clock or Clock(),
             "converter": staticmethod(converter) if converter else None,
             "_audit_fh": self._audit_fh,
             "_audit_lock": threading.Lock() if audit_log else None,

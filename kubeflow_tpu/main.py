@@ -28,7 +28,6 @@ import logging
 import os
 import socket
 import threading
-import time
 from typing import Optional
 
 from .api.types import Notebook, TPUSpec
@@ -36,6 +35,7 @@ from .core.culling_controller import setup_culling
 from .core.metrics import NotebookMetrics
 from .core.notebook_controller import setup_core_controllers
 from .kube import ApiServer, FakeCluster, LeaderElector, Manager
+from .utils.clock import Clock
 from .utils.config import CoreConfig, OdhConfig
 
 
@@ -466,12 +466,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         )
         nb = Notebook.new("demo", "default", tpu=tpu)
         api.create(nb.obj)
-        deadline = time.time() + 10
-        while time.time() < deadline:
+        wall = Clock()  # real polling wait on the threaded manager
+        deadline = wall.now() + 10
+        while wall.now() < deadline:
             live = api.try_get("Notebook", "default", "demo")
             if live and live.body.get("status", {}).get("sliceHealth") == "Healthy":
                 break
-            time.sleep(0.05)
+            wall.sleep(0.05)
         live = api.get("Notebook", "default", "demo")
         print(json.dumps(live.body.get("status", {}), indent=2))
 

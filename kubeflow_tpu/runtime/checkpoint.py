@@ -219,8 +219,10 @@ class CullSignalWatcher:
     completion marker the culling controller's checkpoint gate polls for
     (core/culling_controller.py)."""
 
-    def __init__(self, signal_dir: str = DEFAULT_SIGNAL_DIR):
+    def __init__(self, signal_dir: str = DEFAULT_SIGNAL_DIR,
+                 time_fn: Callable[[], float] = time.time):
         self.signal_dir = Path(signal_dir)
+        self.time_fn = time_fn  # same injectable idiom as CheckpointSidecar
 
     def check(self) -> bool:
         req = self.signal_dir / REQUEST_FILE
@@ -231,7 +233,7 @@ class CullSignalWatcher:
 
     def acknowledge(self) -> None:
         self.signal_dir.mkdir(parents=True, exist_ok=True)
-        (self.signal_dir / ACK_FILE).write_text(str(time.time()))
+        (self.signal_dir / ACK_FILE).write_text(str(self.time_fn()))
 
 
 def checkpoint_on_cull(

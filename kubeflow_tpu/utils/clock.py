@@ -10,11 +10,24 @@ import time
 
 
 class Clock:
+    """The ONE sanctioned home of direct time calls (ci/analyzers clock
+    discipline): everything else takes an injected Clock so FakeClock
+    tests stay deterministic."""
+
     def now(self) -> float:
         return time.time()
 
     def now_iso(self) -> str:
         return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self.now()))
+
+    def monotonic(self) -> float:
+        """Monotonic reading for interval arithmetic (rate limiters,
+        retry deadlines) — never compared against now()."""
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
 
 
 class FakeClock(Clock):
@@ -23,6 +36,15 @@ class FakeClock(Clock):
 
     def now(self) -> float:
         return self._now
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        # a FakeClock sleep advances logical time instead of blocking, so
+        # code routed through Clock.sleep is instant and deterministic
+        if seconds > 0:
+            self.advance(seconds)
 
     def advance(self, seconds: float) -> None:
         self._now += seconds

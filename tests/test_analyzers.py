@@ -1,0 +1,422 @@
+"""Invariant analyzer suite (ci/analyzers) + runtime sanitizer
+(utils/invariants): every static check catches its seeded violation and
+passes the clean twin; strict mode deep-freezes committed snapshots and
+the LockTracker raises on a seeded inversion; the gate itself runs clean
+on the repo."""
+
+import ast
+import threading
+from pathlib import Path
+
+import pytest
+
+from ci.analyzers import (
+    Module,
+    clock_discipline,
+    cow_contract,
+    hot_path,
+    lock_order,
+    run_all,
+)
+from ci.analyzers.allowlist import Allow
+from ci.analyzers import allowlist as allowlist_mod
+from kubeflow_tpu.kube.meta import KubeObject, ObjectMeta
+from kubeflow_tpu.kube.store import ApiServer
+from kubeflow_tpu.utils import invariants, tracing
+from kubeflow_tpu.utils.invariants import (
+    FrozenMutationError,
+    LockInversionError,
+    LockTracker,
+    TrackedLock,
+)
+
+
+def mod(src: str, rel: str = "kubeflow_tpu/fixture.py") -> Module:
+    return Module(Path(rel), rel, src, ast.parse(src))
+
+
+def nb(name="n", ns="d", spec=None):
+    return KubeObject("kubeflow.org/v1", "Notebook",
+                      ObjectMeta(name=name, namespace=ns),
+                      body={"spec": dict(spec or {"image": "x"})})
+
+
+# ---------------------------------------------------------------------------
+# clock discipline
+# ---------------------------------------------------------------------------
+
+class TestClockAnalyzer:
+    def test_direct_calls_flagged(self):
+        src = (
+            "import time\n"
+            "import datetime\n"
+            "def f():\n"
+            "    a = time.time()\n"
+            "    time.sleep(1)\n"
+            "    b = time.monotonic()\n"
+            "    c = datetime.datetime.now()\n"
+            "    return a, b, c\n")
+        v = clock_discipline.analyze(mod(src))
+        assert len(v) == 4
+        assert all(x.check == "clock" for x in v)
+        assert v[0].context == "f"
+
+    def test_alias_imports_resolved(self):
+        src = (
+            "import time as _t\n"
+            "from datetime import datetime as dt\n"
+            "def f():\n"
+            "    return _t.time(), dt.utcnow()\n")
+        assert len(clock_discipline.analyze(mod(src))) == 2
+
+    def test_argless_gmtime_is_an_implicit_now(self):
+        src = "import time\ndef f():\n    return time.gmtime()\n"
+        assert len(clock_discipline.analyze(mod(src))) == 1
+        # with an argument it converts a timestamp: no time read
+        src = "import time\ndef f(t):\n    return time.gmtime(t)\n"
+        assert clock_discipline.analyze(mod(src)) == []
+
+    def test_clean_twin_injected_clock(self):
+        src = (
+            "def f(clock):\n"
+            "    clock.sleep(1)\n"
+            "    return clock.now()\n")
+        assert clock_discipline.analyze(mod(src)) == []
+
+    def test_injectable_default_reference_not_flagged(self):
+        # time_fn=time.time is the injection idiom, not a hardwired read
+        src = (
+            "import time\n"
+            "def f(time_fn=time.time):\n"
+            "    return time_fn()\n")
+        assert clock_discipline.analyze(mod(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# COW / frozen contract
+# ---------------------------------------------------------------------------
+
+class TestCowAnalyzer:
+    @pytest.mark.parametrize("body", [
+        # the PR 8 bug class, in its observed shapes
+        "for o in api.list('Pod'):\n        o.metadata.labels['a'] = 'b'",
+        "objs = api.list('Pod')\n    objs[0].spec['x'] = 1",
+        "objs, rv = api.list_with_rv('Pod')\n"
+        "    del objs[0].body['spec']",
+        "for o in cache.select('Pod', None, {}):\n"
+        "        o.status.setdefault('conditions', [])",
+        "for o in cache.by_index('Pod', 'ns', 'd'):\n"
+        "        o.body['status'].update({'k': 1})",
+        "for o in api.list('Pod'):\n"
+        "        ann = o.metadata.annotations\n"
+        "        ann['k'] = 'v'",
+        "for o in sorted(api.list('Pod')):\n        o.spec['x'] += 1",
+    ])
+    def test_seeded_violation_caught(self, body):
+        src = f"def f(api, cache):\n    {body}\n"
+        v = cow_contract.analyze(mod(src))
+        assert len(v) >= 1 and all(x.check == "cow" for x in v)
+
+    @pytest.mark.parametrize("body", [
+        # deepcopy/get are the sanctioned escape hatches
+        "for o in api.list('Pod'):\n"
+        "        o = o.deepcopy()\n"
+        "        o.metadata.labels['a'] = 'b'",
+        "for o in api.list('Pod'):\n"
+        "        fresh = api.get('Pod', o.namespace, o.name)\n"
+        "        fresh.spec['x'] = 1",
+        # mutating your own list container is fine — the OBJECTS are shared
+        "objs = api.list('Pod')\n    objs.sort()\n    objs.append(None)",
+        "objs = api.list('Pod')\n    objs[0] = None",
+        # reads don't taint
+        "names = [o.name for o in api.list('Pod')]\n    names.append('x')",
+    ])
+    def test_clean_twin_passes(self, body):
+        src = f"def f(api, cache):\n    {body}\n"
+        assert cow_contract.analyze(mod(src)) == []
+
+    def test_rebind_clears_taint(self):
+        src = (
+            "def f(api):\n"
+            "    o = api.list('Pod')\n"
+            "    o = {}\n"
+            "    o['x'] = 1\n")
+        assert cow_contract.analyze(mod(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# lock order
+# ---------------------------------------------------------------------------
+
+_STORE_REL = "kubeflow_tpu/kube/store.py"
+
+
+class TestLockAnalyzer:
+    def test_seeded_inversion_cycle(self):
+        src = (
+            "class A:\n"
+            "    def f(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self._b_lock:\n"
+            "            with self._a_lock:\n"
+            "                pass\n")
+        v = lock_order.analyze_project([mod(src, _STORE_REL)])
+        assert len(v) == 1 and v[0].check == "locks"
+        assert "_a_lock" in v[0].context and "_b_lock" in v[0].context
+
+    def test_clean_consistent_order(self):
+        src = (
+            "class A:\n"
+            "    def f(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self._a_lock:\n"
+            "            pass\n")
+        assert lock_order.analyze_project([mod(src, _STORE_REL)]) == []
+
+    def test_cycle_through_call_propagation(self):
+        src = (
+            "class A:\n"
+            "    def f(self):\n"
+            "        with self._a_lock:\n"
+            "            self.h()\n"
+            "    def g(self):\n"
+            "        with self._b_lock:\n"
+            "            self.i()\n"
+            "    def h(self):\n"
+            "        with self._b_lock:\n"
+            "            pass\n"
+            "    def i(self):\n"
+            "        with self._a_lock:\n"
+            "            pass\n")
+        v = lock_order.analyze_project([mod(src, _STORE_REL)])
+        assert len(v) == 1
+
+    def test_loop_enter_context_self_edge(self):
+        src = (
+            "from contextlib import ExitStack\n"
+            "class A:\n"
+            "    def f(self, shards):\n"
+            "        with ExitStack() as stack:\n"
+            "            for s in shards:\n"
+            "                stack.enter_context(s.lock)\n")
+        v = lock_order.analyze_project([mod(src, _STORE_REL)])
+        assert len(v) == 1 and "lock->" in v[0].context
+
+    def test_real_repo_graph_is_acyclic_modulo_allowlist(self):
+        violations, _ = run_all()
+        assert [v for v in violations if v.check == "locks"] == []
+
+
+# ---------------------------------------------------------------------------
+# hot-path scan ban
+# ---------------------------------------------------------------------------
+
+class TestHotPathAnalyzer:
+    def test_unguarded_api_list_in_reconciler_flagged(self):
+        src = (
+            "class FooReconciler:\n"
+            "    def reconcile(self, req):\n"
+            "        return self.api.list('Pod', namespace=req.namespace)\n")
+        v = hot_path.analyze(mod(src))
+        assert len(v) == 1 and v[0].check == "hotpath"
+
+    @pytest.mark.parametrize("body", [
+        # both sanctioned fallback shapes: else-branch and early-return
+        ("        if self.cache is not None:\n"
+         "            return self.cache.list('Pod')\n"
+         "        else:\n"
+         "            return self.api.list('Pod')\n"),
+        ("        if self.cache is not None:\n"
+         "            return self.cache.list('Pod')\n"
+         "        return self.api.list('Pod')\n"),
+    ])
+    def test_cache_guarded_fallback_allowed(self, body):
+        src = ("class FooController:\n"
+               "    def reconcile(self, req):\n" + body)
+        assert hot_path.analyze(mod(src)) == []
+
+    def test_non_reconciler_class_not_in_scope(self):
+        src = (
+            "class EventRecorder:\n"
+            "    def emit(self):\n"
+            "        return self.api.list('Event')\n")
+        assert hot_path.analyze(mod(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# allowlist semantics + the repo gate itself
+# ---------------------------------------------------------------------------
+
+class TestAllowlistAndGate:
+    def test_stale_entries_fail(self, monkeypatch):
+        monkeypatch.setattr(
+            allowlist_mod, "ALLOWLIST",
+            (Allow("clock", "kubeflow_tpu/nonexistent.py", "*",
+                   "covers nothing"),))
+        kept, allowed, stale = allowlist_mod.apply([])
+        assert kept == [] and allowed == []
+        assert len(stale) == 1 and "stale" in stale[0].message
+
+    def test_every_entry_has_a_reason(self):
+        for entry in allowlist_mod.ALLOWLIST:
+            assert len(entry.reason.strip()) > 10, entry
+
+    def test_repo_gate_clean(self):
+        # the acceptance criterion: python -m ci.analyzers exits 0
+        violations, stats = run_all()
+        assert violations == [], "\n".join(v.render() for v in violations)
+        assert stats["files"] > 100
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: deep-freeze
+# ---------------------------------------------------------------------------
+
+class TestStrictDeepFreeze:
+    @pytest.fixture(autouse=True)
+    def _strict(self, monkeypatch):
+        monkeypatch.setenv("INVARIANTS_STRICT", "1")
+
+    def test_mutate_after_list_raises(self):
+        api = ApiServer()
+        api.create(nb())
+        o = api.list("Notebook")[0]
+        with pytest.raises(FrozenMutationError):
+            o.spec["image"] = "evil"
+        with pytest.raises(FrozenMutationError):
+            o.metadata.labels["a"] = "b"
+        with pytest.raises(FrozenMutationError):
+            o.body["spec"].setdefault("x", 1)
+
+    def test_mutate_watch_event_object_raises(self):
+        api = ApiServer()
+        seen = []
+        api.watch(seen.append, kinds=["Notebook"])
+        api.create(nb())
+        assert seen
+        with pytest.raises(FrozenMutationError):
+            seen[0].obj.status["phase"] = "Hacked"
+
+    def test_empty_status_view_traps(self):
+        api = ApiServer()
+        api.create(nb())
+        o = api.list("Notebook")[0]
+        assert o.status == {}
+        with pytest.raises(FrozenMutationError):
+            o.status["c"] = 1
+
+    def test_get_returns_private_mutable_copy(self):
+        api = ApiServer()
+        api.create(nb())
+        fresh = api.get("Notebook", "d", "n")
+        fresh.spec["image"] = "new"       # no raise
+        api.update(fresh)
+        assert api.get("Notebook", "d", "n").spec["image"] == "new"
+
+    def test_deepcopy_of_frozen_is_mutable(self):
+        api = ApiServer()
+        api.create(nb())
+        o = api.list("Notebook")[0].deepcopy()
+        o.spec["image"] = "new"           # no raise
+        o.metadata.labels["l"] = "v"      # no raise
+
+    def test_error_carries_active_trace_id(self):
+        api = ApiServer()
+        api.create(nb())
+        o = api.list("Notebook")[0]
+        tracer = tracing.Tracer("test")
+        with tracer.start_span("reconcile", trace_id="cafe" * 8):
+            with pytest.raises(FrozenMutationError) as err:
+                o.spec["image"] = "evil"
+        assert "cafe" * 8 in str(err.value)
+
+    def test_strict_off_keeps_zero_cost_path(self, monkeypatch):
+        monkeypatch.delenv("INVARIANTS_STRICT", raising=False)
+        api = ApiServer()
+        api.create(nb())
+        o = api.list("Notebook")[0]
+        assert type(o.body) is dict  # no wrappers rebuilt
+        lock = threading.Lock()
+        assert invariants.tracked(lock, "x") is lock
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: lock tracking
+# ---------------------------------------------------------------------------
+
+class TestLockTracker:
+    def test_seeded_inversion_raises(self):
+        tr = LockTracker()
+        a = TrackedLock(threading.Lock(), "A", tracker=tr)
+        b = TrackedLock(threading.Lock(), "B", tracker=tr)
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockInversionError) as err:
+                a.acquire()
+        assert "'A'" in str(err.value) and "'B'" in str(err.value)
+
+    def test_inversion_detected_across_threads(self):
+        tr = LockTracker()
+        a = TrackedLock(threading.Lock(), "A", tracker=tr)
+        b = TrackedLock(threading.Lock(), "B", tracker=tr)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        with b:
+            with pytest.raises(LockInversionError):
+                a.acquire()
+
+    def test_consistent_order_is_fine(self):
+        tr = LockTracker()
+        a = TrackedLock(threading.Lock(), "A", tracker=tr)
+        b = TrackedLock(threading.Lock(), "B", tracker=tr)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert tr.edges() == {"A": {"B"}}
+
+    def test_reentrant_same_instance_transparent(self):
+        tr = LockTracker()
+        a = TrackedLock(threading.RLock(), "A", tracker=tr)
+        with a:
+            with a:       # RLock re-entry: no self-edge, no raise
+                pass
+        assert tr.edges() == {}
+
+    def test_sibling_rank_order_enforced(self):
+        # the per-kind shard locks: sorted-by-kind acquisition is legal,
+        # unsorted raises (the PR 8 multi-shard subscribe contract)
+        tr = LockTracker()
+        pod = TrackedLock(threading.RLock(), "shard", rank="Pod",
+                          tracker=tr)
+        sts = TrackedLock(threading.RLock(), "shard", rank="StatefulSet",
+                          tracker=tr)
+        with pod:
+            with sts:     # "Pod" < "StatefulSet": sorted, allowed
+                pass
+        with sts:
+            with pytest.raises(LockInversionError):
+                pod.acquire()
+
+    def test_strict_mode_store_is_tracked(self, monkeypatch):
+        monkeypatch.setenv("INVARIANTS_STRICT", "1")
+        api = ApiServer()
+        api.create(nb())
+        api.list("Notebook")
+        edges = invariants.GLOBAL_TRACKER.edges()
+        assert any("shard.lock" in k for k in edges), edges

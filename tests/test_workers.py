@@ -6,6 +6,8 @@ workqueue gauges."""
 import random
 import threading
 
+import pytest
+
 from kubeflow_tpu.kube import (
     ApiServer,
     BucketRateLimiter,
@@ -19,6 +21,15 @@ from kubeflow_tpu.kube import (
 )
 from kubeflow_tpu.kube.store import EventType, WatchEvent
 from kubeflow_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _strict_invariants(monkeypatch):
+    """The threaded suite runs with the runtime sanitizer on: committed
+    snapshots deep-frozen (any escaped write raises at the mutation
+    site) and every store/manager lock order-tracked
+    (utils.invariants, INVARIANTS_STRICT=1)."""
+    monkeypatch.setenv("INVARIANTS_STRICT", "1")
 
 
 def mk(kind, ns, name, labels=None):
