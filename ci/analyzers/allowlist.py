@@ -37,6 +37,13 @@ ALLOWLIST: tuple[Allow, ...] = (
     Allow("clock", "kubeflow_tpu/utils/tracing.py", "_now",
           "documented fallback when no clock has been pinned via "
           "set_clock(); every manager path pins one"),
+    Allow("clock", "kubeflow_tpu/utils/profiler.py", "*",
+          "the continuous profiler samples REAL wall time by design: a "
+          "FakeClock stands still while reconciles execute, so "
+          "logical-time sampling would never fire, and the self-overhead "
+          "ratio must measure true elapsed wall time; tier-1 keeps the "
+          "sampler off (ENABLE_CONTINUOUS_PROFILER=false) and drives "
+          "sample_once()/_record() directly"),
     Allow("clock", "kubeflow_tpu/kube/controller.py", "Manager._on_event",
           "intentionally real monotonic: event-cause stamps measure true "
           "wall latency so the fleet loadtest reports real p99 "

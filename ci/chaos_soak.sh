@@ -11,9 +11,14 @@
 # recover via the migrate verb with restored-state equivalence asserted
 # byte-for-byte, stale ones must fall back to the bare restart, and a
 # manager failover mid-migration must resume from status.sessionState
-# without double-restoring).  All driven on the FakeClock so wall time
-# stays in seconds regardless of how much backoff the injected faults
-# provoke.
+# without double-restoring), plus the fleet SLO soak (TestFleetSLOSoak:
+# every injected degradation window fires exactly one burn alert that
+# resolves on recovery with a flight-recorder-resolvable trace id, ZERO
+# alerts firing at soak end, /debug/fleet counts matching apiserver
+# ground truth, profiler overhead < 5%, and an ops.diagnose bundle that
+# reconstructs the slowest attempt offline).  All driven on the
+# FakeClock so wall time stays in seconds regardless of how much backoff
+# the injected faults provoke.
 #
 # The seed is printed up front and on failure — reproduce any run with
 #   CHAOS_SOAK_SEED=<seed> CHAOS_SOAK_ROUNDS=<n> \
@@ -48,7 +53,8 @@ if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
     WORKQUEUE_WORKERS="$WORKERS" INVARIANTS_STRICT="$STRICT" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
       tests/test_chaos.py::TestSliceRecoverySoak \
-      tests/test_chaos.py::TestMigrationRecoverySoak -q "$@"; then
+      tests/test_chaos.py::TestMigrationRecoverySoak \
+      tests/test_chaos.py::TestFleetSLOSoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
   echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
   echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} MIGRATE_SOAK_ROUNDS=${MIGRATE_ROUNDS} \\" >&2

@@ -7,13 +7,23 @@
 #   - /metrics negotiated as OpenMetrics carries exemplars context and the
 #     spec-required `# EOF` terminator (and still serves classic
 #     Prometheus text without the Accept header),
-#   - an exemplar/recorded trace id resolves on /debug/traces/<id>.
+#   - an exemplar/recorded trace id resolves on /debug/traces/<id>,
+#   - /debug/alerts serves the SLO engine's objectives with zero firing
+#     alerts on a healthy demo fleet,
+#   - /debug/fleet serves the per-namespace rollup with the demo notebook
+#     counted ready,
+#   - /debug/profile serves the continuous profiler's aggregation (the
+#     manager runs with ENABLE_CONTINUOUS_PROFILER=true here) and its
+#     overhead gauge stays under 5%,
+#   - `python -m kubeflow_tpu.ops.diagnose` captures a bundle over the
+#     same surface from which the slowest attempt resolves offline.
 # Wired into ci/run_tests.sh (controlplane lane).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 PORT="${DEBUG_SMOKE_PORT:-18479}"
 
+ENABLE_CONTINUOUS_PROFILER=true \
 python -m kubeflow_tpu.main --metrics-addr "$PORT" --webhook-port -1 \
   --demo --run-seconds 60 >/dev/null 2>&1 &
 MGR_PID=$!
@@ -88,6 +98,51 @@ assert "# TYPE controller_runtime_reconcile_time_seconds histogram" in body
 status, ctype, body = get("/metrics")
 assert status == 200 and ctype.startswith("text/plain"), ctype
 assert "# EOF" not in body
+
+# SLO engine: objectives evaluated, nothing firing on a healthy demo
+_, _, body = get("/debug/alerts")
+alerts = json.loads(body)
+assert alerts["firing"] == [], alerts["firing"]
+assert "reconcile_errors" in alerts["objectives"], alerts
+assert alerts["windows"] == ["5m", "1h"], alerts
+
+# fleet rollup: the demo notebook is counted, and counts are consistent
+_, _, body = get("/debug/fleet")
+fleet = json.loads(body)
+assert fleet["notebooks"] >= 1, fleet
+assert sum(fleet["totals"].values()) == fleet["notebooks"], fleet
+assert "default" in fleet["namespaces"], fleet
+
+# continuous profiler: enabled for this boot, samples flowing, overhead
+# gauge under the 5% always-on budget
+_, _, body = get("/debug/profile")
+prof = json.loads(body)
+assert prof["enabled"] is True, prof
+assert prof["samples_total"] > 0, prof
+assert prof["overhead_ratio"] < 0.05, prof
+status, ctype, body = get("/debug/profile?format=collapsed")
+assert status == 200 and ctype.startswith("text/plain")
+
 print("debug smoke: OK (/debug/reconciles, /debug/traces, "
-      "/debug/workqueue, OpenMetrics negotiation)")
+      "/debug/workqueue, /debug/alerts, /debug/fleet, /debug/profile, "
+      "OpenMetrics negotiation)")
+EOF
+
+# one-shot diagnostics bundle over the same loopback surface: the CLI
+# must exit 0 and the artifact must resolve its slowest attempt offline
+BUNDLE="$(mktemp --suffix=.json)"
+trap 'kill "$MGR_PID" 2>/dev/null || true; rm -f "$BUNDLE"' EXIT
+python -m kubeflow_tpu.ops.diagnose --addr "127.0.0.1:$PORT" --out "$BUNDLE"
+python - "$BUNDLE" <<'EOF'
+import json
+import sys
+
+bundle = json.load(open(sys.argv[1]))
+slowest = bundle["reconciles"]["slowest"][0]
+trace = bundle["traces"][slowest["trace_id"]]
+assert trace["spans"], slowest
+assert bundle["fleet"]["notebooks"] >= 1
+assert bundle["profile"]["samples_total"] > 0
+assert "config" in bundle
+print("diagnose smoke: OK (bundle resolves its slowest attempt offline)")
 EOF

@@ -7,9 +7,46 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..api.types import CONDITION_RECOVERY_EXHAUSTED
 from ..kube import ApiServer, parse_quantity
 from ..utils.metrics import Registry
+from ..utils.profiler import register_profiler_metrics
+from ..utils.slo import register_slo_metrics
 from . import constants as C
+
+# /debug/fleet health states, derived per Notebook by fleet_state(); a
+# bounded set so the rollup is O(namespaces x states), never O(fleet)
+FLEET_STATES = ("ready", "degraded", "recovering", "exhausted",
+                "scheduling", "stopped", "pending")
+
+
+def fleet_state(nb) -> str:
+    """One health bucket per Notebook for the fleet rollup.  The terminal
+    RecoveryExhausted condition wins (an exhausted slice reads Degraded in
+    sliceHealth but has stopped consuming restarts — the operator signal);
+    an active recovery budget (status.sliceRecovery with attempts) turns a
+    broken slice "recovering" rather than plain "degraded"; CPU notebooks
+    (no sliceHealth) bucket off readyReplicas."""
+    status = nb.body.get("status") or {}
+    for cond in (status.get("conditions") or []):
+        if cond.get("type") == CONDITION_RECOVERY_EXHAUSTED and \
+                cond.get("status") == "True":
+            return "exhausted"
+    health = status.get("sliceHealth")
+    if health in ("Healthy",):
+        return "ready"
+    if health in ("Stopped", "Stopping"):
+        return "stopped"
+    if health == "Scheduling":
+        return "scheduling"
+    if health in ("Degraded", "Unhealthy"):
+        recovery = status.get("sliceRecovery") or {}
+        if any(e.get("attempts") for e in recovery.values()
+               if isinstance(e, dict)):
+            return "recovering"
+        return "degraded"
+    # CPU notebook (or no status yet)
+    return "ready" if status.get("readyReplicas") else "pending"
 
 
 class NotebookMetrics:
@@ -170,6 +207,18 @@ class NotebookMetrics:
             "Reconcile requests dropped after exhausting their retry budget",
             labels=("controller",),
         )
+        # fleet SLO engine families (utils/slo.py) + continuous-profiler
+        # self-measurement (utils/profiler.py): registered here so the
+        # metric inventory is identical whether or not an engine/profiler
+        # is attached (ci/metrics_families.golden stability); the engine
+        # and profiler re-register identically and feed the same objects
+        self.slo_burn_rate, self.slo_budget_remaining, self.slo_firing = \
+            register_slo_metrics(self.registry)
+        self.profiler_overhead, self.profiler_samples = \
+            register_profiler_metrics(self.registry)
+        # SLOEngine attached via attach_slo(): evaluated at every scrape
+        # so burn rates/alerts advance at scrape resolution
+        self.slo = None
         # last snapshot of the manager's cumulative totals, so each scrape
         # feeds the counters exactly the delta since the previous scrape
         self._counter_snapshots: dict[tuple, float] = {}
@@ -183,6 +232,12 @@ class NotebookMetrics:
 
     def attach_manager(self, manager) -> None:
         self.manager = manager
+
+    def attach_slo(self, engine) -> None:
+        """Attach a fleet SLOEngine; every scrape() evaluates it (burn
+        rates, budget gauges, alert transitions) so the SLO verdict
+        advances exactly as often as anyone looks at the fleet."""
+        self.slo = engine
 
     def _feed_counter(self, counter, label, total: float) -> None:
         """Advance a monotonic counter to `total` using deltas against the
@@ -248,6 +303,22 @@ class NotebookMetrics:
                 out[key] = out.get(key, 0.0) + 1.0
         return out
 
+    @classmethod
+    def _fleet_census(cls, nb) -> dict:
+        """Per-Notebook contribution to the /debug/fleet rollup: one count
+        under its namespace and (for TPU notebooks) its accelerator-
+        topology shape, keyed by health state.  Maintained incrementally
+        by InformerCache.add_aggregate — O(changed) per watch event — so
+        a /debug/fleet request is O(series), never O(objects)."""
+        state = fleet_state(nb)
+        out = {cls._SEP.join(("ns", nb.namespace, state)): 1.0}
+        tpu = nb.spec.get("tpu") or {}
+        if tpu.get("accelerator"):
+            shape = "%s-%s" % (tpu.get("accelerator", ""),
+                               tpu.get("topology", ""))
+            out[cls._SEP.join(("shape", shape, state))] = 1.0
+        return out
+
     def _ensure_census(self, cache) -> bool:
         if self._census_ready is not None:
             return self._census_ready
@@ -255,6 +326,8 @@ class NotebookMetrics:
             cache.add_aggregate("StatefulSet", "nb-census", self._sts_census)
             cache.add_aggregate(C.WARMPOOL_KIND, "warmpool-census",
                                 self._warmpool_census)
+            cache.add_aggregate("Notebook", "fleet-census",
+                                self._fleet_census)
             self._census_ready = True
         except Exception:  # noqa: BLE001 — a backend that cannot list a
             # kind (real cluster without the CRD) falls back to scans
@@ -294,7 +367,55 @@ class NotebookMetrics:
                     stats.get("longest_running_s", {}).get(name, 0.0))
                 self._feed_counter(self.reconcile_errors_total, name,
                                    stats["errors_total"].get(name, 0))
+        if self.slo is not None:
+            # burn rates / budget gauges / alert lifecycle advance at
+            # scrape resolution, exactly like a Prometheus-side burn rule
+            self.slo.evaluate()
         return self.render(openmetrics=openmetrics)
+
+    # -- fleet rollup (/debug/fleet) ------------------------------------------
+    def fleet_snapshot(self) -> dict:
+        """Per-namespace / per-shape health rollup from the cache's
+        incremental fleet-census sums (list-scan fallback without a
+        cache), plus the SLO engine's last verdicts when attached."""
+        per_ns: dict[str, dict[str, int]] = {}
+        per_shape: dict[str, dict[str, int]] = {}
+        totals: dict[str, int] = {s: 0 for s in FLEET_STATES}
+        cache = getattr(self.manager, "cache", None)
+        if cache is not None and self._ensure_census(cache):
+            sums = cache.aggregate("Notebook", "fleet-census").items()
+        else:
+            sums_d: dict[str, float] = {}
+            for nb in self.api.list("Notebook"):
+                for key, v in self._fleet_census(nb).items():
+                    sums_d[key] = sums_d.get(key, 0.0) + v
+            sums = sums_d.items()
+        for key, v in sums:
+            parts = key.split(self._SEP)
+            n = int(v)
+            if n <= 0:
+                continue  # drained series linger at 0 in the aggregate
+            if parts[0] == "ns":
+                per_ns.setdefault(parts[1], {})[parts[2]] = n
+                totals[parts[2]] = totals.get(parts[2], 0) + n
+            elif parts[0] == "shape":
+                per_shape.setdefault(parts[1], {})[parts[2]] = n
+        out = {
+            "states": list(FLEET_STATES),
+            "notebooks": sum(totals.values()),
+            "totals": totals,
+            "namespaces": {ns: dict(sorted(states.items()))
+                           for ns, states in sorted(per_ns.items())},
+            "shapes": {sh: dict(sorted(states.items()))
+                       for sh, states in sorted(per_shape.items())},
+        }
+        if self.slo is not None:
+            snap = self.slo.snapshot()
+            out["slo"] = {
+                "objectives": snap["objectives"],
+                "firing": snap["firing"],
+            }
+        return out
 
     def _scrape_census_from_cache(self, cache) -> None:
         """Census gauges off the cache's incremental aggregates."""

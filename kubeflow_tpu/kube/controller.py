@@ -348,6 +348,12 @@ class Manager:
         # bounded in-process history of completed reconcile attempts, fed
         # with each attempt's finished root span (/debug/reconciles reads it)
         self.flight_recorder = flight_recorder or FlightRecorder()
+        # optional fleet observers (build_manager wires them): the SLO
+        # engine receives every completed AttemptRecord (exemplar latching
+        # for burn alerts — utils/slo.py), the continuous profiler hangs
+        # here so /debug/profile can reach it
+        self.slo_engine = None
+        self.profiler = None
         self._limiter = rate_limiter or default_rate_limiter(self.clock)
         self._registrations: list[_Registration] = []
         self._lock = invariants.tracked(
@@ -795,7 +801,12 @@ class Manager:
                 root_span.set_attribute("mono_start", mono_start)
                 root_span.set_attribute("mono_end", time.monotonic())
                 try:
-                    self.flight_recorder.record(root_span)
+                    rec = self.flight_recorder.record(root_span)
+                    if rec is not None and self.slo_engine is not None:
+                        # attempt stream -> SLO engine: errored/slow
+                        # attempts become the exemplar trace an alert
+                        # links back into this very recorder
+                        self.slo_engine.observe_attempt(rec)
                 except Exception:  # noqa: BLE001 — observability must
                     # never take the reconcile loop down with it
                     logger.exception("flight recorder rejected a span")

@@ -84,6 +84,14 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
       as /state: diagnosis happens via `kubectl exec`/port-forward, and
       trace payloads carry object names and error strings that must not be
       scrapeable from off-pod);
+    - /debug/alerts — the SLO engine's burn-alert surface: objective
+      stats, firing alerts, and the bounded fire/resolve history (each
+      alert carrying an exemplar trace_id resolvable at /debug/traces);
+    - /debug/profile — the continuous profiler's aggregated collapsed
+      stacks (JSON, or flamegraph text with ?format=collapsed);
+    - /debug/fleet — per-namespace / per-shape health rollup off the
+      informer cache's incremental census (O(series) per request) plus
+      the SLO verdicts;
     - /state    — in-memory store dump (includes Secret data; additionally
       gated on --expose-state)."""
 
@@ -189,6 +197,37 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
         elif path == "/debug/workqueue":
             self._respond(200, json.dumps(mgr.workqueue_debug(), default=str),
                           "application/json")
+        elif path == "/debug/alerts":
+            engine = getattr(mgr, "slo_engine", None)
+            body = engine.snapshot() if engine is not None else {
+                "enabled": False,
+                "error": "no SLO engine attached to this manager"}
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
+        elif path == "/debug/profile":
+            profiler = getattr(mgr, "profiler", None)
+            fmt = (query.get("format") or ["json"])[0]
+            if profiler is None:
+                if fmt == "collapsed":
+                    self._respond(200, "", "text/plain")
+                else:
+                    self._respond(200, json.dumps(
+                        {"enabled": False, "samples_total": 0, "stacks": [],
+                         "hint": "set ENABLE_CONTINUOUS_PROFILER=true"}),
+                        "application/json")
+            elif fmt == "collapsed":
+                self._respond(200, profiler.collapsed(), "text/plain")
+            else:
+                self._respond(200, json.dumps(profiler.snapshot(),
+                                              default=str),
+                              "application/json")
+        elif path == "/debug/fleet":
+            if self.metrics is None:
+                self._respond(503, "no metrics", "text/plain")
+                return
+            self._respond(200, json.dumps(self.metrics.fleet_snapshot(),
+                                          default=str),
+                          "application/json")
         else:
             self._respond(404, "not found", "text/plain")
 
@@ -240,7 +279,29 @@ def build_manager(
     cluster = FakeCluster(api) if (with_fake_cluster and not real_cluster) else None
     mgr = Manager(api)
     odh_cfg = odh_cfg or OdhConfig.from_env()
-    metrics = NotebookMetrics(api)
+    metrics = NotebookMetrics(api, manager=mgr)
+    # fleet SLO engine: declared objectives (SLO_* knobs) over the metric
+    # streams, evaluated at every scrape; alerts serve at /debug/alerts
+    from .utils.slo import SLOEngine, default_objectives
+
+    engine = SLOEngine(
+        default_objectives(core_cfg),
+        registries=[metrics.registry, mgr.metrics_registry],
+        clock=mgr.clock,
+        windows=(core_cfg.slo_short_window_s, core_cfg.slo_long_window_s),
+        burn_threshold=core_cfg.slo_burn_alert_threshold,
+        recorder=mgr.flight_recorder)
+    metrics.attach_slo(engine)
+    mgr.slo_engine = engine
+    if core_cfg.enable_continuous_profiler:
+        # always-on (controller, phase) CPU attribution; self-overhead is
+        # exported so "can it stay on" is a gauge (/debug/profile)
+        from .utils.profiler import ContinuousProfiler
+
+        mgr.profiler = ContinuousProfiler(
+            registry=metrics.registry,
+            interval_s=max(core_cfg.profiler_interval_ms, 1.0) / 1000.0)
+        mgr.profiler.start()
     # the fake cluster doubles as the warm-pool provisioner (cloud-provider
     # hook): ENABLE_SLICE_SCHEDULER turns capacity up/down through it
     setup_core_controllers(mgr, core_cfg, metrics, provisioner=cluster)
@@ -491,6 +552,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     finally:
         if elector is not None:
             elector.stop()
+        if mgr.profiler is not None:
+            mgr.profiler.stop()
         mgr.stop()
         if wire_server is not None:
             wire_server.stop()

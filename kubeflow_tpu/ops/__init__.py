@@ -1,7 +1,30 @@
-"""TPU compute ops: flash/XLA attention and ring attention
-(sequence-parallel exact attention over the ICI ring)."""
+"""TPU compute ops — flash/XLA attention and ring attention (sequence-
+parallel exact attention over the ICI ring) — plus the stdlib-only
+`ops.diagnose` one-shot diagnostics bundle.
 
-from .attention import attention, flash_attention, xla_attention
-from .ring_attention import ring_attention
+The compute exports are lazy (PEP 562): `python -m kubeflow_tpu.ops.
+diagnose` runs in the control-plane pod (and the fast test lane) without
+dragging jax/XLA in; `from kubeflow_tpu.ops import flash_attention`
+resolves exactly as before.
+"""
+
+import importlib
+
+_LAZY = {
+    "attention": ".attention",
+    "flash_attention": ".attention",
+    "xla_attention": ".attention",
+    "ring_attention": ".ring_attention",
+}
 
 __all__ = ["attention", "flash_attention", "ring_attention", "xla_attention"]
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    mod = importlib.import_module(target, __name__)
+    value = getattr(mod, name)
+    globals()[name] = value  # cache: resolve each export once
+    return value

@@ -1,0 +1,209 @@
+"""One-shot diagnostics bundle: `python -m kubeflow_tpu.ops.diagnose`.
+
+An operator paged about a degraded fleet needs everything at once —
+metrics, firing alerts, the flight recorder's retained attempts WITH
+their span trees, the workqueue state, the live profile, and the config
+the manager is actually running — in one artifact that can be attached
+to an incident and analyzed offline, long after the pod restarted.
+
+Two collection modes:
+
+  - **HTTP** (the CLI default): walk the manager's loopback debug
+    surface (`/metrics`, `/debug/{fleet,alerts,reconciles,workqueue,
+    profile}`), then resolve the span trees of every retained slowest/
+    errored attempt via `/debug/traces/<id>` — so the bundle can
+    reconstruct, offline, exactly the attempts an operator gets paged
+    about.  Run it where the manager runs (`kubectl exec`), like every
+    other loopback debug consumer.
+  - **in-process** (`collect_local`): the same bundle straight off live
+    Manager/NotebookMetrics objects — what the fleet soak and the
+    loadtest use, with no HTTP server in the loop.
+
+Config capture is REDACTED: only recognized configuration variables are
+included, and any name that smells like a credential has its value
+masked — the bundle is made to be shared.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import urllib.error
+import urllib.request
+from typing import Mapping, Optional
+
+from ..utils.clock import Clock
+
+BUNDLE_FORMAT = 1
+
+# environment prefixes that are configuration surface (utils/config.py +
+# the observability/tracing knobs); everything else stays out of the
+# bundle entirely
+CONFIG_PREFIXES = (
+    "SLO_", "WORKQUEUE_", "RECOVERY_", "CHECKPOINT_", "WARMPOOL_",
+    "CULL", "ENABLE_", "TRACE_", "OTEL_", "PROFILER_", "WATCH_",
+    "INVARIANTS_", "K8S_", "IDLENESS_", "CLUSTER_DOMAIN", "USE_ISTIO",
+    "ISTIO_", "ADD_FSGROUP", "DEV", "SET_PIPELINE_", "GATEWAY_",
+    "NOTEBOOK_GATEWAY_", "MLFLOW_", "INJECT_", "TPU_", "KUBE_",
+)
+_SECRET_RE = re.compile(r"TOKEN|SECRET|PASSWORD|PASSWD|CREDENTIAL|APIKEY"
+                        r"|API_KEY|PRIVATE|CERT", re.IGNORECASE)
+REDACTED = "**redacted**"
+
+
+def redacted_config(env: Optional[Mapping[str, str]] = None) -> dict:
+    """The recognized config surface of `env` (default: this process —
+    under `kubectl exec` that IS the manager's environment), with
+    credential-shaped names masked."""
+    env = env if env is not None else os.environ
+    out = {}
+    for key in sorted(env):
+        if not any(key.startswith(p) for p in CONFIG_PREFIXES):
+            continue
+        out[key] = REDACTED if _SECRET_RE.search(key) else env[key]
+    return out
+
+
+def _trace_ids(reconciles: dict) -> list[str]:
+    """Trace ids of the retained slowest + errored attempts — the ones a
+    bundle must make reconstructable offline."""
+    ids: list[str] = []
+    for section in ("slowest", "errored"):
+        for a in reconciles.get(section, ()):
+            tid = a.get("trace_id")
+            if tid and tid not in ids:
+                ids.append(tid)
+    return ids
+
+
+def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
+                  = None) -> dict:
+    """Assemble the bundle from in-process objects (no HTTP).  `manager`
+    is a kube.Manager; `metrics` a core.metrics.NotebookMetrics (scraped
+    for the exposition + fleet rollup when given)."""
+    engine = getattr(manager, "slo_engine", None)
+    profiler = getattr(manager, "profiler", None)
+    reconciles = manager.flight_recorder.snapshot()
+    traces = {}
+    for tid in _trace_ids(reconciles):
+        trace = manager.flight_recorder.trace(tid)
+        if trace is not None:
+            traces[tid] = trace
+    return {
+        "bundle_format": BUNDLE_FORMAT,
+        "captured_at": manager.clock.now(),
+        "source": "in-process",
+        "metrics": (metrics.scrape() if metrics is not None
+                    else manager.metrics_registry.render()),
+        "fleet": (metrics.fleet_snapshot() if metrics is not None
+                  else None),
+        "alerts": engine.snapshot() if engine is not None else None,
+        "slo_verdicts": engine.verdicts() if engine is not None else None,
+        "reconciles": reconciles,
+        "traces": traces,
+        "workqueue": manager.workqueue_debug(),
+        "profile": (profiler.snapshot() if profiler is not None
+                    else {"enabled": False}),
+        "config": redacted_config(env),
+    }
+
+
+def _get(base: str, path: str, timeout: float) -> tuple[int, str]:
+    req = urllib.request.Request(base + path)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def collect_http(addr: str, timeout: float = 10.0) -> dict:
+    """Assemble the bundle over the manager's loopback debug surface."""
+    base = addr.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+
+    def get_json(path: str):
+        code, body = _get(base, path, timeout)
+        if code != 200:
+            return {"error": f"GET {path} -> {code}"}
+        return json.loads(body)
+
+    code, metrics_text = _get(base, "/metrics", timeout)
+    if code != 200:
+        metrics_text = f"# GET /metrics -> {code}"
+    reconciles = get_json("/debug/reconciles")
+    traces = {}
+    for tid in _trace_ids(reconciles):
+        trace = get_json(f"/debug/traces/{tid}")
+        if "error" not in trace:
+            traces[tid] = trace
+    alerts = get_json("/debug/alerts")
+    return {
+        "bundle_format": BUNDLE_FORMAT,
+        "captured_at": Clock().now(),
+        "source": base,
+        "metrics": metrics_text,
+        "fleet": get_json("/debug/fleet"),
+        "alerts": alerts,
+        "slo_verdicts": None,  # verdicts need an engine; alerts carry
+        # the per-objective stats over HTTP
+        "reconciles": reconciles,
+        "traces": traces,
+        "workqueue": get_json("/debug/workqueue"),
+        "profile": get_json("/debug/profile"),
+        "config": redacted_config(),
+    }
+
+
+def summarize(bundle: dict) -> str:
+    """One human line per bundle — printed by the CLI so the operator
+    sees what they captured."""
+    reconciles = bundle.get("reconciles") or {}
+    alerts = bundle.get("alerts") or {}
+    profile = bundle.get("profile") or {}
+    fleet = bundle.get("fleet") or {}
+    firing = alerts.get("firing")
+    return (
+        f"bundle: {reconciles.get('recorded_total', 0)} attempts recorded, "
+        f"{len(reconciles.get('slowest') or ())} slowest + "
+        f"{len(reconciles.get('errored') or ())} errored retained, "
+        f"{len(bundle.get('traces') or {})} traces resolved, "
+        f"{len(firing) if firing is not None else 0} alerts firing, "
+        f"{profile.get('samples_total', 0)} profile samples, "
+        f"{fleet.get('notebooks', 0)} notebooks in the fleet rollup"
+    )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.ops.diagnose",
+        description="capture a one-shot diagnostics bundle from a running "
+                    "manager's loopback debug surface")
+    parser.add_argument("--addr", default="http://127.0.0.1:8080",
+                        help="manager health/metrics address "
+                             "(default %(default)s; loopback-only surface "
+                             "— run this where the manager runs)")
+    parser.add_argument("--out", default="bundle.json",
+                        help="bundle output path (default %(default)s)")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+
+    try:
+        bundle = collect_http(args.addr, timeout=args.timeout)
+    except (OSError, urllib.error.URLError) as err:
+        print(f"diagnose: cannot reach {args.addr}: {err}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(bundle, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    print(summarize(bundle))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

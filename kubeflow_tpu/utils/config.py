@@ -142,6 +142,29 @@ class CoreConfig:
     warmpool_max_size: int = 64               # WARMPOOL_MAX_SIZE
     warmpool_target_hit_rate: float = 0.9     # WARMPOOL_TARGET_HIT_RATE
     warmpool_decay_s: float = 600.0           # WARMPOOL_DECAY_S
+    # fleet SLO engine (utils/slo.py): declared objectives over the
+    # existing metric streams, evaluated into multi-window burn rates at
+    # every scrape.  Latency knobs are p99 ceilings (at most 1% of events
+    # may exceed them per window); a knob <= 0 disables its objective.
+    # Alerts fire when EVERY window (slo_short_window_s AND
+    # slo_long_window_s) burns the error budget faster than
+    # slo_burn_alert_threshold, and resolve when the short window
+    # recovers — served at /debug/alerts.
+    slo_time_to_ready_p99_s: float = 600.0      # SLO_TIME_TO_READY_P99_S
+    slo_event_to_reconcile_p99_s: float = 30.0  # SLO_EVENT_TO_RECONCILE_P99_S
+    slo_reconcile_error_rate: float = 0.01      # SLO_RECONCILE_ERROR_RATE
+    slo_recovery_p99_s: float = 300.0           # SLO_RECOVERY_DURATION_P99_S
+    slo_warmpool_hit_rate: float = 0.6          # SLO_WARMPOOL_HIT_RATE
+    slo_short_window_s: float = 300.0           # SLO_SHORT_WINDOW_S
+    slo_long_window_s: float = 3600.0           # SLO_LONG_WINDOW_S
+    slo_burn_alert_threshold: float = 2.0       # SLO_BURN_ALERT_THRESHOLD
+    # continuous sampling profiler (utils/profiler.py): always-on
+    # (controller, phase) CPU attribution served at /debug/profile.  Off
+    # by default — tier-1 tests and FakeClock harnesses must not run a
+    # real-time sampler thread; its self-overhead is exported as
+    # notebook_profiler_overhead_ratio when on.
+    enable_continuous_profiler: bool = False    # ENABLE_CONTINUOUS_PROFILER
+    profiler_interval_ms: float = 10.0          # PROFILER_INTERVAL_MS
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -189,6 +212,23 @@ class CoreConfig:
             warmpool_target_hit_rate=_float(
                 env, "WARMPOOL_TARGET_HIT_RATE", 0.9),
             warmpool_decay_s=_float(env, "WARMPOOL_DECAY_S", 600.0),
+            slo_time_to_ready_p99_s=_float(
+                env, "SLO_TIME_TO_READY_P99_S", 600.0),
+            slo_event_to_reconcile_p99_s=_float(
+                env, "SLO_EVENT_TO_RECONCILE_P99_S", 30.0),
+            slo_reconcile_error_rate=_float(
+                env, "SLO_RECONCILE_ERROR_RATE", 0.01),
+            slo_recovery_p99_s=_float(
+                env, "SLO_RECOVERY_DURATION_P99_S", 300.0),
+            slo_warmpool_hit_rate=_float(
+                env, "SLO_WARMPOOL_HIT_RATE", 0.6),
+            slo_short_window_s=_float(env, "SLO_SHORT_WINDOW_S", 300.0),
+            slo_long_window_s=_float(env, "SLO_LONG_WINDOW_S", 3600.0),
+            slo_burn_alert_threshold=_float(
+                env, "SLO_BURN_ALERT_THRESHOLD", 2.0),
+            enable_continuous_profiler=_bool(
+                env, "ENABLE_CONTINUOUS_PROFILER", False),
+            profiler_interval_ms=_float(env, "PROFILER_INTERVAL_MS", 10.0),
         )
 
 

@@ -98,6 +98,14 @@ _NOOP_SPAN = Span(name="", recording=False)
 _SPAN_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
     "kubeflow_tpu_span_stack", default=())
 
+# Cross-thread mirror of the live span stacks, keyed by thread ident.  A
+# contextvar is only readable from its own thread, but the sampling
+# profiler (utils/profiler.py) must attribute ANOTHER thread's stack
+# frames to the (controller, phase) span that thread is currently inside.
+# Updated on every span start/end with plain (GIL-atomic) dict ops — two
+# dict assignments per span, no lock on the reconcile path.
+_LIVE_STACKS: dict[int, tuple] = {}
+
 
 def current_span() -> Span:
     """The innermost live span on this thread/context (noop when none) —
@@ -105,6 +113,12 @@ def current_span() -> Span:
     reconcile attempt the fault actually hit."""
     stack = _SPAN_STACK.get()
     return stack[-1] if stack else _NOOP_SPAN
+
+
+def live_span_stacks() -> dict[int, tuple]:
+    """Snapshot of every thread's live span stack (thread ident ->
+    innermost-last Span tuple) — the profiler's attribution source."""
+    return dict(_LIVE_STACKS)
 
 
 class InMemorySpanExporter:
@@ -168,10 +182,16 @@ class Tracer:
             span_id=os.urandom(8).hex(),
         )
         token = _SPAN_STACK.set(stack + (span,))
+        tid = threading.get_ident()
+        _LIVE_STACKS[tid] = stack + (span,)
         try:
             yield span
         finally:
             _SPAN_STACK.reset(token)
+            if stack:
+                _LIVE_STACKS[tid] = stack
+            else:
+                _LIVE_STACKS.pop(tid, None)
             span.end_time = _now()
             if parent is not None:
                 parent.children.append(span)

@@ -26,7 +26,10 @@ Per run it reports:
     proving the no-op write suppression end to end — and at most one
     reconcile per (controller, object);
   - per-key serialization: the flight recorder's attempt-overlap check
-    must come back empty (no two concurrent reconciles of one key).
+    must come back empty (no two concurrent reconciles of one key);
+  - SLO verdicts (utils/slo.py): each standing objective's met/violated
+    state and end-of-run burn rate, recorded into the `--out` trajectory
+    JSON — the same engine the manager serves at /debug/alerts.
 
 `--compare-workers W` runs the same fleet again with W parallel workers
 and asserts the normalized final cluster state (resourceVersions, uids,
@@ -62,6 +65,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 from kubeflow_tpu.api.types import Notebook, TPUSpec  # noqa: E402
+from kubeflow_tpu.core.metrics import NotebookMetrics  # noqa: E402
 from kubeflow_tpu.core.notebook_controller import (  # noqa: E402
     setup_core_controllers,
 )
@@ -69,6 +73,10 @@ from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager  # noqa: E402
 from kubeflow_tpu.utils.clock import FakeClock  # noqa: E402
 from kubeflow_tpu.utils.config import CoreConfig  # noqa: E402
 from kubeflow_tpu.utils.flightrecorder import FlightRecorder  # noqa: E402
+from kubeflow_tpu.utils.slo import (  # noqa: E402
+    SLOEngine,
+    default_objectives,
+)
 
 NAMESPACE = "loadtest"
 
@@ -141,7 +149,16 @@ def run_fleet(count: int, workers: int, tpu: str = "",
     mgr = Manager(api, clock=clock, workers=workers,
                   flight_recorder=recorder)
     cfg = CoreConfig.from_env({})  # hermetic: culling off, defaults only
-    setup_core_controllers(mgr, cfg)
+    metrics = NotebookMetrics(api, manager=mgr)
+    setup_core_controllers(mgr, cfg, metrics)
+    # standing SLO verdicts ride the trajectory record (--out): the same
+    # engine production runs under /debug/alerts, evaluated at run end
+    slo_engine = SLOEngine(
+        default_objectives(cfg),
+        registries=[metrics.registry, mgr.metrics_registry],
+        clock=clock, recorder=recorder)
+    mgr.slo_engine = slo_engine
+    metrics.attach_slo(slo_engine)
 
     spec = None
     if tpu:
@@ -247,6 +264,10 @@ def run_fleet(count: int, workers: int, tpu: str = "",
         "steady_reconciles": steady_reconciles,
         "steady_write_verbs": 0,
         "cache": mgr.cache.stats() if mgr.cache is not None else {},
+        # objective -> met/violated + burn rate at end of run (utils/slo):
+        # the trajectory record carries a standing SLO verdict, not just
+        # raw percentiles
+        "slo": slo_engine.verdicts(),
     }
     if compute_state:
         result["_state"] = normalized_state(api)

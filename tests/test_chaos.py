@@ -948,3 +948,176 @@ class TestFlightRecorderDebugSoak:
             tracing.set_exporter(None)
             tracing.set_clock(None)
             server.shutdown()
+
+
+class TestFleetSLOSoak:
+    """ISSUE-10 acceptance: a seeded chaos soak with the SLO engine and
+    the continuous profiler enabled must end with (1) every injected
+    degradation window producing exactly one fired-then-resolved burn
+    alert carrying a trace_id that resolves in the flight recorder,
+    (2) ZERO firing alerts at soak end (outside the injected-fault
+    windows), (3) /debug/fleet counts matching the apiserver's ground
+    truth, (4) profiler self-overhead under 5% of wall time, and (5) an
+    ops.diagnose bundle from which the soak's slowest attempt is fully
+    reconstructable offline."""
+
+    FLEET = 4
+    WINDOWS = 3
+
+    def test_fleet_slo_soak_end_to_end(self):
+        import json
+
+        from kubeflow_tpu.core.metrics import NotebookMetrics, fleet_state
+        from kubeflow_tpu.kube.faults import FaultPlan, FaultRule
+        from kubeflow_tpu.ops.diagnose import collect_local
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+        from kubeflow_tpu.utils.profiler import ContinuousProfiler
+        from kubeflow_tpu.utils.slo import SLOEngine, default_objectives
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    4 * self.FLEET, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock,
+                      flight_recorder=FlightRecorder(capacity=16384,
+                                                     per_object=4096))
+        metrics = NotebookMetrics(api, manager=mgr)
+        cfg = CoreConfig()
+        setup_core_controllers(mgr, cfg, metrics)
+        setup_odh_controllers(mgr, OdhConfig(controller_namespace=CENTRAL_NS))
+        engine = SLOEngine(
+            default_objectives(cfg),
+            registries=[metrics.registry, mgr.metrics_registry],
+            clock=clock, recorder=mgr.flight_recorder, burn_threshold=2.0)
+        mgr.slo_engine = engine
+        metrics.attach_slo(engine)
+        profiler = ContinuousProfiler(registry=metrics.registry,
+                                      interval_s=0.002)
+        mgr.profiler = profiler
+        tracing.set_clock(clock)
+        profiler.start()
+        try:
+            for i in range(self.FLEET):
+                api.create(Notebook.new(f"slo-{i}", "user1",
+                                        tpu=TPUSpec("v5e", "4x4")).obj)
+            mgr.run_until_idle()
+            metrics.scrape()  # baseline evaluation: nothing fires
+            assert not engine.firing()
+
+            def alerts_for(objective):
+                return [a for a in engine.alert_history()
+                        if a.objective == objective]
+
+            # one latency fault early on: the soak's distinguished
+            # slowest attempt, reconstructed from the bundle at the end
+            plan_lag = FaultPlan([FaultRule(
+                verbs=("create",), kinds=("Service",), latency_s=0.75,
+                max_matches=1, name="lag")], clock=clock)
+            with api.fault_exempt():
+                api.delete("Service", "user1", "slo-0")
+            api.install_fault_plan(plan_lag)
+            with api.fault_exempt():
+                mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+            api.clear_fault_plan()
+            assert len(plan_lag.log) == 1
+
+            # injected degradation windows: each faults Service creates
+            # hard enough that the reconcile-error budget burns in both
+            # windows, then recovers and drains the short window
+            for w in range(self.WINDOWS):
+                before = len(alerts_for("reconcile_errors"))
+                plan = FaultPlan([FaultRule(
+                    verbs=("create",), kinds=("Service",),
+                    error="unavailable", max_matches=4,
+                    name=f"win-{w}")], clock=clock)
+                with api.fault_exempt():
+                    api.delete("Service", "user1", f"slo-{w % self.FLEET}")
+                api.install_fault_plan(plan)
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                mgr.settle(max_seconds=7200.0)
+                api.clear_fault_plan()
+                assert len(plan.log) == 4
+
+                metrics.scrape()  # scrape-driven evaluation mid-window
+                firing = engine.firing()
+                assert [a.objective for a in firing] == \
+                    ["reconcile_errors"], (w, firing)
+                assert len(alerts_for("reconcile_errors")) == before + 1
+
+                # recovery: restore steady state, then drain the short
+                # window with idle scrapes — the alert must resolve
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                mgr.settle(max_seconds=7200.0)
+                for _ in range(3):
+                    clock.advance(150)
+                    metrics.scrape()
+                assert not engine.firing(), f"window {w} never resolved"
+                for nb_i in range(self.FLEET):
+                    assert_steady_state(api, "user1", f"slo-{nb_i}", 4)
+
+            # (1)+(2): exactly one fired-then-resolved alert per window,
+            # zero firing at soak end, each with a resolvable trace id
+            history = alerts_for("reconcile_errors")
+            assert len(history) == self.WINDOWS
+            assert not engine.firing()
+            for alert in history:
+                assert alert.state == "resolved"
+                assert alert.resolved_at > alert.fired_at
+                assert alert.trace_id, alert
+                trace = mgr.flight_recorder.trace(alert.trace_id)
+                assert trace is not None and trace["spans"], alert
+            # the firing gauge reads 0 in the final exposition
+            final = metrics.scrape()
+            assert 'notebook_slo_alert_firing{objective='\
+                '"reconcile_errors"} 0' in final
+
+            # (3) /debug/fleet counts == apiserver ground truth
+            snap = metrics.fleet_snapshot()
+            truth = {}
+            for nb in api.list("Notebook"):
+                s = fleet_state(nb)
+                truth[s] = truth.get(s, 0) + 1
+            assert {k: v for k, v in snap["totals"].items() if v} == truth
+            assert snap["notebooks"] == self.FLEET
+            assert snap["namespaces"]["user1"]["ready"] == self.FLEET
+
+            # (4) profiler stayed cheap while always-on
+            profiler.stop()
+            assert profiler.passes > 0 and profiler.samples_total > 0
+            overhead = profiler.overhead_ratio()
+            assert overhead < 0.05, f"profiler overhead {overhead:.3f}"
+            gauge = metrics.registry.get("notebook_profiler_overhead_ratio")
+            assert gauge.collect()[()] == profiler.overhead_ratio()
+
+            # (5) the diagnose bundle reconstructs the slowest attempt
+            # offline: summary -> trace id -> span tree, no live objects
+            bundle = collect_local(mgr, metrics)
+            blob = json.dumps(bundle, default=str)  # self-contained JSON
+            offline = json.loads(blob)
+            slowest = offline["reconciles"]["slowest"][0]
+            assert slowest["duration_s"] >= 0.75  # the injected lag
+            tree = offline["traces"][slowest["trace_id"]]
+            roots = [s for s in tree["spans"]
+                     if s["span_id"] == slowest["span_id"]]
+            assert len(roots) == 1
+            assert {"render", "apply", "status"} <= {
+                c["name"] for c in roots[0]["children"]}
+            assert any(f.get("fault.rule") == "lag"
+                       for f in slowest["faults"]), slowest
+            # alert history and fleet rollup ride in the same artifact
+            assert len(offline["alerts"]["history"]) >= self.WINDOWS
+            assert offline["fleet"]["totals"]["ready"] == self.FLEET
+            assert offline["profile"]["samples_total"] == \
+                profiler.samples_total
+        finally:
+            api.clear_fault_plan()
+            profiler.stop()
+            tracing.set_clock(None)
+            mgr.stop()

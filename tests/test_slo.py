@@ -1,0 +1,781 @@
+"""Fleet SLO engine, continuous profiler, fleet rollup, diagnostics
+bundle (ISSUE 10).
+
+Covers, under FakeClock where timing matters:
+  - burn-rate math over sliding windows (latency bucket snapping, ratio
+    objectives, window anchoring);
+  - alert lifecycle: fire -> persist across scrapes -> resolve on
+    recovery -> re-fire as a NEW alert, with exemplar trace ids latched
+    from the attempt stream and a bounded history;
+  - FlightRecorder.overlapping_attempts() sweep == brute force on seeded
+    histories (including the long-attempt-spans-many shape the old
+    adjacent-pair check missed);
+  - /debug/fleet rollup counts == apiserver ground truth, via the
+    cache's incremental census;
+  - profiler: off by default in the wired stack, deterministic
+    sample_once attribution via the live span-stack mirror, bounded
+    stack store, self-overhead measurement;
+  - ops.diagnose: in-process and HTTP bundles from which the slowest
+    attempt is fully reconstructable offline, with redacted config.
+"""
+
+import json
+import random
+import threading
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from kubeflow_tpu.api.types import CONDITION_RECOVERY_EXHAUSTED, Notebook, \
+    TPUSpec
+from kubeflow_tpu.core.metrics import FLEET_STATES, NotebookMetrics, \
+    fleet_state
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.ops.diagnose import REDACTED, collect_http, collect_local
+from kubeflow_tpu.ops.diagnose import main as diagnose_main
+from kubeflow_tpu.ops.diagnose import redacted_config
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+from kubeflow_tpu.utils.metrics import Registry
+from kubeflow_tpu.utils.profiler import UNATTRIBUTED, ContinuousProfiler, \
+    attribute
+from kubeflow_tpu.utils.slo import KIND_LATENCY, KIND_RATIO, Objective, \
+    SLOEngine, default_objectives, window_label
+
+
+def _engine(clock, reg, objectives, threshold=2.0, windows=(300.0, 3600.0),
+            **kw):
+    return SLOEngine(objectives, [reg], clock, windows=windows,
+                     burn_threshold=threshold, **kw)
+
+
+ERROR_OBJ = Objective(
+    "errors", KIND_RATIO, "controller_runtime_reconcile_total",
+    target_ratio=0.99, label="result", bad_values=("error",))
+
+
+class TestBurnRateMath:
+    def setup_method(self):
+        self.clock = FakeClock()
+        self.reg = Registry()
+        self.total = self.reg.counter(
+            "controller_runtime_reconcile_total", "t",
+            labels=("controller", "result"))
+
+    def test_clean_traffic_burns_nothing(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        for _ in range(12):
+            self.total.labels("notebook", "success").inc(100)
+            self.clock.advance(300)
+            eng.evaluate()
+        stats = eng.evaluate()["errors"]
+        assert stats["burn_rates"] == {"5m": 0.0, "1h": 0.0}
+        assert stats["budget_remaining_ratio"] == 1.0
+        assert not eng.firing()
+
+    def test_burst_burn_rates_exact(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        # one clean hour, then a 50%-errors minute: the short window sees
+        # 50% bad / 1% budget = burn 50, the long window dilutes
+        for _ in range(12):
+            self.total.labels("notebook", "success").inc(100)
+            self.clock.advance(300)
+            eng.evaluate()
+        self.total.labels("notebook", "success").inc(50)
+        self.total.labels("notebook", "error").inc(50)
+        self.clock.advance(60)
+        stats = eng.evaluate()["errors"]
+        # short window: the last clean round's 100 successes are still
+        # inside it, so 50 bad of 200 events / 1% budget = burn 25
+        assert stats["burn_rates"]["5m"] == pytest.approx(25.0)
+        # long window: 50 bad of (1100 good + 50 bad + 50) events since
+        # the 1h anchor; just assert it is diluted but nonzero
+        assert 0 < stats["burn_rates"]["1h"] < stats["burn_rates"]["5m"]
+        assert stats["budget_remaining_ratio"] < 0.0  # budget overspent
+
+    def test_window_anchor_forgets_old_errors(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        self.total.labels("notebook", "error").inc(100)
+        self.clock.advance(60)
+        assert eng.evaluate()["errors"]["burn_rates"]["5m"] > 0
+        # two clean hours later both windows have forgotten the burst
+        for _ in range(24):
+            self.total.labels("notebook", "success").inc(10)
+            self.clock.advance(300)
+            eng.evaluate()
+        stats = eng.evaluate()["errors"]
+        assert stats["burn_rates"] == {"5m": 0.0, "1h": 0.0}
+        assert stats["budget_remaining_ratio"] == 1.0
+
+    def test_latency_threshold_snaps_to_bucket(self):
+        hist = self.reg.histogram("lat_seconds", "l", labels=("c",),
+                                  buckets=(0.1, 1.0, 10.0))
+        # threshold 0.5 snaps UP to the 1.0 bucket bound: a 0.9s
+        # observation still counts good (the exposition cannot tell 0.5
+        # from 1.0 apart), a 5s one is bad
+        obj = Objective("lat", KIND_LATENCY, "lat_seconds", threshold_s=0.5)
+        eng = _engine(self.clock, self.reg, (obj,))
+        hist.labels("a").observe(0.9)
+        hist.labels("a").observe(5.0)
+        hist.labels("b").observe(0.05)
+        self.clock.advance(10)
+        stats = eng.evaluate()["lat"]
+        # 1 bad of 3 -> 33.3% / 1% budget
+        assert stats["burn_rates"]["5m"] == pytest.approx((1 / 3) / 0.01)
+
+    def test_latency_threshold_above_all_buckets_counts_all_good(self):
+        hist = self.reg.histogram("lat_seconds", "l", buckets=(0.1, 1.0))
+        obj = Objective("lat", KIND_LATENCY, "lat_seconds", threshold_s=99.0)
+        eng = _engine(self.clock, self.reg, (obj,))
+        hist.observe(50.0)
+        self.clock.advance(10)
+        assert eng.evaluate()["lat"]["burn_rates"]["5m"] == 0.0
+
+    def test_ratio_total_values_restrict_denominator(self):
+        hits = self.reg.counter("notebook_warmpool_hits_total", "h",
+                                labels=("result",))
+        obj = Objective("hit_rate", KIND_RATIO,
+                        "notebook_warmpool_hits_total", target_ratio=0.6,
+                        label="result", bad_values=("miss",),
+                        total_values=("hit", "miss"))
+        eng = _engine(self.clock, self.reg, (obj,))
+        hits.labels("hit").inc(3)
+        hits.labels("miss").inc(1)
+        hits.labels("bypass").inc(100)  # neutral: not pool traffic
+        self.clock.advance(10)
+        stats = eng.evaluate()["hit_rate"]
+        # 25% misses against a 40% budget: burning but within budget
+        assert stats["burn_rates"]["5m"] == pytest.approx(0.25 / 0.4)
+        assert stats["budget_remaining_ratio"] > 0.0
+
+    def test_unregistered_metric_is_quietly_empty(self):
+        obj = Objective("ghost", KIND_LATENCY, "no_such_family_seconds",
+                        threshold_s=1.0)
+        eng = _engine(self.clock, self.reg, (obj,))
+        stats = eng.evaluate()["ghost"]
+        assert stats["events_long_window"] == 0
+        assert stats["budget_remaining_ratio"] == 1.0
+
+    def test_gauges_exported(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        self.total.labels("notebook", "error").inc(10)
+        self.clock.advance(30)
+        eng.evaluate()
+        text = self.reg.render()
+        assert 'notebook_slo_burn_rate{objective="errors",window="5m"}' \
+            in text
+        assert 'notebook_slo_error_budget_remaining_ratio{' \
+            'objective="errors"}' in text
+        assert 'notebook_slo_alert_firing{objective="errors"}' in text
+
+    def test_window_label(self):
+        assert window_label(300) == "5m"
+        assert window_label(3600) == "1h"
+        assert window_label(7200) == "2h"
+        assert window_label(90) == "90s"
+
+    def test_default_objectives_follow_config(self):
+        cfg = CoreConfig()
+        names = {o.name for o in default_objectives(cfg)}
+        assert names == {"time_to_ready", "event_to_reconcile",
+                         "reconcile_errors", "recovery_duration"}
+        cfg = CoreConfig(enable_slice_scheduler=True)
+        assert "warmpool_hit_rate" in \
+            {o.name for o in default_objectives(cfg)}
+        cfg = CoreConfig(slo_reconcile_error_rate=0.0)
+        assert "reconcile_errors" not in \
+            {o.name for o in default_objectives(cfg)}
+
+
+class TestAlertLifecycle:
+    def setup_method(self):
+        self.clock = FakeClock()
+        self.reg = Registry()
+        self.total = self.reg.counter(
+            "controller_runtime_reconcile_total", "t",
+            labels=("controller", "result"))
+
+    def _burst(self, errors=50, good=50):
+        self.total.labels("notebook", "success").inc(good)
+        self.total.labels("notebook", "error").inc(errors)
+
+    def _recover(self, eng, rounds=3):
+        for _ in range(rounds):
+            self.total.labels("notebook", "success").inc(200)
+            self.clock.advance(150)
+            eng.evaluate()
+
+    def test_fire_persist_resolve_refire(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        self._burst()
+        self.clock.advance(30)
+        eng.evaluate()
+        firing = eng.firing()
+        assert [a.objective for a in firing] == ["errors"]
+        first = firing[0]
+        assert first.state == "firing" and first.fired_at == self.clock.now()
+        assert first.burn_rates["5m"] >= 2.0
+
+        # persists (deduped) across scrapes while the breach continues
+        self.clock.advance(30)
+        eng.evaluate()
+        assert eng.firing()[0].seq == first.seq
+        assert len(eng.alert_history()) == 1
+
+        # resolves once the short window recovers
+        self._recover(eng)
+        assert not eng.firing()
+        hist = eng.alert_history()
+        assert len(hist) == 1 and hist[0].state == "resolved"
+        assert hist[0].resolved_at > hist[0].fired_at
+
+        # a fresh breach after resolution fires a NEW alert
+        self._burst(errors=200, good=0)
+        self.clock.advance(30)
+        eng.evaluate()
+        assert eng.firing()[0].seq == first.seq + 1
+        assert len(eng.alert_history()) == 2
+
+    def test_short_blip_against_calm_long_window_does_not_fire(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,), threshold=5.0)
+        # a big clean hour, then a tiny error blip: short window burns
+        # above threshold, long window stays calm -> no page
+        for _ in range(12):
+            self.total.labels("notebook", "success").inc(10_000)
+            self.clock.advance(300)
+            eng.evaluate()
+        self.clock.advance(300)  # idle: the clean bulk leaves the short
+        eng.evaluate()           # window but stays in the long one
+        self._burst(errors=10, good=90)
+        self.clock.advance(30)
+        stats = eng.evaluate()["errors"]
+        assert stats["burn_rates"]["5m"] >= 5.0
+        assert stats["burn_rates"]["1h"] < 5.0
+        assert not eng.firing()
+
+    def test_alert_latches_errored_attempt_trace(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        eng.observe_attempt(SimpleNamespace(
+            result="error", error="Boom: x", duration_s=0.1,
+            trace_id="deadbeef" * 4))
+        self._burst()
+        self.clock.advance(30)
+        eng.evaluate()
+        assert eng.firing()[0].trace_id == "deadbeef" * 4
+
+    def test_latency_alert_prefers_histogram_exemplar(self):
+        hist = self.reg.histogram("lat_seconds", "l", buckets=(0.1, 1.0))
+        obj = Objective("lat", KIND_LATENCY, "lat_seconds", threshold_s=1.0)
+        eng = _engine(self.clock, self.reg, (obj,))
+        hist.observe(30.0, exemplar={"trace_id": "feedface" * 4})
+        self.clock.advance(30)
+        eng.evaluate()
+        assert eng.firing()[0].trace_id == "feedface" * 4
+
+    def test_history_is_bounded(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,), max_alerts=4)
+        for _ in range(6):
+            self._burst(errors=100, good=0)
+            self.clock.advance(30)
+            eng.evaluate()
+            self._recover(eng)
+        assert not eng.firing()
+        assert len(eng.alert_history()) == 4
+        # oldest evicted: the retained alerts are the newest four
+        seqs = [a.seq for a in eng.alert_history()]
+        assert seqs == sorted(seqs) and seqs[-1] == 6
+
+    def test_firing_gauge_tracks_lifecycle(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        gauge = self.reg.get("notebook_slo_alert_firing")
+        self._burst()
+        self.clock.advance(30)
+        eng.evaluate()
+        assert gauge.value("errors") == 1.0
+        self._recover(eng)
+        assert gauge.value("errors") == 0.0
+
+    def test_snapshot_shape(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        self._burst()
+        self.clock.advance(30)
+        eng.evaluate()
+        snap = eng.snapshot()
+        assert snap["windows"] == ["5m", "1h"]
+        assert snap["objectives"]["errors"]["firing"] is True
+        assert snap["firing"][0]["objective"] == "errors"
+        assert snap["history"][0]["state"] == "firing"
+        json.dumps(snap)  # must be a plain JSON body for /debug/alerts
+
+    def test_verdicts(self):
+        eng = _engine(self.clock, self.reg, (ERROR_OBJ,))
+        self.total.labels("notebook", "success").inc(1000)
+        self.total.labels("notebook", "error").inc(1)
+        self.clock.advance(60)
+        v = eng.verdicts()["errors"]
+        assert v["met"] is True and v["events"] == 1001
+        self.total.labels("notebook", "error").inc(500)
+        self.clock.advance(60)
+        v = eng.verdicts()["errors"]
+        assert v["met"] is False and v["burn_rate"] > 1.0
+
+
+# -- overlapping_attempts sweep ------------------------------------------------
+
+
+def _span(controller, ns, name, start, end, attempt=1):
+    """A finished fake root span shaped like tracing.Span, carrying the
+    Manager's monotonic stamps."""
+    return SimpleNamespace(
+        name="reconcile", recording=True, parent=None,
+        trace_id=f"{random.getrandbits(64):016x}", span_id="s",
+        start_time=start, end_time=end,
+        attributes={"controller": controller, "namespace": ns,
+                    "name": name, "attempt": attempt,
+                    "reconcile.result": "success",
+                    "mono_start": start, "mono_end": end},
+        events=[], children=[])
+
+
+def _brute_force_overlaps(records):
+    out = []
+    by_key = {}
+    for r in records:
+        if r.mono_end > r.mono_start > 0.0:
+            by_key.setdefault((r.object_key, r.controller), []).append(r)
+    for runs in by_key.values():
+        runs.sort(key=lambda r: r.mono_start)
+        for i, a in enumerate(runs):
+            for b in runs[i + 1:]:
+                if b.mono_start < a.mono_end:
+                    out.append((a, b))
+    return out
+
+
+def _pair_set(pairs):
+    return {tuple(sorted(((p.mono_start, p.mono_end),
+                          (c.mono_start, c.mono_end)))) for p, c in pairs}
+
+
+class TestOverlapSweep:
+    def test_long_attempt_overlapping_several(self):
+        # [100,110] overlaps BOTH [101,102] and [103,104] — the shape the
+        # old adjacent-pair check under-reported (it missed the second)
+        fr = FlightRecorder()
+        for s, e in ((100.0, 110.0), (101.0, 102.0), (103.0, 104.0)):
+            fr.record(_span("notebook", "ns", "nb", s, e))
+        got = fr.overlapping_attempts()
+        assert len(got) == 2
+        assert _pair_set(got) == {
+            tuple(sorted(((100.0, 110.0), (101.0, 102.0)))),
+            tuple(sorted(((100.0, 110.0), (103.0, 104.0)))),
+        }
+
+    def test_touching_endpoints_are_clean(self):
+        fr = FlightRecorder()
+        fr.record(_span("notebook", "ns", "nb", 100.0, 101.0))
+        fr.record(_span("notebook", "ns", "nb", 101.0, 102.0))
+        assert fr.overlapping_attempts() == []
+
+    def test_distinct_keys_and_controllers_never_pair(self):
+        fr = FlightRecorder()
+        fr.record(_span("notebook", "ns", "a", 100.0, 110.0))
+        fr.record(_span("notebook", "ns", "b", 101.0, 102.0))
+        fr.record(_span("culling", "ns", "a", 101.0, 102.0))
+        assert fr.overlapping_attempts() == []
+
+    def test_unstamped_attempts_skipped(self):
+        fr = FlightRecorder()
+        span = _span("notebook", "ns", "nb", 100.0, 110.0)
+        span.attributes["mono_start"] = 0.0
+        span.attributes["mono_end"] = 0.0
+        fr.record(span)
+        fr.record(_span("notebook", "ns", "nb", 101.0, 102.0))
+        assert fr.overlapping_attempts() == []
+
+    def test_sweep_equals_brute_force_on_seeded_histories(self):
+        rng = random.Random(20260804)
+        for trial in range(20):
+            fr = FlightRecorder(capacity=4096, per_object=512)
+            for _ in range(rng.randrange(20, 120)):
+                ctrl = rng.choice(("notebook", "odh-notebook", "culling"))
+                name = f"nb-{rng.randrange(6)}"
+                start = round(rng.uniform(1, 50), 6)
+                end = round(start + rng.uniform(0.001, 8), 6)
+                fr.record(_span(ctrl, "ns", name, start, end))
+            recs = [r for recs in
+                    (fr.attempts(k) for k in fr.objects()) for r in recs]
+            expect = _pair_set(_brute_force_overlaps(recs))
+            got = fr.overlapping_attempts()
+            assert _pair_set(got) == expect, f"trial {trial}"
+            assert len(got) == len(_brute_force_overlaps(recs))
+
+
+# -- fleet rollup --------------------------------------------------------------
+
+
+class TestFleetState:
+    def _nb(self, status):
+        return SimpleNamespace(
+            namespace="ns", body={"status": status},
+            spec={"tpu": {"accelerator": "v5e", "topology": "4x4"}})
+
+    def test_buckets(self):
+        assert fleet_state(self._nb({"sliceHealth": "Healthy"})) == "ready"
+        assert fleet_state(self._nb({"sliceHealth": "Degraded"})) \
+            == "degraded"
+        assert fleet_state(self._nb({"sliceHealth": "Unhealthy"})) \
+            == "degraded"
+        assert fleet_state(self._nb(
+            {"sliceHealth": "Degraded",
+             "sliceRecovery": {"0": {"attempts": [{"at": 1.0}]}}})) \
+            == "recovering"
+        assert fleet_state(self._nb({"sliceHealth": "Scheduling"})) \
+            == "scheduling"
+        assert fleet_state(self._nb({"sliceHealth": "Stopped"})) == "stopped"
+        assert fleet_state(self._nb(
+            {"sliceHealth": "Degraded", "conditions": [
+                {"type": CONDITION_RECOVERY_EXHAUSTED, "status": "True"},
+            ]})) == "exhausted"
+        assert fleet_state(self._nb({})) == "pending"
+        assert fleet_state(self._nb({"readyReplicas": 1})) == "ready"
+        assert set(FLEET_STATES) >= {
+            "ready", "degraded", "recovering", "exhausted"}
+
+
+class TestFleetRollup:
+    def _stack(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 12, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api, manager=mgr)
+        cfg = CoreConfig(enable_self_healing=False)
+        setup_core_controllers(mgr, cfg, metrics)
+        return api, cluster, clock, mgr, metrics
+
+    def _ground_truth(self, api):
+        totals = {s: 0 for s in FLEET_STATES}
+        for nb in api.list("Notebook"):
+            totals[fleet_state(nb)] += 1
+        return totals
+
+    def test_rollup_matches_apiserver_ground_truth(self):
+        from kubeflow_tpu.core import constants as CC
+
+        api, cluster, clock, mgr, metrics = self._stack()
+        for i in range(3):
+            api.create(Notebook.new(f"ready-{i}", "user1",
+                                    tpu=TPUSpec("v5e", "4x4")).obj)
+        api.create(Notebook.new("cpu", "user2").obj)
+        mgr.run_until_idle()
+        # degrade one slice (self-healing off so it STAYS degraded)
+        cluster.fail_pod("user1", "ready-0-1")
+        mgr.run_until_idle()
+        # stop another
+        nb = api.get("Notebook", "user1", "ready-1")
+        nb.metadata.annotations[CC.STOP_ANNOTATION] = "true"
+        api.update(nb)
+        mgr.settle(max_seconds=600.0)
+        # and one never reconciled at all (created after the last drain)
+        api.create(Notebook.new("fresh", "user3",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+
+        snap = metrics.fleet_snapshot()
+        truth = self._ground_truth(api)
+        assert snap["totals"] == truth
+        assert snap["notebooks"] == sum(truth.values())
+        assert snap["namespaces"]["user1"]["degraded"] == 1
+        assert snap["namespaces"]["user1"]["stopped"] == 1
+        assert snap["shapes"]["v5e-4x4"]["ready"] == 1
+        # the CPU notebook contributes to its namespace but to no shape
+        assert snap["namespaces"]["user2"] == {"ready": 1}
+        assert "scheduling" not in snap["shapes"]["v5e-4x4"] or \
+            snap["shapes"]["v5e-4x4"]["scheduling"] >= 0
+
+        # incremental: a state transition moves the counts, no rescan
+        mgr.run_until_idle()  # fresh notebook converges
+        snap2 = metrics.fleet_snapshot()
+        assert snap2["totals"] == self._ground_truth(api)
+        assert snap2["totals"]["ready"] == snap["totals"]["ready"] + 1
+
+    def test_rollup_without_cache_falls_back_to_lists(self):
+        api = ApiServer()
+        metrics = NotebookMetrics(api)  # no manager, no cache
+        api.create(Notebook.new("a", "ns1").obj)
+        api.create(Notebook.new("b", "ns2").obj)
+        snap = metrics.fleet_snapshot()
+        assert snap["totals"]["pending"] == 2
+        assert snap["namespaces"] == {"ns1": {"pending": 1},
+                                      "ns2": {"pending": 1}}
+
+    def test_fleet_endpoint_over_http(self):
+        from kubeflow_tpu.main import serve_http
+
+        api, cluster, clock, mgr, metrics = self._stack()
+        api.create(Notebook.new("nb", "user1",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        server = serve_http(0, mgr, metrics)
+        port = server.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/fleet", timeout=5) as r:
+                body = json.loads(r.read().decode())
+            assert body["totals"] == self._ground_truth(api)
+            assert body["namespaces"]["user1"] == {"ready": 1}
+            # alerts + profile endpoints answer too (profiler disabled)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/alerts", timeout=5) as r:
+                alerts = json.loads(r.read().decode())
+            assert alerts == {"enabled": False,
+                              "error": "no SLO engine attached to this "
+                                       "manager"}
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile",
+                    timeout=5) as r:
+                prof = json.loads(r.read().decode())
+            assert prof["enabled"] is False
+        finally:
+            server.shutdown()
+            mgr.stop()
+
+
+# -- continuous profiler -------------------------------------------------------
+
+
+class TestProfiler:
+    def test_attribution_from_live_span_stack(self):
+        root = SimpleNamespace(attributes={"controller": "notebook"})
+        child = SimpleNamespace(attributes={"phase": "render"})
+        assert attribute((root, child)) == ("notebook", "render")
+        assert attribute((root,)) == ("notebook", "reconcile")
+        assert attribute(()) == (UNATTRIBUTED, UNATTRIBUTED)
+        # innermost phase wins (odh auth nested inside routing)
+        inner = SimpleNamespace(attributes={"phase": "auth"})
+        outer = SimpleNamespace(attributes={"phase": "routing"})
+        assert attribute((root, outer, inner)) == ("notebook", "auth")
+
+    def test_sample_once_attributes_spanned_thread(self):
+        reg = Registry()
+        prof = ContinuousProfiler(registry=reg)
+        tracer = tracing.get_tracer("test")
+        ready, done = threading.Event(), threading.Event()
+
+        def worker():
+            with tracer.start_span("reconcile",
+                                   {"controller": "notebook"}):
+                with tracer.start_span("render", {"phase": "render"}):
+                    ready.set()
+                    done.wait(5.0)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert ready.wait(5.0)
+        try:
+            assert prof.sample_once() >= 1
+        finally:
+            done.set()
+            t.join(timeout=5.0)
+        snap = prof.snapshot()
+        assert snap["samples_total"] >= 1
+        assert any(s["controller"] == "notebook" and s["phase"] == "render"
+                   and "test_slo.py:worker" in s["stack"]
+                   for s in snap["stacks"]), snap["stacks"]
+        # counter fed through the registry
+        assert reg.get("notebook_profiler_samples_total").value() >= 1
+        # the worker finished: its live-stack entry is gone
+        assert not any("worker" in str(s)
+                       for s in tracing.live_span_stacks().values())
+
+    def test_collapsed_format(self):
+        prof = ContinuousProfiler()
+        prof._record("notebook", "apply", "a.py:f;b.py:g")
+        prof._record("notebook", "apply", "a.py:f;b.py:g")
+        prof._record("-", "-", "main.py:loop")
+        text = prof.collapsed()
+        assert "notebook;apply;a.py:f;b.py:g 2" in text.splitlines()
+        assert "-;-;main.py:loop 1" in text.splitlines()
+
+    def test_store_is_bounded(self):
+        prof = ContinuousProfiler(max_stacks=3)
+        for i in range(10):
+            prof._record("c", "p", f"stack-{i}")
+        prof._record("c", "p", "stack-0")  # existing key still counts
+        snap = prof.snapshot()
+        assert snap["distinct_stacks"] == 3
+        assert snap["overflow_samples"] == 7
+        assert snap["samples_total"] == 11
+
+    def test_overhead_ratio_measured(self):
+        reg = Registry()
+        prof = ContinuousProfiler(registry=reg, interval_s=0.002)
+        assert prof.overhead_ratio() == 0.0  # not started yet
+        prof.start()
+        try:
+            import time
+            deadline = time.monotonic() + 2.0
+            while prof.passes < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            prof.stop()
+        assert prof.passes >= 5
+        ratio = prof.overhead_ratio()
+        assert 0.0 < ratio < 0.5
+        # the gauge serves the same number via set_function
+        assert reg.get("notebook_profiler_overhead_ratio").collect()[()] \
+            == pytest.approx(ratio, abs=0.05)
+
+    def test_off_by_default_in_wired_stack(self):
+        from kubeflow_tpu.main import build_manager
+
+        mgr, api, cluster, metrics = build_manager(
+            core_cfg=CoreConfig.from_env({}))
+        try:
+            assert mgr.profiler is None
+            # families present (drift-golden stability) even while off
+            text = metrics.scrape()
+            assert "# TYPE notebook_profiler_overhead_ratio gauge" in text
+            assert "notebook_profiler_overhead_ratio 0" in text
+        finally:
+            mgr.stop()
+
+    def test_enabled_via_config(self):
+        from kubeflow_tpu.main import build_manager
+
+        mgr, api, cluster, metrics = build_manager(
+            core_cfg=CoreConfig.from_env(
+                {"ENABLE_CONTINUOUS_PROFILER": "true",
+                 "PROFILER_INTERVAL_MS": "2"}))
+        try:
+            assert mgr.profiler is not None and mgr.profiler.running
+            assert mgr.profiler.interval_s == pytest.approx(0.002)
+        finally:
+            mgr.profiler.stop()
+            mgr.stop()
+
+
+# -- diagnostics bundle --------------------------------------------------------
+
+
+class TestDiagnoseBundle:
+    def _converged_stack(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 4, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api, manager=mgr)
+        cfg = CoreConfig()
+        setup_core_controllers(mgr, cfg, metrics)
+        engine = SLOEngine(default_objectives(cfg),
+                           [metrics.registry, mgr.metrics_registry],
+                           clock=clock, recorder=mgr.flight_recorder)
+        mgr.slo_engine = engine
+        metrics.attach_slo(engine)
+        api.create(Notebook.new("nb", "user1",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+        mgr.run_until_idle()
+        return api, mgr, metrics
+
+    def test_redacted_config(self):
+        env = {
+            "WORKQUEUE_WORKERS": "8",
+            "SLO_RECONCILE_ERROR_RATE": "0.01",
+            "OTEL_EXPORTER_OTLP_TOKEN": "hunter2",
+            "CHECKPOINT_STORE_SECRET": "s3cr3t",
+            "HOME": "/root",          # not config surface: excluded
+            "PATH": "/usr/bin",
+        }
+        red = redacted_config(env)
+        assert red["WORKQUEUE_WORKERS"] == "8"
+        assert red["SLO_RECONCILE_ERROR_RATE"] == "0.01"
+        assert red["OTEL_EXPORTER_OTLP_TOKEN"] == REDACTED
+        assert red["CHECKPOINT_STORE_SECRET"] == REDACTED
+        assert "HOME" not in red and "PATH" not in red
+
+    def test_local_bundle_reconstructs_slowest_attempt(self):
+        api, mgr, metrics = self._converged_stack()
+        bundle = collect_local(mgr, metrics,
+                               env={"WORKQUEUE_WORKERS": "1"})
+        json.dumps(bundle, default=str)  # one serializable artifact
+        assert bundle["bundle_format"] == 1
+        assert "# TYPE controller_runtime_reconcile_total counter" in \
+            bundle["metrics"]
+        assert bundle["fleet"]["totals"]["ready"] == 1
+        assert bundle["alerts"]["firing"] == []
+        assert bundle["slo_verdicts"]["reconcile_errors"]["met"] is True
+        assert bundle["config"] == {"WORKQUEUE_WORKERS": "1"}
+        assert bundle["workqueue"]["depth"] == 0
+        # the slowest retained attempt is fully reconstructable from the
+        # bundle alone: summary -> trace id -> span tree with phases
+        slowest = bundle["reconciles"]["slowest"][0]
+        tree = bundle["traces"][slowest["trace_id"]]
+        assert tree["spans"], slowest
+        roots = [s for s in tree["spans"]
+                 if s["span_id"] == slowest["span_id"]]
+        assert len(roots) == 1
+        assert slowest["phases"].keys() <= {
+            c["attributes"].get("phase", c["name"])
+            for c in roots[0]["children"]} | set(slowest["phases"])
+        mgr.stop()
+
+    def test_http_bundle_and_cli(self, tmp_path):
+        from kubeflow_tpu.main import serve_http
+
+        api, mgr, metrics = self._converged_stack()
+        server = serve_http(0, mgr, metrics)
+        port = server.server_address[1]
+        try:
+            bundle = collect_http(f"http://127.0.0.1:{port}")
+            assert bundle["source"].endswith(str(port))
+            assert bundle["fleet"]["totals"]["ready"] == 1
+            slowest = bundle["reconciles"]["slowest"][0]
+            assert bundle["traces"][slowest["trace_id"]]["spans"]
+            assert bundle["profile"]["enabled"] is False
+
+            out = tmp_path / "bundle.json"
+            rc = diagnose_main(["--addr", f"127.0.0.1:{port}",
+                                "--out", str(out)])
+            assert rc == 0
+            written = json.loads(out.read_text())
+            assert written["bundle_format"] == 1
+            assert written["reconciles"]["recorded_total"] > 0
+        finally:
+            server.shutdown()
+            mgr.stop()
+
+    def test_cli_unreachable_manager_fails_cleanly(self, tmp_path):
+        rc = diagnose_main(["--addr", "127.0.0.1:1",  # nothing listens
+                            "--out", str(tmp_path / "b.json"),
+                            "--timeout", "0.5"])
+        assert rc == 1
+        assert not (tmp_path / "b.json").exists()
+
+
+class TestLoadtestSLOVerdicts:
+    def test_run_fleet_records_slo_verdicts(self):
+        import importlib.util
+        from pathlib import Path
+
+        spec = importlib.util.spec_from_file_location(
+            "loadtest_convergence",
+            Path(__file__).parent.parent / "loadtest" / "convergence.py")
+        conv = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(conv)
+        result = conv.run_fleet(6, 1, compute_state=False)
+        slo = result["slo"]
+        assert {"time_to_ready", "event_to_reconcile",
+                "reconcile_errors", "recovery_duration"} <= set(slo)
+        for name, verdict in slo.items():
+            assert verdict["met"] is True, (name, verdict)
+        json.dumps(result)  # --out writes this verbatim
