@@ -113,25 +113,29 @@ def main_decode(num_steps: int) -> None:
         dt = time.perf_counter() - t0
         best = max(best, batch * new_tokens / dt)
     from kubeflow_tpu.models.quant import quantized_bytes
+    from kubeflow_tpu.runtime.roofline import decode_estimate
 
     # Streamed bytes per step: every matmul weight once.  The embedding
     # table (vocab*d) is a per-token row lookup and does NOT stream —
     # counting it understated the roofline ~10% at this scale (round-4
     # advisor finding) — EXCEPT for tied configs, where the table is the
     # LM-head matmul weight (transformer.py head()) and streams fully.
+    # The floor itself is runtime.roofline's decode_estimate, fed the
+    # measured byte count off the real (possibly quantized) tree.
     exclude = () if config.tie_embeddings else ("embed",)
     param_bytes = quantized_bytes(params, exclude=exclude)
-    kv_bytes = (2 * batch * config.max_seq_len * config.num_kv_heads
-                * config.head_dim * 2 * config.num_layers)
-    roofline_steps = (ACCELERATORS[accel].hbm_gbps * 1e9
-                      / (param_bytes + kv_bytes))
-    roofline_tok_s = roofline_steps * batch
+    est = decode_estimate(config, batch, accelerator=accel,
+                          param_bytes=param_bytes)
+    kv_bytes = est.hbm_bytes - param_bytes
+    roofline_tok_s = batch / est.memory_floor_s
     print(json.dumps({
         "metric": f"decode_tok_s_{accel}" + (
             "_int8" if int8 else "_int4" if int4 else ""),
         "value": round(best, 1),
         "unit": "tokens/s",
         "vs_baseline": round(best / roofline_tok_s, 4),
+        "roofline_fraction": round(best / est.tokens_per_s_ceiling, 4),
+        "bound": est.bound,
         "detail": {
             "model": "bench-chip-470m" if backend != "cpu" else "tiny-cpu",
             "batch": batch, "prompt_len": prompt_len,
@@ -205,11 +209,16 @@ def main_vit(num_steps: int) -> None:
     flops = vit_flops_per_image(cfg) * best
     peak = ACCELERATORS[accel].bf16_peak_tflops * 1e12
     achieved = flops / peak
+    # no HBM traffic model for the encoder family yet: the compute
+    # roofline IS the peak, so roofline_fraction == MFU and the workload
+    # reads compute-bound by construction
     print(json.dumps({
         "metric": "train_mfu_v5e_vit_b16",
         "value": round(achieved, 4),
         "unit": "fraction",
         "vs_baseline": round(achieved / MFU_TARGET, 4),
+        "roofline_fraction": round(achieved, 4),
+        "bound": "compute",
         "detail": {
             "model": "vit-b16" if backend != "cpu" else "vit-tiny-cpu",
             "images_per_s": round(best, 1),
@@ -298,6 +307,15 @@ def main(long_context: bool = False, moe: bool = False) -> None:
     achieved_mfu = mfu(
         result["tokens_per_s"], config, seq, num_chips=len(devices), accelerator=accel
     )
+    # roofline attribution (runtime/roofline.py — the ONE MFU/floor
+    # definition the TelemetryAgent publishes too): which resource the
+    # analytic model says binds this workload, and how close the measured
+    # step ran to the floor.  Emitted on every result, CPU smoke included
+    # (ci/bench_trajectory_check.py requires the fields on all paths).
+    from kubeflow_tpu.runtime.roofline import train_estimate
+
+    est = train_estimate(config, batch, seq, num_chips=len(devices),
+                         accelerator=accel)
     print(
         json.dumps(
             {
@@ -307,6 +325,9 @@ def main(long_context: bool = False, moe: bool = False) -> None:
                 "value": round(achieved_mfu, 4),
                 "unit": "fraction",
                 "vs_baseline": round(achieved_mfu / MFU_TARGET, 4),
+                "roofline_fraction": round(
+                    est.roofline_fraction(result["step_time_s"]), 4),
+                "bound": est.bound,
                 "detail": {
                     "model": ("tiny-cpu" if backend == "cpu"
                               else "bench-moe-760m" if moe

@@ -1,0 +1,149 @@
+"""Bench-trajectory gate: the headline MFU may never silently regress.
+
+The repo's bench history lives in BENCH_r*.json — one record per round,
+written by the driver as {"n": <round>, "rc": <exit>, "parsed": <the
+bench.py JSON line, or null when the run crashed before printing one>}.
+The roofline chase stalled once already because round 5 crashed on an
+unavailable TPU backend and NOTHING noticed until a human read the file:
+rc 1, parsed null, headline target unmeasured for two PRs.  This gate
+makes that class of silence a CI failure:
+
+  - the newest MEASURED run of the headline metric (train_mfu_v5e) must
+    not regress sustained MFU more than --max-regression (default 10%)
+    below the best run so far;
+  - the newest record must not be a silent skip: a {"skipped": true}
+    result without a "reason" field fails (bench.py emits the reason on
+    every fallback path — its absence means an unknown writer);
+  - unparseable records (parsed null — a crash predating the bench
+    fallback, like r05) are surfaced as warnings: they carry no
+    measurement, so they cannot gate, but the newest one being a crash
+    is printed loudly so the next bench round re-measures.
+
+Pure stdlib; wired into ci/run_tests.sh.  Exit 0 = trajectory healthy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+
+HEADLINE_METRIC = "train_mfu_v5e"
+
+
+def load_records(paths: list[str]) -> list[dict]:
+    """Normalize each BENCH file to {"n", "rc", "result"} where result is
+    the bench.py JSON object or None.  Accepts both the driver envelope
+    ({"n":..,"parsed":..}) and a bare bench.py line (local runs)."""
+    records = []
+    for path in sorted(paths):
+        with open(path) as f:
+            raw = json.load(f)
+        if "parsed" in raw or "rc" in raw:
+            n = raw.get("n")
+            if n is None:
+                m = re.search(r"r(\d+)", os.path.basename(path))
+                n = int(m.group(1)) if m else len(records) + 1
+            records.append({"path": path, "n": int(n),
+                            "rc": raw.get("rc", 0),
+                            "result": raw.get("parsed")})
+        else:
+            m = re.search(r"r(\d+)", os.path.basename(path))
+            records.append({"path": path,
+                            "n": int(m.group(1)) if m else len(records) + 1,
+                            "rc": 0, "result": raw})
+    records.sort(key=lambda r: r["n"])
+    return records
+
+
+def check(records: list[dict], max_regression: float = 0.10,
+          metric: str = HEADLINE_METRIC) -> tuple[bool, list[str]]:
+    """Returns (ok, messages).  Gating rules in the module docstring."""
+    msgs: list[str] = []
+    if not records:
+        return True, ["no bench records found — nothing to gate"]
+    measured = []
+    for rec in records:
+        res = rec["result"]
+        if res is None:
+            msgs.append(
+                f"WARN r{rec['n']:02d}: no parseable bench result "
+                f"(rc {rec['rc']}) — crashed before the JSON line; "
+                "carries no measurement")
+            continue
+        if res.get("skipped"):
+            if not res.get("reason"):
+                if rec is records[-1]:
+                    msgs.append(
+                        f"FAIL r{rec['n']:02d}: skipped without a "
+                        "'reason' field — silent skips are exactly the "
+                        "regression this gate exists to catch")
+                    return False, msgs
+                msgs.append(f"WARN r{rec['n']:02d}: silent skip "
+                            "(no reason) in history")
+            else:
+                msgs.append(f"note r{rec['n']:02d}: skipped "
+                            f"({res['reason'][:80]})")
+            continue
+        if res.get("metric") != metric:
+            continue
+        measured.append((rec["n"], float(res["value"]), res))
+    if not measured:
+        msgs.append(f"WARN: no measured {metric} runs in history — "
+                    "gate passes vacuously, but the target is unmeasured")
+        return True, msgs
+    best_n, best = max(((n, v) for n, v, _ in measured),
+                       key=lambda t: t[1])
+    newest_n, newest, newest_res = measured[-1]
+    floor = best * (1.0 - max_regression)
+    msgs.append(
+        f"trajectory: {len(measured)} measured runs, best {best:.4f} "
+        f"(r{best_n:02d}), newest {newest:.4f} (r{newest_n:02d}), "
+        f"floor {floor:.4f}")
+    if records[-1]["result"] is None:
+        msgs.append(
+            f"WARN: newest record r{records[-1]['n']:02d} is a crash — "
+            f"gating on the newest measured run r{newest_n:02d} instead; "
+            "re-measure the headline next bench round")
+    for key in ("roofline_fraction", "bound"):
+        if key in newest_res:
+            msgs.append(f"  newest {key}: {newest_res[key]}")
+    if newest < floor:
+        msgs.append(
+            f"FAIL: newest measured MFU {newest:.4f} regresses more than "
+            f"{max_regression:.0%} below the best-so-far {best:.4f}")
+        return False, msgs
+    return True, msgs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="gate CI on the BENCH_r*.json MFU trajectory")
+    parser.add_argument("--glob", default="BENCH_r*.json",
+                        help="bench-history files (default %(default)s, "
+                             "relative to --root)")
+    parser.add_argument("--root",
+                        default=os.path.dirname(os.path.dirname(
+                            os.path.abspath(__file__))),
+                        help="repo root holding the bench history")
+    parser.add_argument("--max-regression", type=float, default=0.10,
+                        help="allowed fraction below best-so-far "
+                             "(default %(default)s)")
+    parser.add_argument("--metric", default=HEADLINE_METRIC)
+    args = parser.parse_args(argv)
+
+    paths = glob.glob(os.path.join(args.root, args.glob))
+    records = load_records(paths)
+    ok, msgs = check(records, max_regression=args.max_regression,
+                     metric=args.metric)
+    for m in msgs:
+        print(f"bench-trajectory: {m}")
+    print(f"bench-trajectory: {'OK' if ok else 'REGRESSED'} "
+          f"({len(records)} records)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -113,6 +113,32 @@ assert fleet["notebooks"] >= 1, fleet
 assert sum(fleet["totals"].values()) == fleet["notebooks"], fleet
 assert "default" in fleet["namespaces"], fleet
 
+# data-plane rollup: the demo workers published telemetry annotations
+# (main.py --demo plays the training loops), so /debug/fleet must carry
+# the per-notebook worker rollup with roofline-consistent stats — poll
+# briefly, the stamp lands just after the notebook turns Healthy
+deadline = time.time() + 15
+while True:
+    _, _, body = get("/debug/fleet")
+    dataplane = json.loads(body).get("dataplane") or {}
+    if dataplane.get("notebooks"):
+        break
+    if time.time() > deadline:
+        raise SystemExit("/debug/fleet never carried the data-plane rollup")
+    time.sleep(0.25)
+demo = dataplane["notebooks"]["default/demo"]
+assert demo["workers"], demo
+assert demo["tokens_per_s"] > 0 and 0 < demo["mfu"] < 1, demo
+assert demo["straggler"] is None, demo  # healthy demo slice
+assert dataplane["stragglers"] == [], dataplane
+for w in demo["workers"].values():
+    assert w["step_time_s"] > 0, demo
+
+# the dataplane gauges surface on /metrics too
+_, _, body = get("/metrics")
+assert 'notebook_dataplane_mfu_ratio{namespace="default",name="demo"}' \
+    in body, "dataplane gauge missing from scrape"
+
 # continuous profiler: enabled for this boot, samples flowing, overhead
 # gauge under the 5% always-on budget
 _, _, body = get("/debug/profile")
@@ -144,5 +170,11 @@ assert trace["spans"], slowest
 assert bundle["fleet"]["notebooks"] >= 1
 assert bundle["profile"]["samples_total"] > 0
 assert "config" in bundle
-print("diagnose smoke: OK (bundle resolves its slowest attempt offline)")
+# the bundle carries the worker telemetry rollup (offline straggler
+# attribution), mirrored from the fleet rollup's dataplane section
+telem = bundle["telemetry"]
+assert telem and telem["notebooks"]["default/demo"]["workers"], telem
+assert bundle["fleet"]["dataplane"]["notebooks"], bundle["fleet"].keys()
+print("diagnose smoke: OK (bundle resolves its slowest attempt offline, "
+      "worker telemetry included)")
 EOF

@@ -73,6 +73,10 @@ fi
 # recovery soak — all FakeClock-driven, seconds of wall time)
 if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   bash ci/chaos_soak.sh
+  # bench trajectory: the newest measured headline MFU must stay within
+  # 10% of the best-so-far, and a skipped bench run must carry a reason —
+  # the r05 silent-crash class of regression fails here now
+  python ci/bench_trajectory_check.py
   # metric-family inventory vs the committed golden list — renames/removals
   # fail here instead of silently breaking dashboards
   bash ci/metrics_drift_check.sh

@@ -40,6 +40,7 @@ from kubeflow_tpu.models.generate import generate  # noqa: E402
 from kubeflow_tpu.models.train import setup_training  # noqa: E402
 from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: E402
 from kubeflow_tpu.runtime.data import input_pipeline  # noqa: E402
+from kubeflow_tpu.runtime.telemetry import TelemetryAgent  # noqa: E402
 
 
 def main() -> None:
@@ -64,10 +65,17 @@ def main() -> None:
 
     pipe = input_pipeline(tokens, global_batch=16, seq_len=64, mesh=mesh,
                           num_epochs=None, prefetch=2)
+    # the data-plane telemetry contract: one step_boundary() per synced
+    # step; on a provisioned worker the summary publishes into the pod's
+    # telemetry annotation for the control plane's straggler detection
+    agent = TelemetryAgent(config=TINY, batch=16, seq_len=64,
+                           num_chips=len(devices))
     state, first_loss, last_loss = setup.state, None, None
+    agent.step_boundary()
     for step, batch in enumerate(pipe):
         state, metrics = setup.train_step(state, batch)
         loss = float(metrics["loss"])
+        agent.step_boundary()
         first_loss = first_loss if first_loss is not None else loss
         last_loss = loss
         if step % 10 == 0:
@@ -76,7 +84,10 @@ def main() -> None:
             pipe.close()
             break
     assert last_loss < first_loss, (first_loss, last_loss)
-    print(f"trained: loss {first_loss:.4f} -> {last_loss:.4f}")
+    summary = agent.summary()
+    print(f"trained: loss {first_loss:.4f} -> {last_loss:.4f}  "
+          f"({summary['tokens_per_s']:.0f} tok/s, mfu {summary['mfu']:.4f},"
+          f" {summary['bound']}-bound)")
 
     params = jax.device_get(state.params)
     prompt = np.stack([np.arange(10, 15), np.arange(100, 105)]).astype(np.int32)

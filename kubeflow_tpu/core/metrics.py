@@ -13,6 +13,7 @@ from ..utils.metrics import Registry
 from ..utils.profiler import register_profiler_metrics
 from ..utils.slo import register_slo_metrics
 from . import constants as C
+from .telemetry import register_dataplane_metrics
 
 # /debug/fleet health states, derived per Notebook by fleet_state(); a
 # bounded set so the rollup is O(namespaces x states), never O(fleet)
@@ -216,9 +217,18 @@ class NotebookMetrics:
             register_slo_metrics(self.registry)
         self.profiler_overhead, self.profiler_samples = \
             register_profiler_metrics(self.registry)
+        # data-plane rollup families (core/telemetry.py): registered here
+        # so the inventory is identical whether or not a
+        # WorkerTelemetryAggregator is attached; the aggregator
+        # re-registers identically and feeds the same objects
+        register_dataplane_metrics(self.registry)
         # SLOEngine attached via attach_slo(): evaluated at every scrape
         # so burn rates/alerts advance at scrape resolution
         self.slo = None
+        # WorkerTelemetryAggregator attached via attach_dataplane():
+        # evaluated at every scrape, BEFORE the SLO engine so its verdict
+        # counters are fresh when the burn rates read them
+        self.dataplane = None
         # last snapshot of the manager's cumulative totals, so each scrape
         # feeds the counters exactly the delta since the previous scrape
         self._counter_snapshots: dict[tuple, float] = {}
@@ -238,6 +248,12 @@ class NotebookMetrics:
         rates, budget gauges, alert transitions) so the SLO verdict
         advances exactly as often as anyone looks at the fleet."""
         self.slo = engine
+
+    def attach_dataplane(self, aggregator) -> None:
+        """Attach a WorkerTelemetryAggregator; every scrape() rolls the
+        per-worker telemetry annotations into the notebook_dataplane_*
+        series and runs straggler detection."""
+        self.dataplane = aggregator
 
     def _feed_counter(self, counter, label, total: float) -> None:
         """Advance a monotonic counter to `total` using deltas against the
@@ -367,6 +383,10 @@ class NotebookMetrics:
                     stats.get("longest_running_s", {}).get(name, 0.0))
                 self._feed_counter(self.reconcile_errors_total, name,
                                    stats["errors_total"].get(name, 0))
+        if self.dataplane is not None:
+            # data-plane rollup first: the SLO engine's straggler/MFU
+            # objectives read the verdict counters this evaluation feeds
+            self.dataplane.evaluate()
         if self.slo is not None:
             # burn rates / budget gauges / alert lifecycle advance at
             # scrape resolution, exactly like a Prometheus-side burn rule
@@ -415,6 +435,8 @@ class NotebookMetrics:
                 "objectives": snap["objectives"],
                 "firing": snap["firing"],
             }
+        if self.dataplane is not None:
+            out["dataplane"] = self.dataplane.snapshot()
         return out
 
     def _scrape_census_from_cache(self, cache) -> None:

@@ -26,6 +26,7 @@ from .store import ApiServer, EventType, WatchEvent
 # kube substrate stays importable without the core package)
 _NOTEBOOK_NAME_LABEL = "notebook-name"
 _TPU_SLICE_LABEL = "notebooks.kubeflow.org/tpu-slice"
+_TELEMETRY_ANNOTATION = "notebooks.kubeflow.org/telemetry"
 _RESTORED_GENERATION_ANNOTATION = \
     "notebooks.kubeflow.org/restored-generation"
 _RESTORED_DIGEST_ANNOTATION = "notebooks.kubeflow.org/restored-digest"
@@ -314,6 +315,77 @@ class FakeCluster:
                     return
                 self._mark_running(pod)
                 self._sync_sts_status_for_pod(pod)
+
+    # -- data-plane telemetry --------------------------------------------------
+    def stamp_worker_telemetry(
+        self,
+        namespace: str,
+        notebook: str,
+        step_time_s: float = 1.0,
+        *,
+        flops_per_token: float = 0.0,
+        config=None,
+        batch: int = 1,
+        seq_len: int = 1,
+        num_chips: int = 4,
+        accelerator: str = "v5e",
+        steps: int = 3,
+        slow_worker: Optional[object] = None,
+        slow_factor: float = 4.0,
+        now: float = 0.0,
+    ) -> dict[str, dict]:
+        """Play the data plane's training loops: run a real
+        runtime.telemetry.TelemetryAgent per worker pod of `notebook`
+        (the identical code path — and therefore the identical
+        roofline-derived MFU — a worker publishes) and stamp each rolling
+        summary into the pod's telemetry annotation for the control
+        plane's WorkerTelemetryAggregator to read watch-fed.
+
+        `slow_worker` (a pod name or an ordinal into the sorted pod
+        list) records `slow_factor` x the step time — the deliberately
+        slow worker straggler drills inject.  Returns pod name ->
+        published summary."""
+        from ..runtime.telemetry import TelemetryAgent, annotation_payload
+
+        with self.api.fault_exempt():
+            pods = sorted(
+                (p for p in self.api.list("Pod", namespace=namespace)
+                 if p.metadata.labels.get(_NOTEBOOK_NAME_LABEL) == notebook
+                 and p.metadata.deletion_timestamp is None),
+                key=lambda p: p.name)
+            out: dict[str, dict] = {}
+            for i, pod in enumerate(pods):
+                dt = step_time_s
+                if slow_worker is not None and \
+                        slow_worker in (i, pod.name):
+                    dt = step_time_s * slow_factor
+                agent = TelemetryAgent(
+                    config=config, flops_per_token=flops_per_token,
+                    batch=batch, seq_len=seq_len, num_chips=num_chips,
+                    accelerator=accelerator, worker=pod.name,
+                    time_fn=lambda t=now: t, hbm_fn=lambda: {})
+                for _ in range(max(1, steps)):
+                    agent.record_step(dt)
+                summary = agent.summary()
+                live = self.api.get("Pod", namespace, pod.name).deepcopy()
+                live.metadata.annotations[_TELEMETRY_ANNOTATION] = \
+                    annotation_payload(summary)
+                self.api.update(live)
+                out[pod.name] = summary
+            return out
+
+    def clear_worker_telemetry(self, namespace: str, notebook: str) -> None:
+        """Drop the telemetry annotations (a worker that stopped
+        reporting — the aggregator must zero its series)."""
+        with self.api.fault_exempt():
+            for p in self.api.list("Pod", namespace=namespace):
+                if p.metadata.labels.get(_NOTEBOOK_NAME_LABEL) != notebook:
+                    continue
+                if _TELEMETRY_ANNOTATION not in p.metadata.annotations:
+                    continue
+                live = p.deepcopy()
+                del live.metadata.annotations[_TELEMETRY_ANNOTATION]
+                self.api.update(live)
 
     # -- session-state data plane ----------------------------------------------
     def attach_session_store(self, store,

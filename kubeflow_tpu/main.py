@@ -293,6 +293,21 @@ def build_manager(
         recorder=mgr.flight_recorder)
     metrics.attach_slo(engine)
     mgr.slo_engine = engine
+    # data-plane rollup: per-worker telemetry annotations -> per-notebook
+    # series + straggler detection, evaluated at every scrape (before the
+    # SLO engine, which burns against its verdict counters) and surfaced
+    # in /debug/fleet and the diagnose bundle
+    from .core.telemetry import WorkerTelemetryAggregator
+    from .kube import EventRecorder
+
+    aggregator = WorkerTelemetryAggregator(
+        api, metrics.registry, mgr.clock, cache=mgr.cache,
+        recorder=EventRecorder(api, "dataplane-telemetry"),
+        straggler_ratio=core_cfg.dataplane_straggler_ratio,
+        min_workers=core_cfg.dataplane_straggler_min_workers,
+        mfu_target=core_cfg.dataplane_mfu_target)
+    metrics.attach_dataplane(aggregator)
+    mgr.telemetry_aggregator = aggregator
     if core_cfg.enable_continuous_profiler:
         # always-on (controller, phase) CPU attribution; self-overhead is
         # exported so "can it stay on" is a gauge (/debug/profile)
@@ -535,6 +550,16 @@ def main(argv: Optional[list[str]] = None) -> int:
                 break
             wall.sleep(0.05)
         live = api.get("Notebook", "default", "demo")
+        # play the workers' training loops: publish one telemetry summary
+        # per demo worker (real TelemetryAgent -> pod annotation), so the
+        # /debug/fleet data-plane rollup and the diagnose bundle carry a
+        # live slice in the CI smokes
+        from .models.configs import LLAMA2_350M
+
+        cluster.stamp_worker_telemetry(
+            "default", "demo", step_time_s=0.5, config=LLAMA2_350M,
+            batch=8, seq_len=2048, num_chips=shape.chips // shape.num_hosts,
+            accelerator=args.demo_accelerator, now=wall.now())
         print(json.dumps(live.body.get("status", {}), indent=2))
 
     exit_code = 0

@@ -1,12 +1,41 @@
 """Model zoo for the BASELINE workload matrix: MNIST MLP, ViT, and the
-Llama/Gemma decoder family with sharded training (models.train)."""
+Llama/Gemma decoder family with sharded training (models.train).
 
-from .configs import GEMMA_7B, LLAMA2_7B, LLAMA2_350M, PRESETS, TINY, TransformerConfig
-from .mlp import MLP
-from .transformer import Transformer
-from .vit import VIT_B16, VIT_TINY, ViT, ViTConfig
+Exports are lazy (PEP 562, same pattern as ops/__init__): configs.py is
+pure dataclasses, and the control plane (telemetry stamping, roofline
+math, the --demo manager) imports `models.configs` without dragging
+jax/flax in; `from kubeflow_tpu.models import Transformer` still
+resolves exactly as before."""
+
+import importlib
+
+_LAZY = {
+    "GEMMA_7B": ".configs",
+    "LLAMA2_7B": ".configs",
+    "LLAMA2_350M": ".configs",
+    "PRESETS": ".configs",
+    "TINY": ".configs",
+    "TransformerConfig": ".configs",
+    "MLP": ".mlp",
+    "Transformer": ".transformer",
+    "VIT_B16": ".vit",
+    "VIT_TINY": ".vit",
+    "ViT": ".vit",
+    "ViTConfig": ".vit",
+}
 
 __all__ = [
     "GEMMA_7B", "LLAMA2_7B", "LLAMA2_350M", "MLP", "PRESETS", "TINY",
-    "Transformer", "TransformerConfig", "VIT_B16", "VIT_TINY", "ViT", "ViTConfig",
+    "Transformer", "TransformerConfig", "VIT_B16", "VIT_TINY", "ViT",
+    "ViTConfig",
 ]
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    mod = importlib.import_module(target, __name__)
+    value = getattr(mod, name)
+    globals()[name] = value  # cache: resolve each export once
+    return value

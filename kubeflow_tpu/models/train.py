@@ -20,7 +20,6 @@ from flax.training import train_state
 from jax.sharding import Mesh
 
 from ..parallel.sharding import DEFAULT_RULES, logical_sharding
-from ..tpu.topology import ACCELERATORS
 from .configs import TransformerConfig
 from .transformer import Transformer
 
@@ -392,9 +391,13 @@ def mfu(
     num_chips: int,
     accelerator: str = "v5e",
 ) -> float:
-    """Achieved fraction of the slice's bf16 peak."""
-    peak = ACCELERATORS[accelerator].bf16_peak_tflops * 1e12 * num_chips
-    return tokens_per_second * config.flops_per_token(seq_len) / peak
+    """Achieved fraction of the slice's bf16 peak — ONE definition,
+    shared with the worker-side TelemetryAgent and bench.py through
+    runtime.roofline so the headline number cannot fork."""
+    from ..runtime.roofline import mfu as roofline_mfu
+
+    return roofline_mfu(tokens_per_second, config, seq_len, num_chips,
+                        accelerator)
 
 
 def timed_steps(

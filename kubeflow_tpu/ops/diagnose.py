@@ -48,6 +48,7 @@ CONFIG_PREFIXES = (
     "INVARIANTS_", "K8S_", "IDLENESS_", "CLUSTER_DOMAIN", "USE_ISTIO",
     "ISTIO_", "ADD_FSGROUP", "DEV", "SET_PIPELINE_", "GATEWAY_",
     "NOTEBOOK_GATEWAY_", "MLFLOW_", "INJECT_", "TPU_", "KUBE_",
+    "DATAPLANE_", "TELEMETRY_",
 )
 _SECRET_RE = re.compile(r"TOKEN|SECRET|PASSWORD|PASSWD|CREDENTIAL|APIKEY"
                         r"|API_KEY|PRIVATE|CERT", re.IGNORECASE)
@@ -86,6 +87,7 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
     for the exposition + fleet rollup when given)."""
     engine = getattr(manager, "slo_engine", None)
     profiler = getattr(manager, "profiler", None)
+    aggregator = getattr(manager, "telemetry_aggregator", None)
     reconciles = manager.flight_recorder.snapshot()
     traces = {}
     for tid in _trace_ids(reconciles):
@@ -107,6 +109,8 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
         "workqueue": manager.workqueue_debug(),
         "profile": (profiler.snapshot() if profiler is not None
                     else {"enabled": False}),
+        "telemetry": (aggregator.snapshot() if aggregator is not None
+                      else None),
         "config": redacted_config(env),
     }
 
@@ -135,6 +139,7 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
     code, metrics_text = _get(base, "/metrics", timeout)
     if code != 200:
         metrics_text = f"# GET /metrics -> {code}"
+    fleet = get_json("/debug/fleet")
     reconciles = get_json("/debug/reconciles")
     traces = {}
     for tid in _trace_ids(reconciles):
@@ -147,7 +152,7 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
         "captured_at": Clock().now(),
         "source": base,
         "metrics": metrics_text,
-        "fleet": get_json("/debug/fleet"),
+        "fleet": fleet,
         "alerts": alerts,
         "slo_verdicts": None,  # verdicts need an engine; alerts carry
         # the per-objective stats over HTTP
@@ -155,6 +160,11 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
         "traces": traces,
         "workqueue": get_json("/debug/workqueue"),
         "profile": get_json("/debug/profile"),
+        # the fleet rollup's data-plane section, lifted to the same
+        # top-level key collect_local uses so offline consumers need one
+        # lookup path for worker telemetry
+        "telemetry": (fleet.get("dataplane")
+                      if isinstance(fleet, dict) else None),
         "config": redacted_config(),
     }
 

@@ -1,19 +1,46 @@
 """In-notebook runtime: distributed bootstrap, checkpoint/cull hooks,
-performance metrics.  Ships inside the TPU workbench image; everything the
-controller plane arranges (env injection, headless DNS, cull signals) is
-consumed here."""
+performance metrics, and the data-plane telemetry agent.  Ships inside
+the TPU workbench image; everything the controller plane arranges (env
+injection, headless DNS, cull signals) is consumed here.
 
-from .checkpoint import CheckpointManager, CullSignalWatcher, checkpoint_on_cull
-from .init import WorkerIdentity, parse_worker_env, tpu_init
-from .metrics import StepTimer, hbm_usage_bytes
+Exports are lazy (PEP 562, same pattern as ops/__init__): the control
+plane and the fast test lane import `runtime.telemetry` /
+`runtime.roofline` / `runtime.metrics` / `runtime.checkpoint` without
+executing the sibling imports, and `from kubeflow_tpu.runtime import
+StepTimer` resolves exactly as before."""
+
+import importlib
+
+_LAZY = {
+    "CheckpointManager": ".checkpoint",
+    "CullSignalWatcher": ".checkpoint",
+    "checkpoint_on_cull": ".checkpoint",
+    "WorkerIdentity": ".init",
+    "parse_worker_env": ".init",
+    "tpu_init": ".init",
+    "StepTimer": ".metrics",
+    "hbm_usage_bytes": ".metrics",
+    "TelemetryAgent": ".telemetry",
+}
 
 __all__ = [
     "CheckpointManager",
     "CullSignalWatcher",
     "StepTimer",
+    "TelemetryAgent",
     "WorkerIdentity",
     "checkpoint_on_cull",
     "hbm_usage_bytes",
     "parse_worker_env",
     "tpu_init",
 ]
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    mod = importlib.import_module(target, __name__)
+    value = getattr(mod, name)
+    globals()[name] = value  # cache: resolve each export once
+    return value

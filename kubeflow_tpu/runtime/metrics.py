@@ -6,22 +6,31 @@ exported through the same `utils.metrics.Registry` so both planes share one
 exposition format, HELP/TYPE metadata, the ci/lint.py naming rule, and the
 ci/metrics_drift_check.sh family inventory).
 
+The StepTimer is now a SHIM over `runtime.telemetry.TelemetryAgent` (the
+deprecated direct path — new code should construct an agent): `observe()`
+forwards to the agent's step boundary and every derived stat reads the
+agent's rolling window, so `notebook_training_step_duration_seconds` and
+the agent's samples are one stream by construction and can never
+disagree.  MFU comes from `runtime.roofline` — the same single definition
+bench.py reports.
+
 `jax` is imported lazily (hbm_usage_bytes) so the family inventory and the
-StepTimer's timing logic are usable from control-plane tooling — the drift
-check registers the families without touching an accelerator, and tests
-drive the timer off an injected monotonic clock instead of
-time.perf_counter.
+timing logic are usable from control-plane tooling — the drift check
+registers the families without touching an accelerator, and tests drive
+the timer off an injected monotonic clock instead of time.perf_counter.
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable, Optional
 
-from ..utils.metrics import Histogram, Registry
+from ..utils.metrics import Registry
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .telemetry import TelemetryAgent
+
     from ..models.configs import TransformerConfig
 
 
@@ -68,12 +77,13 @@ def register_step_metrics(registry: Registry) -> dict:
 class StepTimer:
     """Rolling train-step telemetry; call `observe()` once per synced step.
 
-    Timing reads `time_fn` — a monotonic-seconds callable, perf_counter by
-    default — so tests inject a fake (FakeClock.now works) and assert exact
-    step times and histogram buckets.  Every family lives in `registry`
-    (own one by default; pass a shared Registry to co-expose with other
-    metrics): step time is a real Histogram, and the derived gauges
-    (throughput, MFU, HBM) recompute lazily at scrape time."""
+    DEPRECATED SHIM: everything routes through a TelemetryAgent
+    (`runtime.telemetry`) — the agent observes the step histogram,
+    computes MFU through `runtime.roofline`, and keeps the rolling
+    window this class's properties read, so the two paths cannot drift.
+    Kept for the workbench-image API (`report()`/`prometheus_text()`);
+    new loops should construct the agent directly for phase scopes,
+    the sample ring, and annotation publishing."""
 
     config: "TransformerConfig"
     batch: int
@@ -83,58 +93,50 @@ class StepTimer:
     window: int = 20
     registry: Optional[Registry] = None
     time_fn: Callable[[], float] = time.perf_counter
-    _times: list[float] = field(default_factory=list)
-    _last: Optional[float] = None
 
     def __post_init__(self) -> None:
+        from .telemetry import TelemetryAgent
+
         if self.registry is None:
             self.registry = Registry()
-        m = register_step_metrics(self.registry)
-        self._step_hist: Histogram = m["step_duration"]
-        # derived values recompute at collect()/render() time, so a scrape
-        # is always current without observe() having to push gauges
-        m["tokens_per_second"].set_function(lambda: self.tokens_per_s)
-        m["mfu_ratio"].set_function(lambda: self.mfu)
-        m["hbm_bytes_in_use"].set_function(
-            lambda: float(sum(hbm_usage_bytes().values())))
+        self.agent: "TelemetryAgent" = TelemetryAgent(
+            config=self.config, batch=self.batch, seq_len=self.seq_len,
+            num_chips=self.num_chips, accelerator=self.accelerator,
+            window=self.window, registry=self.registry,
+            time_fn=self.time_fn)
 
     def observe(self) -> None:
-        now = self.time_fn()
-        if self._last is not None:
-            dt = now - self._last
-            self._times.append(dt)
-            if len(self._times) > self.window:
-                self._times.pop(0)
-            self._step_hist.observe(dt)
-        self._last = now
+        self.agent.step_boundary()
+
+    # the rolling window lives in the agent; tests historically poked
+    # `_times` directly, so the shim aliases it read/write
+    @property
+    def _times(self) -> list[float]:
+        return list(self.agent._durations)
+
+    @_times.setter
+    def _times(self, values: list[float]) -> None:
+        self.agent._durations.clear()
+        self.agent._durations.extend(values)
 
     @property
     def step_time_s(self) -> float:
-        return sum(self._times) / len(self._times) if self._times else 0.0
+        return self.agent.step_time_s
 
     @property
     def tokens_per_s(self) -> float:
-        st = self.step_time_s
-        return self.batch * self.seq_len / st if st else 0.0
+        return self.agent.tokens_per_s
 
     @property
     def mfu(self) -> float:
-        from ..models.train import mfu as mfu_fn
-
-        return mfu_fn(
-            self.tokens_per_s,
-            self.config,
-            self.seq_len,
-            self.num_chips,
-            self.accelerator,
-        )
+        return self.agent.mfu
 
     def report(self) -> dict:
         return {
             "step_time_s": self.step_time_s,
             "tokens_per_s": self.tokens_per_s,
             "mfu": self.mfu,
-            "hbm_bytes_in_use": sum(hbm_usage_bytes().values()),
+            "hbm_bytes_in_use": self.agent.hbm_bytes_in_use(),
         }
 
     def prometheus_text(self) -> str:

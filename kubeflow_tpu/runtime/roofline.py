@@ -1,0 +1,245 @@
+"""Analytic roofline model: step-time floors, MFU, and bound attribution.
+
+The repo measured MFU in two places with two code paths (bench.py through
+models/train.mfu, the StepTimer through the same function but its own
+call graph) and attributed nothing: a 0.39-MFU run never said whether the
+chip was compute-starved or bandwidth-starved.  This module is the ONE
+definition both planes share:
+
+  - **FLOPs per step** come from the model config's own accounting
+    (`TransformerConfig.flops_per_token`: 6x activated matmul params +
+    causal attention; MoE counts top-k experts only), so the MFU
+    numerator here is byte-identical to what bench.py always reported.
+  - **HBM bytes per step** are a first-order traffic model (weights
+    streamed fwd+bwd, fp32 master + Adam moments read/written, remat
+    layer-boundary activations stashed+read for training; matmul weights
+    streamed once + the full static-shape staged-KV cache read once for
+    decode — the same formula bench.py --decode derived empirically in
+    round 4).  These are *floors, not simulations*: real steps add
+    attention traffic and collective overhead on top.
+  - the chip table is `tpu.topology.ACCELERATORS` (per-chip bf16 peak
+    TFLOPs and HBM GB/s for v4/v5e/v5p/v6e) — no second spec table.
+
+A `RooflineEstimate` answers the questions telemetry needs: the
+compute-bound and memory-bound step-time floors, which one *binds*
+(`bound`: compute | memory), achieved MFU at a measured step time, and
+the roofline fraction (floor / measured — 1.0 means running at the
+analytic limit).  Pure stdlib math, importable jax-free from the
+control plane, the workbench image, and CI alike.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..tpu.topology import ACCELERATORS
+
+# bytes per element by dtype name (TransformerConfig dtype fields);
+# int4 is nibble-packed (models.quant)
+DTYPE_BYTES = {
+    "float32": 4.0,
+    "float16": 2.0,
+    "bfloat16": 2.0,
+    "int8": 1.0,
+    "int4": 0.5,
+}
+
+# Adam keeps two fp32 moments per parameter; each is read and written
+# once per step (mu_dtype="bfloat16" shaves the first moment — ignored
+# here, the floor stays a floor)
+_ADAM_MOMENT_BYTES = 2 * 2 * 4.0
+
+
+def dtype_bytes(name: str, default: float = 2.0) -> float:
+    return DTYPE_BYTES.get(name, default)
+
+
+def matmul_params(config) -> float:
+    """Parameters that participate in matmuls — the weights decode must
+    stream.  The untied embedding table is a per-token row lookup and
+    never streams; tied, it doubles as the LM-head weight and does
+    (the same convention as `flops_per_token`)."""
+    p = float(config.num_params)
+    if not config.tie_embeddings:
+        p -= config.vocab_size * config.embed_dim
+    return p
+
+
+# -- per-step work ------------------------------------------------------------
+
+
+def train_step_flops(config, batch: int, seq_len: int) -> float:
+    """Fwd+bwd matmul FLOPs per training step — the MFU numerator, one
+    definition with `TransformerConfig.flops_per_token`."""
+    return config.flops_per_token(seq_len) * batch * seq_len
+
+
+def train_step_hbm_bytes(config, batch: int, seq_len: int) -> float:
+    """First-order HBM traffic per training step:
+
+      - every parameter's compute copy read by fwd AND bwd (2x act
+        bytes), the fp32 master read + written by the optimizer (2x
+        param bytes), and both Adam moments read + written;
+      - the remat activation stash: one [B, S, D] residual per layer
+        boundary written by fwd and read back by bwd.
+
+    Attention score traffic and collectives ride on top of this floor.
+    """
+    ab = dtype_bytes(config.dtype)
+    pb = dtype_bytes(config.param_dtype, 4.0)
+    weights = config.num_params * (2 * ab + 2 * pb + _ADAM_MOMENT_BYTES)
+    stash = 2.0 * batch * seq_len * config.embed_dim * config.num_layers * ab
+    return weights + stash
+
+
+def decode_weight_stream_bytes(config) -> float:
+    """Bytes of weights one decode step streams: every matmul weight
+    once, in the decode streaming dtype (bf16 unless `weight_dtype`
+    says the kernels are int8/int4-quantized)."""
+    wb = dtype_bytes(config.weight_dtype or "bfloat16")
+    return matmul_params(config) * wb
+
+
+def decode_kv_bytes(config, batch: int) -> float:
+    """The full static-shape KV cache read once per decode step: K and V,
+    [B, max_seq, kv_heads, head_dim] bf16 per layer.  The cache is
+    allocated (and with staged-KV, flushed in aligned 8-row tiles) to
+    max_seq_len, so it reads to max_seq_len regardless of fill — the
+    round-4 empirical finding bench.py --decode codified."""
+    return (2.0 * batch * config.max_seq_len * config.num_kv_heads
+            * config.head_dim * 2.0 * config.num_layers)
+
+
+def decode_step_flops(config, batch: int) -> float:
+    """Matmul FLOPs per single-token decode step: 2 FLOPs per streamed
+    weight per token, plus the QK^T/AV attention reads over the cache."""
+    attn = (4.0 * config.num_layers * config.num_heads * config.head_dim
+            * config.max_seq_len)
+    return (2.0 * matmul_params(config) + attn) * batch
+
+
+# -- MFU (the one definition) -------------------------------------------------
+
+
+def mfu_from_flops(tokens_per_second: float, flops_per_token: float,
+                   num_chips: int, accelerator: str = "v5e") -> float:
+    """Achieved fraction of the slice's bf16 peak.  EVERY MFU the repo
+    reports funnels through here: bench.py and models/train.mfu via
+    `mfu()`, the TelemetryAgent/StepTimer via the same — so the headline
+    number has exactly one definition."""
+    peak = ACCELERATORS[accelerator].bf16_peak_tflops * 1e12 * num_chips
+    return tokens_per_second * flops_per_token / peak
+
+
+def mfu(tokens_per_second: float, config, seq_len: int, num_chips: int,
+        accelerator: str = "v5e") -> float:
+    return mfu_from_flops(tokens_per_second, config.flops_per_token(seq_len),
+                          num_chips, accelerator)
+
+
+# -- the estimate -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RooflineEstimate:
+    """Analytic floors for one (config, batch, seq) workload on a slice."""
+
+    mode: str                 # train | decode
+    accelerator: str
+    num_chips: int
+    flops: float              # matmul FLOPs per step
+    hbm_bytes: float          # HBM bytes per step (first-order floor)
+    tokens: int               # tokens produced/consumed per step
+
+    @property
+    def peak_flops_per_s(self) -> float:
+        return (ACCELERATORS[self.accelerator].bf16_peak_tflops * 1e12
+                * self.num_chips)
+
+    @property
+    def peak_hbm_bytes_per_s(self) -> float:
+        return (ACCELERATORS[self.accelerator].hbm_gbps * 1e9
+                * self.num_chips)
+
+    @property
+    def compute_floor_s(self) -> float:
+        return self.flops / self.peak_flops_per_s
+
+    @property
+    def memory_floor_s(self) -> float:
+        return self.hbm_bytes / self.peak_hbm_bytes_per_s
+
+    @property
+    def step_floor_s(self) -> float:
+        return max(self.compute_floor_s, self.memory_floor_s)
+
+    @property
+    def bound(self) -> str:
+        """Which resource the analytic floor says binds this workload."""
+        return ("compute" if self.compute_floor_s >= self.memory_floor_s
+                else "memory")
+
+    @property
+    def tokens_per_s_ceiling(self) -> float:
+        return self.tokens / self.step_floor_s if self.step_floor_s else 0.0
+
+    def mfu_at(self, step_time_s: float) -> float:
+        """MFU at a measured step time — identical to
+        `mfu_from_flops(tokens/step_time, flops/tokens, ...)`."""
+        if step_time_s <= 0:
+            return 0.0
+        return self.flops / step_time_s / self.peak_flops_per_s
+
+    def roofline_fraction(self, step_time_s: float) -> float:
+        """Fraction of the analytic limit achieved: floor / measured.
+        1.0 = running at the floor; >1.0 means the first-order model
+        under-counts this workload (worth knowing, not clamped)."""
+        if step_time_s <= 0:
+            return 0.0
+        return self.step_floor_s / step_time_s
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode,
+            "accelerator": self.accelerator,
+            "num_chips": self.num_chips,
+            "flops_per_step": self.flops,
+            "hbm_bytes_per_step": self.hbm_bytes,
+            "tokens_per_step": self.tokens,
+            "compute_floor_s": self.compute_floor_s,
+            "memory_floor_s": self.memory_floor_s,
+            "step_floor_s": self.step_floor_s,
+            "bound": self.bound,
+        }
+
+
+def train_estimate(config, batch: int, seq_len: int, num_chips: int = 1,
+                   accelerator: str = "v5e") -> RooflineEstimate:
+    return RooflineEstimate(
+        mode="train", accelerator=accelerator, num_chips=num_chips,
+        flops=train_step_flops(config, batch, seq_len),
+        hbm_bytes=train_step_hbm_bytes(config, batch, seq_len),
+        tokens=batch * seq_len)
+
+
+def decode_estimate(config, batch: int, num_chips: int = 1,
+                    accelerator: str = "v5e",
+                    param_bytes: float = 0.0) -> RooflineEstimate:
+    """Single-token decode step.  `param_bytes` overrides the analytic
+    weight-stream bytes with measured ones (bench.py --decode passes
+    `quantized_bytes(params, ...)` off the real tree, which knows the
+    exact quantization group scales)."""
+    stream = param_bytes or decode_weight_stream_bytes(config)
+    return RooflineEstimate(
+        mode="decode", accelerator=accelerator, num_chips=num_chips,
+        flops=decode_step_flops(config, batch),
+        hbm_bytes=stream + decode_kv_bytes(config, batch),
+        tokens=batch)
+
+
+__all__ = [
+    "DTYPE_BYTES", "RooflineEstimate", "decode_estimate", "decode_kv_bytes",
+    "decode_step_flops", "decode_weight_stream_bytes", "dtype_bytes",
+    "matmul_params", "mfu", "mfu_from_flops", "train_estimate",
+    "train_step_flops", "train_step_hbm_bytes",
+]

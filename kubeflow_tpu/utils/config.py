@@ -165,6 +165,23 @@ class CoreConfig:
     # notebook_profiler_overhead_ratio when on.
     enable_continuous_profiler: bool = False    # ENABLE_CONTINUOUS_PROFILER
     profiler_interval_ms: float = 10.0          # PROFILER_INTERVAL_MS
+    # data-plane telemetry (runtime/telemetry.py TelemetryAgent publishes
+    # rolling summaries into pod annotations; core/telemetry.py
+    # WorkerTelemetryAggregator rolls them up at every scrape).  A worker
+    # whose rolling step time exceeds dataplane_straggler_ratio x the
+    # slice median (with at least dataplane_straggler_min_workers
+    # reporting) fires the straggler gauge + Warning event —
+    # observability only, never a healing action.  dataplane_mfu_target
+    # feeds the (knob-disabled) fleet-MFU SLO objective's low/ok verdict
+    # counter; slo_fleet_mfu / slo_straggler_rate <= 0 keep those
+    # objectives off.
+    dataplane_straggler_ratio: float = 1.5      # DATAPLANE_STRAGGLER_RATIO
+    dataplane_straggler_min_workers: int = 2    # DATAPLANE_STRAGGLER_MIN_WORKERS
+    dataplane_mfu_target: float = 0.0           # DATAPLANE_MFU_TARGET
+    telemetry_ring_size: int = 512              # TELEMETRY_RING_SIZE
+    telemetry_publish_interval_s: float = 30.0  # TELEMETRY_PUBLISH_INTERVAL_S
+    slo_fleet_mfu: float = 0.0                  # SLO_FLEET_MFU
+    slo_straggler_rate: float = 0.0             # SLO_STRAGGLER_RATE
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -229,6 +246,17 @@ class CoreConfig:
             enable_continuous_profiler=_bool(
                 env, "ENABLE_CONTINUOUS_PROFILER", False),
             profiler_interval_ms=_float(env, "PROFILER_INTERVAL_MS", 10.0),
+            dataplane_straggler_ratio=_float(
+                env, "DATAPLANE_STRAGGLER_RATIO", 1.5),
+            dataplane_straggler_min_workers=max(2, _int(
+                env, "DATAPLANE_STRAGGLER_MIN_WORKERS", 2)),
+            dataplane_mfu_target=_float(env, "DATAPLANE_MFU_TARGET", 0.0),
+            telemetry_ring_size=max(1, _int(
+                env, "TELEMETRY_RING_SIZE", 512)),
+            telemetry_publish_interval_s=_float(
+                env, "TELEMETRY_PUBLISH_INTERVAL_S", 30.0),
+            slo_fleet_mfu=_float(env, "SLO_FLEET_MFU", 0.0),
+            slo_straggler_rate=_float(env, "SLO_STRAGGLER_RATE", 0.0),
         )
 
 

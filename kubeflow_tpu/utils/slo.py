@@ -179,6 +179,25 @@ def default_objectives(cfg) -> tuple[Objective, ...]:
             total_values=("hit", "miss"),
             description="warm-pool claims served from a pre-provisioned "
                         "slice"))
+    # data-plane objectives (core/telemetry.py verdict counters): both
+    # knob-disabled by default — they only mean something on fleets whose
+    # workers actually publish telemetry annotations
+    if cfg.slo_fleet_mfu > 0:
+        out.append(Objective(
+            name="fleet_mfu", kind=KIND_RATIO,
+            metric="notebook_dataplane_mfu_checks_total",
+            target_ratio=cfg.slo_fleet_mfu,
+            label="result", bad_values=("low",),
+            description="per-notebook MFU evaluations at or above "
+                        "DATAPLANE_MFU_TARGET"))
+    if cfg.slo_straggler_rate > 0:
+        out.append(Objective(
+            name="straggler_rate", kind=KIND_RATIO,
+            metric="notebook_dataplane_straggler_checks_total",
+            target_ratio=1.0 - cfg.slo_straggler_rate,
+            label="result", bad_values=("straggler",),
+            description="per-notebook straggler evaluations finding the "
+                        "slice stepping together"))
     return tuple(out)
 
 

@@ -51,14 +51,16 @@ def pytest_collection_modifyitems(config, items):
     # fail-open guard: a module is XLA-heavy iff it imports the compute
     # plane — a new model-test module missing from _COMPUTE_MODULES must
     # fail collection loudly, not silently join the fast lane
-    # runtime.checkpoint and ops.diagnose are exempt: their jax imports
-    # are lazy/absent (cull-signal + session-store plumbing and the
-    # diagnostics bundle are pure stdlib; kubeflow_tpu/ops/__init__.py
-    # resolves its compute exports lazily), so importing them does not
-    # drag XLA into the fast lane
+    # runtime.{checkpoint,metrics,roofline,telemetry}, models.configs and
+    # ops.diagnose are exempt: their jax imports are lazy/absent
+    # (cull-signal + session-store plumbing, the roofline math and the
+    # telemetry agent are pure stdlib, configs.py is dataclasses only;
+    # the ops/models/runtime package __init__s resolve their compute
+    # exports lazily), so importing them does not drag XLA into the fast
+    # lane
     compute_import = re.compile(
-        r"kubeflow_tpu\.(models|ops(?!\.diagnose\b)|parallel"
-        r"|runtime(?!\.checkpoint\b))")
+        r"kubeflow_tpu\.(models(?!\.configs\b)|ops(?!\.diagnose\b)|parallel"
+        r"|runtime(?!\.(checkpoint|metrics|roofline|telemetry)\b))")
     jax_import = re.compile(r"^\s*(?:import|from)\s+jax\b", re.M)
     seen_modules = {}
     for item in items:

@@ -1121,3 +1121,155 @@ class TestFleetSLOSoak:
             profiler.stop()
             tracing.set_clock(None)
             mgr.stop()
+
+
+class TestStragglerSoak:
+    """ISSUE-11 acceptance: an injected slow worker must be attributed to
+    the right (notebook, worker) via the fleet rollup AND the diagnose
+    bundle — exactly one straggler gauge + Warning event — must clear
+    when healed, and the straggler SLO objective must never false-fire
+    on healthy slices."""
+
+    FLEET = 2
+    WORKERS = 4
+
+    def test_straggler_soak_attribution_and_clear(self):
+        import json
+
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.telemetry import (
+            EVENT_STRAGGLER,
+            EVENT_STRAGGLER_CLEARED,
+            WorkerTelemetryAggregator,
+        )
+        from kubeflow_tpu.kube import EventRecorder
+        from kubeflow_tpu.models.configs import LLAMA2_350M
+        from kubeflow_tpu.ops.diagnose import collect_local
+        from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+        from kubeflow_tpu.utils.slo import SLOEngine, default_objectives
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    self.WORKERS * self.FLEET, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock, flight_recorder=FlightRecorder())
+        metrics = NotebookMetrics(api, manager=mgr)
+        # straggler SLO objective armed (knob-disabled by default): the
+        # soak proves it stays silent on healthy slices
+        cfg = CoreConfig(slo_straggler_rate=0.30)
+        setup_core_controllers(mgr, cfg, metrics)
+        aggregator = WorkerTelemetryAggregator(
+            api, metrics.registry, clock, cache=mgr.cache,
+            recorder=EventRecorder(api, "dataplane-telemetry"),
+            straggler_ratio=cfg.dataplane_straggler_ratio,
+            min_workers=cfg.dataplane_straggler_min_workers)
+        metrics.attach_dataplane(aggregator)
+        mgr.telemetry_aggregator = aggregator
+        engine = SLOEngine(
+            default_objectives(cfg),
+            registries=[metrics.registry, mgr.metrics_registry],
+            clock=clock, recorder=mgr.flight_recorder)
+        metrics.attach_slo(engine)
+        mgr.slo_engine = engine
+        try:
+            for i in range(self.FLEET):
+                api.create(Notebook.new(f"tele-{i}", "user1",
+                                        tpu=TPUSpec("v5e", "4x4")).obj)
+            mgr.run_until_idle()
+            for i in range(self.FLEET):
+                assert api.get("Notebook", "user1", f"tele-{i}").body[
+                    "status"]["sliceHealth"] == "Healthy"
+
+            def stamp(slow=None):
+                for i in range(self.FLEET):
+                    cluster.stamp_worker_telemetry(
+                        "user1", f"tele-{i}", step_time_s=0.5,
+                        config=LLAMA2_350M, batch=8, seq_len=2048,
+                        num_chips=4, slow_worker=(
+                            slow if i == 0 else None),
+                        slow_factor=4.0, now=clock.now())
+
+            def straggler_events(nb, reason=EVENT_STRAGGLER):
+                return [e for e in api.list("Event", namespace="user1")
+                        if e.body.get("reason") == reason
+                        and e.body["involvedObject"]["name"] == nb]
+
+            gauge = metrics.registry.get("notebook_dataplane_straggler")
+
+            # phase 1 — healthy fleet: scrapes see telemetry, zero
+            # straggler firings, SLO objective silent
+            stamp()
+            for _ in range(4):
+                clock.advance(60)
+                metrics.scrape()
+            snap = metrics.fleet_snapshot()["dataplane"]
+            assert snap["fleet"]["notebooks"] == self.FLEET
+            assert snap["stragglers"] == []
+            for i in range(self.FLEET):
+                assert gauge.collect()[("user1", f"tele-{i}")] == 0.0
+                assert straggler_events(f"tele-{i}") == []
+            assert not engine.firing()
+
+            # phase 2 — inject one deliberately slow worker on tele-0
+            stamp(slow=2)  # ordinal 2 -> pod tele-0-2
+            clock.advance(60)
+            metrics.scrape()
+            snap = metrics.fleet_snapshot()["dataplane"]
+            assert [(s["namespace"], s["name"], s["worker"])
+                    for s in snap["stragglers"]] == \
+                [("user1", "tele-0", "tele-0-2")]
+            assert snap["notebooks"]["user1/tele-0"]["straggler"] == \
+                "tele-0-2"
+            assert gauge.collect()[("user1", "tele-0")] == 1.0
+            assert gauge.collect()[("user1", "tele-1")] == 0.0
+            # exactly ONE Warning event, naming the worker, even across
+            # repeated scrapes while the breach persists
+            for _ in range(3):
+                clock.advance(60)
+                metrics.scrape()
+            events = straggler_events("tele-0")
+            assert len(events) == 1
+            assert "tele-0-2" in events[0].body["message"]
+            assert straggler_events("tele-1") == []
+
+            # the diagnose bundle attributes the straggler offline
+            bundle = json.loads(json.dumps(
+                collect_local(mgr, metrics), default=str))
+            assert [s["worker"] for s in
+                    bundle["telemetry"]["stragglers"]] == ["tele-0-2"]
+            assert bundle["fleet"]["dataplane"]["notebooks"][
+                "user1/tele-0"]["straggler"] == "tele-0-2"
+            assert 'notebook_dataplane_straggler{namespace="user1",' \
+                'name="tele-0"} 1' in bundle["metrics"]
+
+            # phase 3 — heal: the worker rejoins the pace
+            stamp()
+            clock.advance(60)
+            metrics.scrape()
+            snap = metrics.fleet_snapshot()["dataplane"]
+            assert snap["stragglers"] == []
+            assert gauge.collect()[("user1", "tele-0")] == 0.0
+            assert len(straggler_events("tele-0")) == 1  # no re-fire
+            assert len(straggler_events(
+                "tele-0", EVENT_STRAGGLER_CLEARED)) == 1
+
+            # phase 4 — healthy soak tail: the straggler SLO objective
+            # drains and must not be firing at soak end, and tele-1
+            # stayed clean the whole run
+            for _ in range(6):
+                clock.advance(120)
+                metrics.scrape()
+            assert not engine.firing()
+            assert straggler_events("tele-1") == []
+            assert straggler_events("tele-1", EVENT_STRAGGLER_CLEARED) \
+                == []
+            # verdict counters saw both phases: mostly-ok, some straggler
+            checks = metrics.registry.get(
+                "notebook_dataplane_straggler_checks_total").collect()
+            assert checks[("straggler",)] >= 1
+            assert checks[("ok",)] > checks[("straggler",)]
+        finally:
+            mgr.stop()

@@ -207,6 +207,16 @@ class TestTraining:
         # MFU: 1 token/s across 16 chips is tiny
         assert mfu(1.0, LLAMA2_7B, 4096, num_chips=16) < 1e-4
 
+    def test_train_mfu_is_the_roofline_definition(self):
+        # bench.py reports through models.train.mfu, the TelemetryAgent
+        # through runtime.roofline — both must be the SAME number for the
+        # same (config, tokens/s) or the headline forks
+        from kubeflow_tpu.runtime.roofline import mfu as roofline_mfu
+
+        for tok_s in (1.0, 2.8e4, 3.4e4):
+            assert mfu(tok_s, LLAMA2_7B, 4096, num_chips=16) == \
+                roofline_mfu(tok_s, LLAMA2_7B, 4096, 16)
+
 
 class TestModelZoo:
     def test_mnist_mlp_learns(self):

@@ -1,0 +1,607 @@
+"""Data-plane telemetry: roofline closed forms, the TelemetryAgent, the
+control-plane WorkerTelemetryAggregator, straggler detection, and the
+bench-trajectory CI gate.
+
+Everything here is jax-free (controlplane lane): runtime.roofline and
+runtime.telemetry are pure stdlib math, models.configs is dataclasses,
+and the aggregator runs against the in-memory apiserver + InformerCache.
+"""
+
+import json
+
+import pytest
+
+from ci.bench_trajectory_check import check as trajectory_check
+from ci.bench_trajectory_check import load_records
+from kubeflow_tpu.core.telemetry import (
+    EVENT_STRAGGLER,
+    EVENT_STRAGGLER_CLEARED,
+    WorkerTelemetryAggregator,
+    parse_pod_telemetry,
+)
+from kubeflow_tpu.core import telemetry as core_telemetry
+from kubeflow_tpu.kube import ApiServer, EventRecorder, FakeCluster, InformerCache
+from kubeflow_tpu.kube.meta import KubeObject, ObjectMeta
+from kubeflow_tpu.models.configs import BENCH_CHIP, BENCH_MOE, TINY
+import kubeflow_tpu.runtime.roofline as roofline
+import kubeflow_tpu.runtime.telemetry as telemetry
+from kubeflow_tpu.runtime.metrics import StepTimer
+from kubeflow_tpu.runtime.telemetry import (
+    JsonlRing,
+    TelemetryAgent,
+    annotation_payload,
+    parse_annotation,
+)
+from kubeflow_tpu.tpu.topology import ACCELERATORS
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+from kubeflow_tpu.utils.metrics import Registry
+from kubeflow_tpu.utils.slo import default_objectives
+
+
+# -- roofline closed forms ----------------------------------------------------
+
+
+class TestRooflineClosedForm:
+    def test_dense_train_flops_match_hand_formula(self):
+        cfg, batch, seq = TINY, 4, 128
+        # PaLM appendix-B accounting: 6x matmul params + causal attention
+        matmul = cfg.num_params - cfg.vocab_size * cfg.embed_dim
+        attn = 12 * cfg.num_layers * seq * cfg.num_heads * cfg.head_dim / 2
+        expected = (6.0 * matmul + attn) * batch * seq
+        assert roofline.train_step_flops(cfg, batch, seq) == \
+            pytest.approx(expected)
+
+    def test_moe_counts_activated_experts_only(self):
+        cfg = TINY.with_(moe_experts=4, moe_top_k=2, moe_mlp_dim=64)
+        dense_twin = TINY.with_(moe_experts=0)
+        expert_mlp = 3 * cfg.embed_dim * 64
+        inactive = (4 - 2) * expert_mlp * cfg.num_layers
+        # activated-FLOPs convention: the 2 inactive experts' matmul
+        # params are excluded from the numerator
+        full = roofline.train_step_flops(
+            cfg.with_(moe_top_k=4), 2, 64)
+        active = roofline.train_step_flops(cfg, 2, 64)
+        assert full - active == pytest.approx(6.0 * inactive * 2 * 64)
+        del dense_twin
+
+    def test_train_hbm_bytes_closed_form(self):
+        cfg, batch, seq = TINY, 2, 64
+        ab = 4.0  # TINY runs fp32 activations
+        pb = 4.0
+        weights = cfg.num_params * (2 * ab + 2 * pb + 16.0)
+        stash = 2.0 * batch * seq * cfg.embed_dim * cfg.num_layers * ab
+        assert roofline.train_step_hbm_bytes(cfg, batch, seq) == \
+            pytest.approx(weights + stash)
+
+    def test_compute_vs_memory_crossover(self):
+        # tiny batches cannot amortize the weight traffic: memory-bound;
+        # the bench batch keeps the MXU fed: compute-bound
+        small = roofline.train_estimate(BENCH_CHIP, 1, 128)
+        big = roofline.train_estimate(BENCH_CHIP, 40, 2048)
+        assert small.bound == "memory"
+        assert big.bound == "compute"
+        # floors are exactly the work / peak ratios of the chip table
+        spec = ACCELERATORS["v5e"]
+        assert big.compute_floor_s == pytest.approx(
+            big.flops / (spec.bf16_peak_tflops * 1e12))
+        assert big.memory_floor_s == pytest.approx(
+            big.hbm_bytes / (spec.hbm_gbps * 1e9))
+        assert big.step_floor_s == max(big.compute_floor_s,
+                                       big.memory_floor_s)
+
+    def test_decode_estimate_matches_bench_formula(self):
+        cfg = BENCH_CHIP.with_(max_seq_len=384, decode=True)
+        batch = 16
+        est = roofline.decode_estimate(cfg, batch)
+        kv = (2 * batch * 384 * cfg.num_kv_heads * cfg.head_dim
+              * 2 * cfg.num_layers)
+        stream = roofline.matmul_params(cfg) * 2.0  # bf16
+        assert est.hbm_bytes == pytest.approx(stream + kv)
+        assert est.bound == "memory"
+        # int8 weight streaming halves the stream share, exactly
+        est8 = roofline.decode_estimate(cfg.with_(weight_dtype="int8"),
+                                        batch)
+        assert est.hbm_bytes - est8.hbm_bytes == pytest.approx(stream / 2)
+        # a measured byte count (bench passes quantized_bytes) overrides
+        est_m = roofline.decode_estimate(cfg, batch, param_bytes=1e9)
+        assert est_m.hbm_bytes == pytest.approx(1e9 + kv)
+
+    def test_tied_embeddings_stream_and_count(self):
+        tied = TINY.with_(tie_embeddings=True)
+        assert roofline.matmul_params(tied) == tied.num_params
+        assert roofline.matmul_params(TINY) == \
+            TINY.num_params - TINY.vocab_size * TINY.embed_dim
+
+    def test_mfu_single_definition(self):
+        # the acceptance identity: bench.py (models.train.mfu ->
+        # roofline.mfu) and the TelemetryAgent report the same MFU for
+        # the same (config, step time)
+        step_time = 3.5071
+        tokens = 40 * 2048 / step_time
+        by_fn = roofline.mfu(tokens, BENCH_CHIP, 2048, 1, "v5e")
+        spec = ACCELERATORS["v5e"]
+        assert by_fn == pytest.approx(
+            tokens * BENCH_CHIP.flops_per_token(2048)
+            / (spec.bf16_peak_tflops * 1e12))
+        est = roofline.train_estimate(BENCH_CHIP, 40, 2048)
+        assert est.mfu_at(step_time) == pytest.approx(by_fn)
+        agent = TelemetryAgent(config=BENCH_CHIP, batch=40, seq_len=2048,
+                               time_fn=FakeClock(0.0).now, hbm_fn=dict)
+        agent.record_step(step_time)
+        assert agent.mfu == pytest.approx(by_fn)
+
+    def test_roofline_fraction_equals_mfu_when_compute_bound(self):
+        est = roofline.train_estimate(BENCH_CHIP, 40, 2048)
+        assert est.bound == "compute"
+        assert est.roofline_fraction(2.0) == pytest.approx(est.mfu_at(2.0))
+
+    def test_moe_train_estimate(self):
+        est = roofline.train_estimate(BENCH_MOE, 16, 2048)
+        assert est.flops == pytest.approx(
+            BENCH_MOE.flops_per_token(2048) * 16 * 2048)
+        assert est.bound in ("compute", "memory")
+
+    def test_zero_step_time_is_safe(self):
+        est = roofline.train_estimate(TINY, 1, 8)
+        assert est.mfu_at(0.0) == 0.0
+        assert est.roofline_fraction(0.0) == 0.0
+        assert roofline.mfu_from_flops(0.0, 1e9, 1) == 0.0
+
+
+# -- TelemetryAgent -----------------------------------------------------------
+
+
+class TestTelemetryAgent:
+    def make(self, clock, **kw):
+        kw.setdefault("config", TINY)
+        kw.setdefault("batch", 4)
+        kw.setdefault("seq_len", 128)
+        kw.setdefault("hbm_fn", lambda: {"d0": 123})
+        return TelemetryAgent(time_fn=clock.now, **kw)
+
+    def test_step_boundary_off_fake_clock(self):
+        clock = FakeClock(0.0)
+        agent = self.make(clock)
+        assert agent.step_boundary() is None  # arms only
+        clock.advance(0.1)
+        sample = agent.step_boundary()
+        assert sample["step_time_s"] == pytest.approx(0.1)
+        assert sample["tokens_per_s"] == pytest.approx(4 * 128 / 0.1)
+        assert sample["mfu"] == pytest.approx(
+            roofline.mfu(4 * 128 / 0.1, TINY, 128, 1, "v5e"))
+        est = roofline.train_estimate(TINY, 4, 128)
+        assert sample["roofline_fraction"] == \
+            pytest.approx(est.roofline_fraction(0.1))
+        assert sample["bound"] == est.bound
+        assert sample["hbm_bytes"] == 123
+
+    def test_phase_scopes_attach_to_next_sample(self):
+        clock = FakeClock(0.0)
+        agent = self.make(clock)
+        agent.step_boundary()
+        with agent.scope("fwd"):
+            clock.advance(0.06)
+        with agent.scope("bwd"):
+            clock.advance(0.03)
+        with agent.scope("opt"):
+            clock.advance(0.01)
+        sample = agent.step_boundary()
+        assert sample["step_time_s"] == pytest.approx(0.1)
+        assert sample["phases"] == pytest.approx(
+            {"fwd": 0.06, "bwd": 0.03, "opt": 0.01})
+        # consumed: the next sample carries no stale phases
+        clock.advance(0.1)
+        assert "phases" not in agent.step_boundary()
+
+    def test_ring_is_bounded(self):
+        clock = FakeClock(0.0)
+        agent = self.make(clock, ring_size=8)
+        for _ in range(20):
+            agent.record_step(0.05)
+        assert agent.steps_recorded == 20
+        samples = agent.samples()
+        assert len(samples) == 8
+        assert [s["step"] for s in samples] == list(range(13, 21))
+
+    def test_rolling_window_bounded(self):
+        clock = FakeClock(0.0)
+        agent = self.make(clock, window=3)
+        for dt in (1.0, 1.0, 0.2, 0.2, 0.2):
+            agent.record_step(dt)
+        assert agent.step_time_s == pytest.approx(0.2)
+
+    def test_jsonl_spool_bounded_and_parseable(self, tmp_path):
+        clock = FakeClock(0.0)
+        agent = self.make(clock, ring_size=8)
+        path = str(tmp_path / "telemetry.jsonl")
+        agent.spool_to(path)
+        for _ in range(20):
+            agent.record_step(0.05)
+        ring = JsonlRing(path, max_records=8)
+        records = ring.read()
+        assert [r["step"] for r in records] == list(range(13, 21))
+        # the on-disk file stays bounded (compaction), not append-forever
+        with open(path) as f:
+            assert len(f.readlines()) <= 16
+
+    def test_publish_rate_limited(self):
+        clock = FakeClock(0.0)
+        published = []
+        agent = self.make(clock, publish_fn=published.append,
+                          publish_interval_s=10.0)
+        agent.record_step(0.1)   # first step publishes immediately
+        assert len(published) == 1
+        clock.advance(3)
+        agent.record_step(0.1)
+        assert len(published) == 1  # inside the interval
+        clock.advance(10)
+        agent.record_step(0.1)
+        assert len(published) == 2
+        assert published[-1]["steps"] == 3
+        assert agent.publish_now()
+        assert len(published) == 3
+
+    def test_summary_annotation_round_trip(self):
+        clock = FakeClock(5.0)
+        agent = self.make(clock, worker="nb-0-0")
+        agent.record_step(0.25)
+        summary = agent.summary()
+        assert summary["worker"] == "nb-0-0"
+        assert summary["mfu"] == pytest.approx(agent.mfu)
+        assert summary["bound"] in ("compute", "memory")
+        assert parse_annotation(annotation_payload(summary)) == \
+            pytest.approx(summary)
+        assert parse_annotation("not json") is None
+        assert parse_annotation(json.dumps({"v": 999})) is None
+        assert parse_annotation(json.dumps(["list"])) is None
+
+    def test_flops_override_skips_config(self):
+        clock = FakeClock(0.0)
+        agent = TelemetryAgent(flops_per_token=1e9, batch=8, seq_len=16,
+                               time_fn=clock.now, hbm_fn=dict)
+        agent.record_step(0.5)
+        assert agent.mfu == pytest.approx(
+            roofline.mfu_from_flops(8 * 16 / 0.5, 1e9, 1, "v5e"))
+        # no config = no traffic model = no roofline attribution
+        assert agent.estimate() is None
+        assert "roofline_fraction" not in agent.samples()[-1]
+
+
+class TestStepTimerShim:
+    """The deprecated direct path routes through the agent — the
+    histogram and the agent's samples cannot disagree."""
+
+    def test_observe_feeds_agent_and_histogram_once(self):
+        clock = FakeClock(0.0)
+        timer = StepTimer(TINY, batch=4, seq_len=128, num_chips=1,
+                          time_fn=clock.now)
+        timer.observe()
+        clock.advance(0.1)
+        timer.observe()
+        clock.advance(0.3)
+        timer.observe()
+        hist = timer.registry.get("notebook_training_step_duration_seconds")
+        assert hist.count_value() == 2
+        assert timer.agent.steps_recorded == 2
+        assert [s["step_time_s"] for s in timer.agent.samples()] == \
+            pytest.approx([0.1, 0.3])
+        # every derived stat is the agent's stat
+        assert timer.step_time_s == timer.agent.step_time_s
+        assert timer.tokens_per_s == timer.agent.tokens_per_s
+        assert timer.mfu == timer.agent.mfu
+        assert timer.mfu == pytest.approx(
+            roofline.mfu(timer.tokens_per_s, TINY, 128, 1, "v5e"))
+
+    def test_legacy_times_poke_still_works(self):
+        timer = StepTimer(TINY, batch=4, seq_len=128, num_chips=1)
+        timer._times = [0.1, 0.1]
+        assert timer.tokens_per_s == pytest.approx(4 * 128 / 0.1)
+        assert timer._times == [0.1, 0.1]
+
+    def test_report_and_exposition(self):
+        timer = StepTimer(TINY, batch=4, seq_len=128, num_chips=1)
+        timer.agent.hbm_fn = dict
+        timer._times = [0.2]
+        rep = timer.report()
+        assert rep["step_time_s"] == pytest.approx(0.2)
+        text = timer.prometheus_text()
+        assert "# TYPE notebook_training_mfu_ratio gauge" in text
+
+
+class TestAnnotationContractSync:
+    def test_core_and_runtime_constants_match(self):
+        # core must not import the runtime package; the literals are
+        # duplicated and THIS is the tripwire that keeps them in sync
+        assert core_telemetry.TELEMETRY_ANNOTATION == \
+            telemetry.TELEMETRY_ANNOTATION
+        assert core_telemetry.SUMMARY_VERSION == telemetry.SUMMARY_VERSION
+
+
+# -- control-plane aggregation ------------------------------------------------
+
+
+def make_pod(api, ns, notebook, name, summary=None, raw=None):
+    annotations = {}
+    if raw is not None:
+        annotations[core_telemetry.TELEMETRY_ANNOTATION] = raw
+    elif summary is not None:
+        annotations[core_telemetry.TELEMETRY_ANNOTATION] = \
+            annotation_payload(summary)
+    return api.create(KubeObject(
+        api_version="v1", kind="Pod",
+        metadata=ObjectMeta(name=name, namespace=ns,
+                            labels={"notebook-name": notebook},
+                            annotations=annotations),
+        body={"status": {"phase": "Running"}}))
+
+
+def make_notebook(api, ns, name):
+    return api.create(KubeObject(
+        api_version="kubeflow.org/v1", kind="Notebook",
+        metadata=ObjectMeta(name=name, namespace=ns), body={"spec": {}}))
+
+
+def worker_summary(worker, step_time_s, tokens_per_s=None, mfu=0.3):
+    if tokens_per_s is None:
+        tokens_per_s = 1000.0 / step_time_s
+    return {"v": 1, "worker": worker, "mode": "train", "steps": 5,
+            "step_time_s": step_time_s, "tokens_per_s": tokens_per_s,
+            "mfu": mfu, "hbm_bytes": 1 << 30, "t": 0.0}
+
+
+class TestWorkerTelemetryAggregator:
+    def build(self, api, with_cache=True, recorder=None, **kw):
+        registry = Registry()
+        cache = InformerCache(api) if with_cache else None
+        agg = WorkerTelemetryAggregator(
+            api, registry, FakeClock(), cache=cache, recorder=recorder,
+            **kw)
+        return agg, registry
+
+    def test_rollup_matches_brute_force_over_pods(self):
+        api = ApiServer()
+        import random
+
+        rng = random.Random(11)
+        for i in range(5):
+            for w in range(rng.randint(1, 6)):
+                st = rng.uniform(0.1, 2.0)
+                make_pod(api, f"ns{i % 2}", f"nb-{i}", f"nb-{i}-{w}",
+                         worker_summary(f"nb-{i}-{w}", st,
+                                        mfu=rng.uniform(0.1, 0.5)))
+        # noise: annotation-less and malformed pods never contribute
+        make_pod(api, "ns0", "nb-0", "nb-0-noann")
+        make_pod(api, "ns0", "nb-1", "nb-1-bad", raw="{not json")
+        make_pod(api, "ns0", "nb-1", "nb-1-oldv",
+                 raw=json.dumps({"v": 0, "step_time_s": 1.0}))
+        cached, _ = self.build(api, with_cache=True)
+        brute, _ = self.build(api, with_cache=False)
+        a, b = cached.evaluate(), brute.evaluate()
+        # identical float inputs through identical rollup code: the
+        # cache-fed and brute-force paths must agree EXACTLY
+        assert a["notebooks"] == b["notebooks"]
+        assert a["fleet"] == b["fleet"]
+        # and equals a by-hand rollup straight off the pod list
+        for key, entry in a["notebooks"].items():
+            ns, nb = key.split("/")
+            pods = [p for p in api.list("Pod", namespace=ns)
+                    if parse_pod_telemetry(p)
+                    and parse_pod_telemetry(p)["notebook"] == nb]
+            assert len(entry["workers"]) == len(pods)
+            assert entry["tokens_per_s"] == pytest.approx(sum(
+                parse_pod_telemetry(p)["summary"]["tokens_per_s"]
+                for p in pods))
+
+    def test_watch_fed_updates_replace_worker_contribution(self):
+        api = ApiServer()
+        pod = make_pod(api, "u1", "nb", "nb-0",
+                       worker_summary("nb-0", 1.0))
+        agg, _ = self.build(api)
+        assert agg.evaluate()["notebooks"]["u1/nb"]["step_time_s"] == \
+            pytest.approx(1.0)
+        live = api.get("Pod", "u1", pod.name)
+        live.metadata.annotations[core_telemetry.TELEMETRY_ANNOTATION] = \
+            annotation_payload(worker_summary("nb-0", 0.25))
+        api.update(live)
+        assert agg.evaluate()["notebooks"]["u1/nb"]["step_time_s"] == \
+            pytest.approx(0.25)
+
+    def test_straggler_fire_and_clear_with_events(self):
+        api = ApiServer()
+        make_notebook(api, "u1", "nb")
+        for w in range(4):
+            make_pod(api, "u1", "nb", f"nb-0-{w}",
+                     worker_summary(f"nb-0-{w}", 0.5))
+        recorder = EventRecorder(api, "test-telemetry")
+        agg, registry = self.build(api, recorder=recorder,
+                                   straggler_ratio=1.5)
+        out = agg.evaluate()
+        assert out["stragglers"] == []
+        gauge = registry.get("notebook_dataplane_straggler")
+        assert gauge.collect()[("u1", "nb")] == 0.0
+
+        # one worker falls 4x behind the slice median
+        live = api.get("Pod", "u1", "nb-0-3")
+        live.metadata.annotations[core_telemetry.TELEMETRY_ANNOTATION] = \
+            annotation_payload(worker_summary("nb-0-3", 2.0))
+        api.update(live)
+        out = agg.evaluate()
+        assert [s["worker"] for s in out["stragglers"]] == ["nb-0-3"]
+        assert out["stragglers"][0]["ratio"] == pytest.approx(4.0)
+        assert out["notebooks"]["u1/nb"]["straggler"] == "nb-0-3"
+        assert out["notebooks"]["u1/nb"]["step_time_s"] == \
+            pytest.approx(2.0)
+        assert gauge.collect()[("u1", "nb")] == 1.0
+        events = [e for e in api.list("Event", namespace="u1")
+                  if e.body.get("reason") == EVENT_STRAGGLER]
+        assert len(events) == 1
+        assert "nb-0-3" in events[0].body["message"]
+        # continued breach dedups into the same event (count bump)
+        agg.evaluate()
+        events = [e for e in api.list("Event", namespace="u1")
+                  if e.body.get("reason") == EVENT_STRAGGLER]
+        assert len(events) == 1
+
+        # heal: the worker rejoins the pace; gauge and state clear
+        live = api.get("Pod", "u1", "nb-0-3")
+        live.metadata.annotations[core_telemetry.TELEMETRY_ANNOTATION] = \
+            annotation_payload(worker_summary("nb-0-3", 0.5))
+        api.update(live)
+        out = agg.evaluate()
+        assert out["stragglers"] == []
+        assert gauge.collect()[("u1", "nb")] == 0.0
+        cleared = [e for e in api.list("Event", namespace="u1")
+                   if e.body.get("reason") == EVENT_STRAGGLER_CLEARED]
+        assert len(cleared) == 1
+
+    def test_single_worker_never_straggles(self):
+        api = ApiServer()
+        make_pod(api, "u1", "solo", "solo-0",
+                 worker_summary("solo-0", 10.0))
+        agg, registry = self.build(api)
+        assert agg.evaluate()["stragglers"] == []
+        assert registry.get("notebook_dataplane_straggler") \
+            .collect()[("u1", "solo")] == 0.0
+
+    def test_vanished_workers_zero_the_series(self):
+        api = ApiServer()
+        for w in range(2):
+            make_pod(api, "u1", "nb", f"nb-0-{w}",
+                     worker_summary(f"nb-0-{w}", 0.5))
+        agg, registry = self.build(api)
+        agg.evaluate()
+        tokens = registry.get("notebook_dataplane_tokens_per_second")
+        assert tokens.collect()[("u1", "nb")] > 0
+        for w in range(2):
+            api.delete("Pod", "u1", f"nb-0-{w}")
+        out = agg.evaluate()
+        assert out["notebooks"] == {}
+        assert tokens.collect()[("u1", "nb")] == 0.0
+
+    def test_check_counters_feed_slo_objectives(self):
+        api = ApiServer()
+        for w in range(3):
+            make_pod(api, "u1", "nb", f"nb-0-{w}",
+                     worker_summary(f"nb-0-{w}", 0.5, mfu=0.2))
+        agg, registry = self.build(api, mfu_target=0.35)
+        agg.evaluate()
+        checks = registry.get("notebook_dataplane_straggler_checks_total")
+        assert checks.collect()[("ok",)] == 1.0
+        mfu_checks = registry.get("notebook_dataplane_mfu_checks_total")
+        assert mfu_checks.collect()[("low",)] == 1.0  # 0.2 < 0.35
+        # and the (knob-enabled) objectives read exactly these families
+        cfg = CoreConfig(slo_fleet_mfu=0.99, slo_straggler_rate=0.05)
+        names = {o.name: o for o in default_objectives(cfg)}
+        assert names["fleet_mfu"].metric == \
+            "notebook_dataplane_mfu_checks_total"
+        assert names["straggler_rate"].metric == \
+            "notebook_dataplane_straggler_checks_total"
+        assert names["straggler_rate"].target_ratio == pytest.approx(0.95)
+        # knob-disabled by default
+        defaults = {o.name for o in default_objectives(CoreConfig())}
+        assert "fleet_mfu" not in defaults
+        assert "straggler_rate" not in defaults
+
+    def test_snapshot_refreshes(self):
+        api = ApiServer()
+        agg, _ = self.build(api)
+        assert agg.snapshot()["fleet"]["notebooks"] == 0
+        make_pod(api, "u1", "nb", "nb-0-0", worker_summary("nb-0-0", 0.5))
+        make_pod(api, "u1", "nb", "nb-0-1", worker_summary("nb-0-1", 0.5))
+        snap = agg.snapshot()  # no explicit evaluate() needed
+        assert snap["fleet"]["notebooks"] == 1
+        assert snap["notebooks"]["u1/nb"]["mfu"] == pytest.approx(0.3)
+
+
+class TestFakeClusterStamping:
+    def test_stamp_runs_real_agents_and_flags_slow_worker(self):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        for w in range(3):
+            make_pod(api, "u1", "nb", f"nb-0-{w}")
+        out = cluster.stamp_worker_telemetry(
+            "u1", "nb", step_time_s=0.5, config=TINY, batch=4,
+            seq_len=128, num_chips=1, slow_worker=1, slow_factor=4.0,
+            now=42.0)
+        assert set(out) == {"nb-0-0", "nb-0-1", "nb-0-2"}
+        assert out["nb-0-1"]["step_time_s"] == pytest.approx(2.0)
+        assert out["nb-0-0"]["step_time_s"] == pytest.approx(0.5)
+        # the stamped annotation IS a real agent summary (same MFU
+        # definition as bench.py, via roofline)
+        pod = api.get("Pod", "u1", "nb-0-0")
+        parsed = parse_pod_telemetry(pod)
+        assert parsed["summary"] == pytest.approx(out["nb-0-0"])
+        assert out["nb-0-0"]["mfu"] == pytest.approx(
+            roofline.mfu(4 * 128 / 0.5, TINY, 128, 1, "v5e"))
+        # the aggregator attributes the slow worker
+        agg = WorkerTelemetryAggregator(api, Registry(), FakeClock())
+        snap = agg.snapshot()
+        assert snap["notebooks"]["u1/nb"]["straggler"] == "nb-0-1"
+        cluster.clear_worker_telemetry("u1", "nb")
+        assert agg.snapshot()["notebooks"] == {}
+
+
+# -- bench trajectory gate ----------------------------------------------------
+
+
+def bench_record(tmp_path, n, parsed, rc=0):
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({"n": n, "rc": rc, "parsed": parsed}))
+    return str(path)
+
+
+class TestBenchTrajectoryGate:
+    def test_repo_history_gates_green(self):
+        import glob
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        records = load_records(glob.glob(os.path.join(root,
+                                                      "BENCH_r*.json")))
+        assert len(records) >= 5
+        ok, msgs = trajectory_check(records)
+        assert ok, msgs
+
+    def test_regression_beyond_10pct_fails(self, tmp_path):
+        paths = [
+            bench_record(tmp_path, 1,
+                         {"metric": "train_mfu_v5e", "value": 0.40}),
+            bench_record(tmp_path, 2,
+                         {"metric": "train_mfu_v5e", "value": 0.35}),
+        ]
+        ok, msgs = trajectory_check(load_records(paths))
+        assert not ok
+        assert any("FAIL" in m for m in msgs)
+        # within tolerance passes
+        paths[1] = bench_record(tmp_path, 2,
+                                {"metric": "train_mfu_v5e", "value": 0.37})
+        ok, _ = trajectory_check(load_records(paths))
+        assert ok
+
+    def test_silent_skip_fails_reasoned_skip_passes(self, tmp_path):
+        base = bench_record(tmp_path, 1,
+                            {"metric": "train_mfu_v5e", "value": 0.40})
+        silent = bench_record(tmp_path, 2,
+                              {"metric": "train_mfu_v5e", "skipped": True})
+        ok, msgs = trajectory_check(load_records([base, silent]))
+        assert not ok and any("silent" in m for m in msgs)
+        reasoned = bench_record(
+            tmp_path, 3, {"metric": "train_mfu_v5e", "skipped": True,
+                          "reason": "no usable JAX backend"})
+        ok, _ = trajectory_check(load_records([base, reasoned]))
+        assert ok
+
+    def test_newest_crash_warns_but_gates_on_measured(self, tmp_path):
+        paths = [
+            bench_record(tmp_path, 1,
+                         {"metric": "train_mfu_v5e", "value": 0.40}),
+            bench_record(tmp_path, 2, None, rc=1),
+        ]
+        ok, msgs = trajectory_check(load_records(paths))
+        assert ok
+        assert any("crash" in m for m in msgs)
+
+    def test_empty_history_passes_vacuously(self):
+        ok, msgs = trajectory_check([])
+        assert ok
