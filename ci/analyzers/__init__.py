@@ -17,6 +17,11 @@ unwritten until each was violated once:
   - **hotpath**: reconciler/controller bodies read the InformerCache,
     never `api.list()` — O(its objects) per reconcile, not O(cluster)
     (`hot_path`).
+  - **writeahead**: in the crash-resumable protocols (recovery, slice
+    placement) every destructive call is dominated on the CFG by the
+    status write a successor resumes from (`write_ahead`).
+  - **lockset**: a field some method guards with a lock is guarded
+    everywhere, with lock inheritance for private helpers (`lockset`).
 
 Same zero-dependency ethos as `ci/lint.py`: stdlib `ast` only, runs in
 the hermetic image.  Exceptions live in `allowlist.py` and every entry
@@ -39,7 +44,7 @@ TARGETS = ["kubeflow_tpu", "tests", "ci", "conformance", "examples",
 
 @dataclass
 class Violation:
-    check: str      # analyzer id: clock | cow | locks | hotpath
+    check: str      # analyzer id: clock|cow|locks|hotpath|writeahead|lockset
     path: str       # repo-relative posix path ("" for project-wide)
     line: int
     context: str    # enclosing qualname (or edge/cycle descriptor)
@@ -127,18 +132,33 @@ def iter_modules() -> list[Module]:
 def run_all(modules=None) -> tuple[list[Violation], dict]:
     """Run every analyzer; returns (unallowed violations, stats).
     Allowlisted violations are filtered here; allowlist entries that
-    matched nothing come back as violations themselves."""
+    matched nothing come back as violations themselves.  `stats` carries
+    per-analyzer wall time + raw finding counts under "analyzers"."""
+    import time
+
     from . import allowlist, clock_discipline, cow_contract, hot_path, \
-        lock_order
+        lock_order, lockset, write_ahead
 
     if modules is None:
         modules = iter_modules()
     raw: list[Violation] = []
-    for mod in modules:
-        raw.extend(clock_discipline.analyze(mod))
-        raw.extend(cow_contract.analyze(mod))
-        raw.extend(hot_path.analyze(mod))
-    raw.extend(lock_order.analyze_project(modules))
+    timings: list[dict] = []
+
+    def timed(check, run) -> None:
+        t0 = time.perf_counter()
+        found = run()
+        timings.append({"check": check,
+                        "seconds": round(time.perf_counter() - t0, 4),
+                        "findings": len(found)})
+        raw.extend(found)
+
+    def over_modules(analyzer):
+        return lambda: [v for m in modules for v in analyzer.analyze(m)]
+
+    for analyzer in (clock_discipline, cow_contract, hot_path,
+                     write_ahead, lockset):
+        timed(analyzer.CHECK, over_modules(analyzer))
+    timed(lock_order.CHECK, lambda: lock_order.analyze_project(modules))
 
     kept, allowed, stale = allowlist.apply(
         raw, scanned_paths=[m.rel for m in modules])
@@ -147,15 +167,47 @@ def run_all(modules=None) -> tuple[list[Violation], dict]:
         "files": len(modules),
         "violations": len(kept),
         "allowed": len(allowed),
+        "analyzers": timings,
     }
     return kept, stats
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m ci.analyzers",
+        description="repo-native invariant analyzers")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report on stdout")
+    ap.add_argument("--json-out", metavar="FILE",
+                    help="also write the JSON report to FILE (CI artifact)")
+    args = ap.parse_args(argv)
+
     violations, stats = run_all()
-    for v in sorted(violations, key=lambda v: (v.path, v.line, v.check)):
+    ordered = sorted(violations, key=lambda v: (v.path, v.line, v.check))
+    doc = {
+        "ok": not violations,
+        "files": stats["files"],
+        "allowed": stats["allowed"],
+        "analyzers": stats["analyzers"],
+        "violations": [
+            {"check": v.check, "path": v.path, "line": v.line,
+             "context": v.context, "message": v.message}
+            for v in ordered],
+    }
+    if args.json_out:
+        Path(args.json_out).write_text(json.dumps(doc, indent=2) + "\n")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 1 if violations else 0
+
+    for v in ordered:
         print(v.render())
+    timing = "  ".join(f"{t['check']}={t['seconds']:.2f}s"
+                       for t in stats["analyzers"])
     print(f"analyzers: {stats['files']} files, "
           f"{stats['violations']} violations "
-          f"({stats['allowed']} allowlisted)")
+          f"({stats['allowed']} allowlisted) [{timing}]")
     return 1 if violations else 0

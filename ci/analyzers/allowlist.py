@@ -82,6 +82,11 @@ ALLOWLIST: tuple[Allow, ...] = (
           "wire servers, worker pools) — a FakeClock cannot advance "
           "another thread's progress; logical-time tests already inject "
           "FakeClock via fixtures"),
+    Allow("clock", "kubeflow_tpu/testing/interleave.py", "*",
+          "the schedule explorer's budget and wedge guards must measure "
+          "TRUE wall time: they bound how long CI spends enumerating and "
+          "detect threads that stopped cooperating — a logical clock "
+          "would never expire while a run is wedged"),
     # -- COW / frozen contract -----------------------------------------------
     Allow("cow", "tests/test_analyzers.py", "*",
           "the sanitizer's own test suite seeds deliberate "
@@ -93,6 +98,45 @@ ALLOWLIST: tuple[Allow, ...] = (
           "multi-shard acquisition in subscribe() takes sibling shard "
           "locks in sorted-by-kind order under _shards_lock; the runtime "
           "LockTracker enforces the rank order under INVARIANTS_STRICT"),
+    # -- lockset (lock-inconsistent field access) ----------------------------
+    Allow("lockset", "kubeflow_tpu/kube/cache.py", "InformerCache.connected",
+          "GIL-atomic bool used for double-checked locking: "
+          "ensure_connected() re-checks it under _conn_lock before "
+          "reconnecting, so a stale lock-free read only costs one extra "
+          "call, never a double subscribe"),
+    Allow("lockset", "kubeflow_tpu/kube/cache.py", "InformerCache.drops",
+          "single-writer telemetry counter bumped on the apiserver's "
+          "watch-delivery thread; taking a cache lock there would nest "
+          "cache locks under the store's watch fan-out, and a torn read "
+          "in stats() only misstates a diagnostic count"),
+    Allow("lockset", "kubeflow_tpu/kube/cluster.py",
+          "FakeCluster._session_store",
+          "attached once during test setup before the cluster sees "
+          "concurrent traffic; read-only afterwards (the guarded sites "
+          "are just reads that happen to run under _mutex)"),
+    Allow("lockset", "kubeflow_tpu/kube/cluster.py", "FakeCluster.api",
+          "the apiserver reference never rebinds after __init__ — "
+          ".update()/.delete() mutate the store BEHIND the reference "
+          "(which has its own shard locks), but the container-mutator "
+          "heuristic cannot tell api.update from dict.update"),
+    Allow("lockset", "kubeflow_tpu/kube/controller.py",
+          "Manager._event_latency",
+          "deque(maxlen) appends are GIL-atomic; the _pop sampling path "
+          "deliberately records wall latency outside _lock (hot path), "
+          "and the loadtest reader snapshots under _lock"),
+    Allow("lockset", "kubeflow_tpu/kube/controller.py",
+          "Manager._registrations",
+          "register/unregister mutate the list under _lock, but the "
+          "event and reconcile hot paths iterate lock-free: CPython list "
+          "iteration is tear-free, and a racing (un)register only means "
+          "one delivery sees the previous registration set — "
+          "_process_item re-validates liveness under _lock (alive())"),
+    Allow("lockset", "kubeflow_tpu/kube/controller.py",
+          "Manager._trace_ids",
+          "each key is owned by exactly one worker between _pop and "
+          "_done (per-key serialization), so same-key get/set never "
+          "interleave; the locked sites touch other keys and dict ops "
+          "are GIL-atomic"),
     # -- hot-path scan ban ---------------------------------------------------
     Allow("hotpath", "kubeflow_tpu/core/scheduler.py",
           "SliceScheduler._inventory",

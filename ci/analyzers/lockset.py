@@ -1,0 +1,236 @@
+"""Static race detector: lock-inconsistent field access (lockset).
+
+In the concurrency core (the same modules lock_order.py graphs, plus the
+slice scheduler), an instance field that SOME method protects with a
+lock is a shared mutable — every other access must hold a lock too.  A
+field with both guarded and unguarded accesses outside ``__init__`` is
+flagged once, per field: either the unguarded site is a real race (the
+PR 9 class of bug the interleave explorer hunts dynamically) or it is a
+reasoned exception (GIL-atomic counters, single-writer telemetry) that
+belongs in allowlist.py with its reason written down.
+
+Mechanics (stdlib ``ast``, one pass per module):
+
+  - a *lock* is a ``self.<attr>`` whose name contains lock/mutex and
+    that the class acquires via ``with self.<attr>:`` (or holds through
+    ``ExitStack.enter_context``);
+  - every other ``self.<attr>`` load/store in a method body is a field
+    access, labelled with the set of locks held at that point;
+  - a private method called only while a lock is held INHERITS it: its
+    entry lockset is the intersection of the locksets at its intra-class
+    call sites (fixpoint) — the ``_register_entry``-style "caller holds
+    the lock" idiom needs no annotation;
+  - nested functions (retry closures) are scanned with the lockset at
+    their definition point — they run inline in these modules.
+
+Constructor writes are exempt (no concurrent readers exist before
+``__init__`` returns), as are fields only ever read after construction —
+a *write* being an attribute rebind, a subscript store/delete, or a
+mutating container method (append/add/pop/...).  Call sites inside
+``__init__`` likewise don't count against lock inheritance.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from . import Module, Violation
+
+CHECK = "lockset"
+
+#: concurrency core: every class here is touched from watch/worker
+#: threads and the manager loop at once
+LOCK_MODULES = (
+    "kubeflow_tpu/kube/store.py",
+    "kubeflow_tpu/kube/cache.py",
+    "kubeflow_tpu/kube/cluster.py",
+    "kubeflow_tpu/kube/controller.py",
+    "kubeflow_tpu/core/scheduler.py",
+)
+
+_LOCKISH = ("lock", "mutex")
+
+#: container methods that mutate their receiver — `self.x.append(...)`
+#: is a write to the shared structure behind `self.x`
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "__setitem__", "__delitem__",
+})
+
+
+def _is_self_attr(node) -> str:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _lockish(attr: str) -> bool:
+    low = attr.lower()
+    return any(p in low for p in _LOCKISH)
+
+
+class _Access:
+    __slots__ = ("method", "held", "line", "write")
+
+    def __init__(self, method, held, line, write):
+        self.method = method
+        self.held = held       # frozenset of lock attr names (with-held)
+        self.line = line
+        self.write = write
+
+
+class _ClassScan:
+    def __init__(self, cls: ast.ClassDef) -> None:
+        self.cls = cls
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.locks: set[str] = set()
+        self.accesses: dict[str, list[_Access]] = defaultdict(list)
+        # callee -> [(caller method, with-held locks at the call site)]
+        self.callsites: dict[str, list[tuple[str, frozenset]]] = \
+            defaultdict(list)
+        for name, fn in self.methods.items():
+            self._scan(name, fn.body, frozenset())
+
+    # -- per-method walk ------------------------------------------------------
+    def _scan(self, method: str, stmts, held) -> None:
+        for stmt in stmts:
+            self._scan_stmt(method, stmt, held)
+
+    def _scan_stmt(self, method: str, stmt, held) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                attr = _is_self_attr(item.context_expr)
+                if attr and _lockish(attr):
+                    self.locks.add(attr)
+                    inner = inner | {attr}
+                else:
+                    self._scan_expr(method, item.context_expr, held)
+            self._scan(method, stmt.body, inner)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # retry closures and watch callbacks: scanned with the
+            # lockset at their definition point
+            self._scan(method, stmt.body, held)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub:
+                self._scan(method, sub, held)
+        for h in getattr(stmt, "handlers", ()) or ():
+            self._scan(method, h.body, held)
+        for name in stmt._fields:
+            sub = getattr(stmt, name, None)
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            for node in sub if isinstance(sub, list) else [sub]:
+                if isinstance(node, ast.AST):
+                    self._scan_expr(method, node, held)
+
+    def _scan_expr(self, method: str, expr, held) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                func = node.func
+                callee = _is_self_attr(func)
+                if callee and callee in self.methods:
+                    self.callsites[callee].append((method, held))
+                elif isinstance(func, ast.Attribute) and \
+                        func.attr in _MUTATORS:
+                    self._record(method, _is_self_attr(func.value),
+                                 held, node.lineno, write=True)
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                self._record(method, _is_self_attr(node.value),
+                             held, node.lineno, write=True)
+            attr = _is_self_attr(node)
+            if attr:
+                self._record(method, attr, held, node.lineno,
+                             write=isinstance(node.ctx,
+                                              (ast.Store, ast.Del)))
+
+    def _record(self, method, attr, held, line, write) -> None:
+        if attr and not _lockish(attr) and attr not in self.methods:
+            self.accesses[attr].append(_Access(method, held, line, write))
+
+    # -- inherited locksets (fixpoint) ----------------------------------------
+    def entry_locksets(self) -> dict[str, frozenset]:
+        """Entry lockset per method: the intersection over every
+        intra-class call site of (locks held at the site ∪ the caller's
+        own entry lockset).  Only private helpers inherit — a public
+        method is callable from outside the class with nothing held.
+        Seeded full and refined down, so call cycles converge."""
+
+        def inherits(name: str) -> bool:
+            return name.startswith("_") and not name.startswith("__")
+
+        # construction-time call sites can't race — they don't dilute
+        # the intersection
+        callsites = {
+            name: [(c, h) for c, h in sites if c != "__init__"]
+            for name, sites in self.callsites.items()}
+        entry = {name: (frozenset(self.locks)
+                        if inherits(name) and callsites.get(name)
+                        else frozenset())
+                 for name in self.methods}
+        changed = True
+        while changed:
+            changed = False
+            for name, sites in callsites.items():
+                if not inherits(name):
+                    continue
+                got = None
+                for caller, held in sites:
+                    site = held | entry.get(caller, frozenset())
+                    got = site if got is None else (got & site)
+                got = got if got is not None else frozenset()
+                if got != entry[name]:
+                    entry[name] = got
+                    changed = True
+        return entry
+
+
+def analyze(mod: Module) -> list[Violation]:
+    if mod.rel not in LOCK_MODULES:
+        return []
+    out: list[Violation] = []
+    for node in mod.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        scan = _ClassScan(node)
+        if not scan.locks:
+            continue
+        entry = scan.entry_locksets()
+        for field in sorted(scan.accesses):
+            accs = [a for a in scan.accesses[field]
+                    if a.method != "__init__"]
+            if not accs or not any(a.write for a in accs):
+                continue   # read-only after construction
+
+            def lockset(a: _Access) -> frozenset:
+                return a.held | entry.get(a.method, frozenset())
+
+            guarded = [a for a in accs if lockset(a) & scan.locks]
+            naked = [a for a in accs if not (lockset(a) & scan.locks)]
+            if not guarded or not naked:
+                continue
+            locks = sorted(set().union(
+                *(lockset(a) & scan.locks for a in guarded)))
+            first = min(naked, key=lambda a: a.line)
+            where = sorted({f"{a.method}:{a.line}" for a in naked})
+            out.append(Violation(
+                CHECK, mod.rel, first.line, f"{node.name}.{field}",
+                "field is guarded by %s in %d place(s) but accessed "
+                "without any lock at %s — either a data race or an "
+                "allowlist.py entry with its reason" % (
+                    "/".join(locks), len(guarded), ", ".join(where))))
+    return out

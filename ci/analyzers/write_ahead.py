@@ -1,0 +1,339 @@
+"""Write-ahead discipline: destructive verbs dominated by persistence.
+
+The recovery and scheduling protocols survive manager crashes only
+because every destructive action (pod-deleting restarts, intent
+annotation writes) happens strictly AFTER the bookkeeping that lets a
+successor resume the work: the restore intent + attempt charge land on
+status.sessionState/sliceRecovery before any pod dies, and the pool
+claim commit lands on the TPUWarmPool status before the placement
+annotation that points at it.  tests/test_interleave.py proves the
+dynamic half (a seeded mutant fails a schedule); this analyzer pins the
+static half: in each configured flow, every statement that may
+(transitively) invoke a destructive verb must be DOMINATED on the
+method's control-flow graph by a statement that performs the
+status-persisting call — i.e. there is no entry->destroy path that skips
+the persist.
+
+Per-method statement-level CFG, stdlib `ast` only (same ethos as
+lock_order.py).  Calls resolve one level deep through local nested
+functions and same-class methods, including functions passed BY NAME as
+call arguments (`retry_on_conflict(attempt)` executes `attempt`); a bare
+destructive name passed as an argument (`self._execute_migrate(...,
+restart_slice)`) marks the call site destructive.  The check is
+intentionally strict: a statement that both destroys and persists does
+NOT satisfy itself — ordering inside one call is invisible statically,
+so the persist must happen in an earlier dominator.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from . import Module, Violation
+
+CHECK = "writeahead"
+
+
+@dataclass(frozen=True)
+class Flow:
+    path: str          # repo-relative module
+    qualname: str      # Class.method the discipline applies to
+    destructive: tuple  # dotted call patterns / bare callback names
+    persist: tuple      # dotted call patterns that persist the intent
+
+
+FLOWS: tuple[Flow, ...] = (
+    # recovery: restore intent + attempt charge persist before pod deletes
+    Flow("kubeflow_tpu/core/selfheal.py", "RecoveryEngine.maybe_recover",
+         destructive=("restart_slice", "stamp_restore"),
+         persist=("self._write_bookkeeping",)),
+    # placement: the pool claim commit persists before the intent
+    # annotation that points at it
+    Flow("kubeflow_tpu/core/scheduler.py", "SliceScheduler._place",
+         destructive=("self.api.update",),
+         persist=("self.api.update_status",)),
+    # reclamation: claims drain back to the pool before the intent
+    # annotation (the crash-recovery pointer to them) is dropped
+    Flow("kubeflow_tpu/core/scheduler.py", "SliceScheduler._release",
+         destructive=("self.api.update",),
+         persist=("self.api.update_status",)),
+)
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+# -- local call summaries ------------------------------------------------------
+class _Summaries:
+    """May-invoke summaries for every function/method in the module,
+    keyed by simple name (closures and methods share one namespace —
+    coarse, but collisions only ever widen the summary)."""
+
+    def __init__(self, tree: ast.AST, flow: Flow) -> None:
+        self.flow = flow
+        self.fns: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fns.setdefault(node.name, node)
+        self.destroys: dict[str, bool] = {}
+        self.persists: dict[str, bool] = {}
+        self._solve()
+
+    def _direct(self, fn) -> tuple[bool, bool, set]:
+        """(destroys, persists, local callees) from fn's own statements,
+        not descending into nested function definitions."""
+        destroys = persists = False
+        callees: set[str] = set()
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue   # executes only when called — summary per callee
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name in self.flow.destructive:
+                    destroys = True
+                if name in self.flow.persist:
+                    persists = True
+                simple = name.split(".")[-1] if name else ""
+                if simple in self.fns:
+                    callees.add(simple)
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        if arg.id in self.flow.destructive:
+                            destroys = True
+                        if arg.id in self.fns:
+                            callees.add(arg.id)
+            stack.extend(ast.iter_child_nodes(node))
+        return destroys, persists, callees
+
+    def _solve(self) -> None:
+        direct = {name: self._direct(fn) for name, fn in self.fns.items()}
+        self.destroys = {n: d for n, (d, _, _) in direct.items()}
+        self.persists = {n: p for n, (_, p, _) in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, (_, _, callees) in direct.items():
+                for c in callees:
+                    if self.destroys.get(c) and not self.destroys[name]:
+                        self.destroys[name] = True
+                        changed = True
+                    if self.persists.get(c) and not self.persists[name]:
+                        self.persists[name] = True
+                        changed = True
+
+
+# -- statement-level CFG -------------------------------------------------------
+class _Node:
+    __slots__ = ("idx", "stmt", "succ")
+
+    def __init__(self, idx: int, stmt) -> None:
+        self.idx = idx
+        self.stmt = stmt
+        self.succ: set[int] = set()
+
+
+class _Cfg:
+    """CFG over one function body.  Conservative: try-bodies may jump to
+    their handlers after ANY statement, loops may skip their bodies,
+    breaks exit the innermost loop."""
+
+    def __init__(self, fn: ast.FunctionDef) -> None:
+        self.nodes: list[_Node] = []
+        entry = self._new(None)            # synthetic entry
+        exits = self._build(fn.body, [entry.idx], loop_exits=None)
+        self.entry = entry.idx
+        self.exits = exits
+
+    def _new(self, stmt) -> _Node:
+        node = _Node(len(self.nodes), stmt)
+        self.nodes.append(node)
+        return node
+
+    def _link(self, preds, node) -> None:
+        for p in preds:
+            self.nodes[p].succ.add(node.idx)
+
+    def _build(self, stmts, preds, loop_exits) -> list:
+        """Wire `stmts` after `preds`; returns the fall-through exits.
+        `loop_exits` collects break targets for the innermost loop."""
+        for stmt in stmts:
+            node = self._new(stmt)
+            self._link(preds, node)
+            preds = [node.idx]
+            if isinstance(stmt, ast.If):
+                body = self._build(stmt.body, [node.idx], loop_exits)
+                other = self._build(stmt.orelse, [node.idx], loop_exits) \
+                    if stmt.orelse else [node.idx]
+                preds = body + other
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                brk: list = []
+                body = self._build(stmt.body, [node.idx], brk)
+                for b in body:           # back edge
+                    self.nodes[b].succ.add(node.idx)
+                after = self._build(stmt.orelse, [node.idx], loop_exits) \
+                    if stmt.orelse else [node.idx]
+                preds = after + brk
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                preds = self._build(stmt.body, [node.idx], loop_exits)
+            elif isinstance(stmt, ast.Try):
+                start = len(self.nodes)
+                body = self._build(stmt.body, [node.idx], loop_exits)
+                # any body statement may raise straight into a handler
+                raised = [node.idx] + list(range(start, len(self.nodes)))
+                handler_exits: list = []
+                for h in stmt.handlers:
+                    handler_exits += self._build(h.body, raised, loop_exits)
+                els = self._build(stmt.orelse, body, loop_exits) \
+                    if stmt.orelse else body
+                merged = els + handler_exits
+                if stmt.finalbody:
+                    preds = self._build(stmt.finalbody, merged, loop_exits)
+                else:
+                    preds = merged
+            elif isinstance(stmt, (ast.Return, ast.Raise)):
+                preds = []               # terminator
+            elif isinstance(stmt, ast.Break):
+                if loop_exits is not None:
+                    loop_exits.append(node.idx)
+                preds = []
+            elif isinstance(stmt, ast.Continue):
+                preds = []               # back edge folded into loop node
+        return preds
+
+    def dominators(self) -> list:
+        """Iterative dominator sets (method-sized CFGs — quadratic is
+        fine)."""
+        n = len(self.nodes)
+        preds: list[set[int]] = [set() for _ in range(n)]
+        for node in self.nodes:
+            for s in node.succ:
+                preds[s].add(node.idx)
+        full = set(range(n))
+        dom = [full.copy() for _ in range(n)]
+        dom[self.entry] = {self.entry}
+        changed = True
+        while changed:
+            changed = False
+            for i in range(n):
+                if i == self.entry:
+                    continue
+                if not preds[i]:
+                    new = {i}
+                else:
+                    new = set.intersection(
+                        *(dom[p] for p in preds[i])) | {i}
+                if new != dom[i]:
+                    dom[i] = new
+                    changed = True
+        return dom
+
+
+def _stmt_calls(stmt) -> list:
+    """Calls directly attributable to this statement.  Compound
+    statements contribute only their HEADER expressions (test, iterable,
+    context managers) — their bodies are separate CFG nodes — and nested
+    function definitions execute at their CALL sites, not here."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots: list = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [item.context_expr for item in stmt.items]
+    elif isinstance(stmt, ast.Try):
+        roots = []
+    else:
+        roots = [stmt]
+    out = []
+    stack = roots
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _labels(stmt, summaries: _Summaries, flow: Flow) -> tuple[bool, bool]:
+    if stmt is None or isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return False, False
+    destroys = persists = False
+    for call in _stmt_calls(stmt):
+        name = _dotted(call.func)
+        simple = name.split(".")[-1] if name else ""
+        if name in flow.destructive or summaries.destroys.get(simple):
+            destroys = True
+        if name in flow.persist or summaries.persists.get(simple):
+            persists = True
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                if arg.id in flow.destructive or \
+                        summaries.destroys.get(arg.id):
+                    destroys = True
+                if summaries.persists.get(arg.id):
+                    persists = True
+    return destroys, persists
+
+
+def _find_method(tree: ast.AST, qualname: str):
+    cls_name, meth = qualname.split(".", 1)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and child.name == meth:
+                    return child
+    return None
+
+
+def analyze(mod: Module) -> list[Violation]:
+    out: list[Violation] = []
+    for flow in FLOWS:
+        if mod.rel != flow.path:
+            continue
+        fn = _find_method(mod.tree, flow.qualname)
+        if fn is None:
+            out.append(Violation(
+                CHECK, mod.rel, 1, flow.qualname,
+                f"configured write-ahead flow {flow.qualname} not found — "
+                "update ci/analyzers/write_ahead.py FLOWS"))
+            continue
+        summaries = _Summaries(mod.tree, flow)
+        cfg = _Cfg(fn)
+        labels = [_labels(n.stmt, summaries, flow) for n in cfg.nodes]
+        dom = cfg.dominators()
+        for node in cfg.nodes:
+            destroys, _ = labels[node.idx]
+            if not destroys:
+                continue
+            # strict dominators only: persist-then-destroy inside ONE
+            # statement is not statically ordered
+            if any(labels[d][1] for d in dom[node.idx]
+                   if d != node.idx):
+                continue
+            line = getattr(node.stmt, "lineno", fn.lineno)
+            out.append(Violation(
+                CHECK, mod.rel, line, flow.qualname,
+                "destructive call (%s) is not dominated by the "
+                "status-persisting write (%s): a crash between them "
+                "loses the write-ahead record this protocol resumes "
+                "from" % (" | ".join(flow.destructive),
+                          " | ".join(flow.persist))))
+    return out

@@ -62,3 +62,23 @@ if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
   exit 1
 fi
 echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, workers=${WORKERS})"
+
+# INTERLEAVE_DEEP=1: re-run the schedule-exploring protocol tests
+# (tests/test_interleave.py) with a much larger enumeration budget than
+# the in-suite smoke — more distinct schedules and a longer wall budget
+# buy coverage of deeper preemption patterns.  Off by default: the smoke
+# already proves >=1000 schedules per protocol inside tier-1.
+if [[ "${INTERLEAVE_DEEP:-0}" == "1" ]]; then
+  DEEP_SCHEDULES="${INTERLEAVE_DEEP_SCHEDULES:-20000}"
+  DEEP_BUDGET="${INTERLEAVE_DEEP_BUDGET_S:-600}"
+  echo "== interleave deep exploration: max_schedules=${DEEP_SCHEDULES} budget_s=${DEEP_BUDGET} =="
+  if ! INTERLEAVE_MAX_SCHEDULES="$DEEP_SCHEDULES" \
+      INTERLEAVE_BUDGET_S="$DEEP_BUDGET" INVARIANTS_STRICT="$STRICT" \
+      python -m pytest tests/test_interleave.py -q; then
+    echo "interleave deep exploration FAILED — reproduce with:" >&2
+    echo "  INTERLEAVE_DEEP=1 INTERLEAVE_DEEP_SCHEDULES=${DEEP_SCHEDULES} \\" >&2
+    echo "    INTERLEAVE_DEEP_BUDGET_S=${DEEP_BUDGET} ci/chaos_soak.sh" >&2
+    exit 1
+  fi
+  echo "interleave deep exploration OK"
+fi

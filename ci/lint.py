@@ -25,6 +25,12 @@ that needs no third-party tooling so the gate also runs in hermetic images:
     a `*_total` name must register a counter, and a `*_seconds` name a
     histogram or gauge — a counter-suffixed gauge breaks PromQL
     rate()/increase() silently (the bug this check was born from)
+  - bare `threading.Lock()`/`RLock()` construction in kubeflow_tpu/:
+    control-plane locks must be wrapped in `invariants.tracked(...)` so
+    the runtime LockTracker orders them and the interleave explorer
+    (kubeflow_tpu/testing/interleave.py) can schedule around them; an
+    untracked lock is invisible to both.  Leaf/out-of-scope modules are
+    exempted in `_BARE_LOCK_EXEMPT` with their reason
 """
 
 from __future__ import annotations
@@ -154,7 +160,89 @@ def check(path: Path, tree: "ast.AST | None" = None) -> list[str]:
             out.append(f"{rel}:{lineno}: tab indentation")
     out.extend(f"{rel}:{line}: {msg}"
                for line, msg in check_metric_names(tree))
+    out.extend(f"{rel}:{line}: {msg}"
+               for line, msg in check_bare_locks(tree, rel.as_posix()))
     return out
+
+
+#: modules allowed to construct untracked locks, each with WHY the
+#: tracker/explorer may stay blind to them (same contract as
+#: ci/analyzers/allowlist.py: no entry without a reason)
+_BARE_LOCK_EXEMPT = {
+    "kubeflow_tpu/utils/invariants.py":
+        "the TrackedLock factory and the LockTracker's own graph lock "
+        "live here — wrapping them would recurse",
+    "kubeflow_tpu/tpu/device_plugin.py":
+        "real-node kubelet daemon; its message buffer lock never meets "
+        "a control-plane lock or a model-checked schedule",
+    "kubeflow_tpu/kube/client.py":
+        "real-apiserver HTTP client: locks guard private watch/session "
+        "plumbing on the wire side and never nest with store locks",
+    "kubeflow_tpu/kube/wire.py":
+        "wire-protocol server internals (per-connection snapshots, "
+        "audit log); self-contained leaf locks on the serving path",
+    "kubeflow_tpu/core/sessionstate.py":
+        "leaf RLock around the in-memory snapshot ring, never held "
+        "across a call into another subsystem; the model-checked "
+        "restore protocol serializes on store.commit yield points, not "
+        "on this lock",
+    "kubeflow_tpu/utils/tracing.py":
+        "telemetry leaf locks (span buffers, provider registry); "
+        "tracking them would inject a yield point into every span "
+        "start and blow up the explored schedule space with "
+        "control-flow-irrelevant interleavings",
+    "kubeflow_tpu/utils/metrics.py":
+        "telemetry leaf locks around metric registries/series — same "
+        "rationale as tracing.py",
+    "kubeflow_tpu/utils/profiler.py":
+        "sampler leaf lock on the real-wall-time profiling path — "
+        "same rationale as tracing.py",
+    "kubeflow_tpu/utils/flightrecorder.py":
+        "flight-recorder ring lock, append-only diagnostics — same "
+        "rationale as tracing.py",
+    "kubeflow_tpu/utils/slo.py":
+        "SLO engine sample-window lock, telemetry only — same "
+        "rationale as tracing.py",
+}
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock")
+
+
+def check_bare_locks(tree: ast.AST, rel: str) -> list[tuple[int, str]]:
+    """Flag `threading.Lock()`/`RLock()` constructions in kubeflow_tpu/
+    that are not passed straight into `invariants.tracked(...)`."""
+    if not rel.startswith("kubeflow_tpu/") or rel in _BARE_LOCK_EXEMPT:
+        return []
+    wrapped: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _dotted_name(node.func).split(".")[-1] == "tracked":
+            for arg in node.args:
+                if isinstance(arg, ast.Call):
+                    wrapped.add(id(arg))
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _dotted_name(node.func) in _LOCK_CTORS and \
+                id(node) not in wrapped:
+            out.append((
+                node.lineno,
+                "bare %s() — wrap it in invariants.tracked(...) so the "
+                "LockTracker and the interleave explorer see it, or add "
+                "this module to _BARE_LOCK_EXEMPT with a reason"
+                % _dotted_name(node.func)))
+    return out
+
+
+def _dotted_name(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return ""
+    parts.append(node.id)
+    return ".".join(reversed(parts))
 
 
 _METRIC_METHODS = ("counter", "gauge", "histogram")

@@ -20,10 +20,12 @@ set -- ${FILTERED+"${FILTERED[@]}"}
 
 python ci/lint.py
 # invariant analyzers (ci/analyzers): clock discipline, COW/frozen
-# contract, lock-order graph, hot-path scan ban — zero unexplained
-# violations; exceptions live in ci/analyzers/allowlist.py with reasons
-# (docs/STATIC_ANALYSIS.md)
-python -m ci.analyzers
+# contract, lock-order graph, hot-path scan ban, write-ahead dominance,
+# lockset race detection — zero unexplained violations; exceptions live
+# in ci/analyzers/allowlist.py with reasons (docs/STATIC_ANALYSIS.md).
+# The JSON report (per-analyzer findings + wall time) lands as a CI
+# artifact next to the human output.
+python -m ci.analyzers --json-out "${ANALYZERS_JSON_OUT:-/tmp/analyzers_report.json}"
 if command -v ruff >/dev/null 2>&1; then
   RUFF="ruff"
 elif python -c "import ruff" 2>/dev/null; then
@@ -58,6 +60,13 @@ while [[ $# -gt 0 ]]; do
     ARGS+=("$1"); shift
   fi
 done
+# interleave explorer smoke budget (tests/test_interleave.py, part of
+# the controlplane lane): bounded schedule enumeration keeps the
+# model-checking protocol tests CI-sized (>=1000 distinct schedules
+# each, seconds of wall time); ci/chaos_soak.sh INTERLEAVE_DEEP=1 raises
+# these for deep exploration
+export INTERLEAVE_MAX_SCHEDULES="${INTERLEAVE_MAX_SCHEDULES:-1200}"
+export INTERLEAVE_BUDGET_S="${INTERLEAVE_BUDGET_S:-60}"
 if [[ -n "$LANE" ]]; then
   case "$LANE" in
     controlplane|compute) ;;

@@ -462,21 +462,28 @@ class SliceScheduler:
                            {"sliceHealth": health or ""})
             return Result()
 
-        if has_claims:
-            def release_claims() -> None:
-                live = self.api.get(C.WARMPOOL_KIND, "", pool.name)
-                st = copy.deepcopy(live.body.get("status") or {})
-                slices = st.setdefault("slices", {})
-                changed = False
-                for sid in list(slices):
-                    if slices[sid].get("claimedBy") == key:
-                        self._release_entry(slices, sid)
-                        changed = True
-                if changed:
-                    live.status = st
-                    self.api.update_status(live)
+        def release_claims() -> None:
+            # claims MUST drain before the intent annotation goes: the
+            # intent is what lets a crashed scheduler re-find its claims,
+            # so dropping it first would leak the pool slice forever.
+            # Unconditional (no-pool no-ops inside) so the status write
+            # dominates drop_intent on every CFG path — enforced by
+            # ci/analyzers/write_ahead.py.
+            if pool is None:
+                return
+            live = self.api.get(C.WARMPOOL_KIND, "", pool.name)
+            st = copy.deepcopy(live.body.get("status") or {})
+            slices = st.setdefault("slices", {})
+            changed = False
+            for sid in list(slices):
+                if slices[sid].get("claimedBy") == key:
+                    self._release_entry(slices, sid)
+                    changed = True
+            if changed:
+                live.status = st
+                self.api.update_status(live)
 
-            retry_on_conflict(release_claims)
+        retry_on_conflict(release_claims)
 
         def drop_intent() -> None:
             live = self.api.get("Notebook", nb.namespace, nb.name)

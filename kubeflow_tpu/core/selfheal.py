@@ -359,13 +359,16 @@ class RecoveryEngine:
                 session_state.pop(key)
             exhausted = sorted(
                 k for k, s in recovery.items() if s.get("exhausted"))
-            if recovery != prev_recovery or session_state != prev_session:
-                # write-ahead: the budget charge AND the restore intent
-                # must survive a crash between here and the pod deletes
-                # below — a manager failover resumes the migration from
-                # status.sessionState instead of forgetting it
-                self._write_bookkeeping(nb, recovery, exhausted,
-                                        session_state)
+            # write-ahead: the budget charge AND the restore intent must
+            # survive a crash between here and the pod deletes below — a
+            # manager failover resumes the migration from
+            # status.sessionState instead of forgetting it.  The call is
+            # unconditional (the unchanged-bookkeeping no-op check lives
+            # inside) so it dominates every restart on the CFG — enforced
+            # by ci/analyzers/write_ahead.py.
+            self._write_bookkeeping(nb, recovery, exhausted, session_state,
+                                    skip_if_unchanged=(prev_recovery,
+                                                       prev_session))
             for etype, reason, message in events:
                 self.recorder.event(nb.obj, etype, reason, message)
 
@@ -716,14 +719,22 @@ class RecoveryEngine:
     # -- persistence ----------------------------------------------------------
     def _write_bookkeeping(self, nb: Notebook, recovery: dict,
                            exhausted: Optional[list[str]] = None,
-                           session_state: Optional[dict] = None) -> None:
+                           session_state: Optional[dict] = None,
+                           skip_if_unchanged: Optional[tuple] = None) -> None:
         """Persist status.sliceRecovery + status.sessionState (and the
         RecoveryExhausted condition) with conflict retry.  Runs BEFORE any
         pod delete of the same pass, so the attempt charge and the restore
         intent are crash-safe.  `session_state` None leaves
         status.sessionState untouched (the Stopped-cleanup path drops only
         the recovery budget — the pre-cull checkpoint record must
-        survive)."""
+        survive).  `skip_if_unchanged=(prev_recovery, prev_session)` makes
+        an unchanged write a no-op — the check lives HERE, not at the call
+        site, so the caller's call dominates its pod deletes on the CFG
+        (ci/analyzers/write_ahead.py)."""
+        if skip_if_unchanged is not None and \
+                recovery == skip_if_unchanged[0] and \
+                session_state == skip_if_unchanged[1]:
+            return
         exhausted = exhausted or []
 
         def write() -> None:

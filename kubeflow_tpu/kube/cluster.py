@@ -198,7 +198,7 @@ class FakeCluster:
         retire idle slices, but a shared/user-created pool label must
         never let a teardown yank nodes out from under running pods (and
         silently wreck their used-resources accounting)."""
-        with self.api.fault_exempt():
+        with self._mutex, self.api.fault_exempt():
             doomed = [
                 n.name for n in self.api.list("Node")
                 if n.metadata.labels.get(_GKE_NODEPOOL_LABEL) == pool
@@ -442,8 +442,10 @@ class FakeCluster:
         every worker pod of the slice exists, is Running with live
         containers, and still has its (Ready) node — then the current
         session payload lands as a `final` snapshot.  Anything less
-        returns None and the engine falls back to stored checkpoints."""
-        with self.api.fault_exempt():
+        returns None and the engine falls back to stored checkpoints.
+        Holds _mutex (reentrant): the failed-pod set mutates on the chaos
+        and watch threads while the recovery thread calls in here."""
+        with self._mutex, self.api.fault_exempt():
             pods = [
                 p for p in self.api.list("Pod", namespace=namespace)
                 if p.metadata.labels.get(_NOTEBOOK_NAME_LABEL) == notebook
@@ -522,7 +524,8 @@ class FakeCluster:
     def heal_statefulset(self, namespace: str, name: str) -> None:
         """Undo poison_statefulset: the next slice restart comes up
         clean (the operator replaced the broken hardware)."""
-        self._poisoned.pop((namespace, name), None)
+        with self._mutex:
+            self._poisoned.pop((namespace, name), None)
 
     # -- event loop ------------------------------------------------------------
     def _on_event(self, ev: WatchEvent) -> None:
@@ -734,7 +737,8 @@ class FakeCluster:
         """Incrementally-maintained used resources of one node (the sum of
         requests of pods bound there) — the equivalence tests compare this
         against the brute-force recount."""
-        return dict(self._node_used.get(name, {}))
+        with self._mutex:
+            return dict(self._node_used.get(name, {}))
 
     def _schedule(self, pod: KubeObject) -> Optional[KubeObject]:
         selector = pod.spec.get("nodeSelector") or {}

@@ -539,6 +539,8 @@ class Manager:
     def _enqueue(self, reg_name: str, req: Request,
                  enqueued_at: Optional[float] = None,
                  cause: Optional[tuple[float, float]] = None) -> None:
+        invariants.yield_point("queue.add", (reg_name, req.namespace,
+                                             req.name))
         with self._lock:
             key = (reg_name, req)
             if key in self._queued:
@@ -595,6 +597,7 @@ class Manager:
 
     # -- execution ------------------------------------------------------------
     def _pop(self) -> Optional[tuple[str, Request]]:
+        invariants.yield_point("queue.pop", None)
         with self._lock:
             # fairness: rotate over registrations so one chatty controller
             # cannot starve the others' queues
@@ -635,6 +638,8 @@ class Manager:
     def _done(self, key: tuple[str, Request]) -> None:
         """Finish processing `key`: release the per-key slot and re-queue
         it when events parked on it while it ran."""
+        invariants.yield_point("queue.done", (key[0], key[1].namespace,
+                                              key[1].name))
         with self._lock:
             self._processing.discard(key)
             self._inflight_started.pop(key, None)
@@ -1026,7 +1031,8 @@ class Manager:
 
     @property
     def dropped_errors(self) -> list[tuple[str, Request, BaseException]]:
-        return list(self._errors)
+        with self._lock:
+            return list(self._errors)
 
     def event_latency_samples(self) -> list[float]:
         """Wall-clock event->reconcile-start latencies (seconds) of up to

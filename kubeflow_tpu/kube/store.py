@@ -527,6 +527,11 @@ class ApiServer:
         ONE shared frozen object: no per-watcher deepcopy; callbacks must
         only read it, and may only enqueue or re-enter this ApiServer."""
         kind = ev.obj.kind
+        # model-checker schedule point: a commit becoming visible is where
+        # optimistic-concurrency races decide (testing/interleave.py)
+        invariants.yield_point(
+            "store.commit",
+            (ev.type.value, kind, ev.obj.namespace, ev.obj.name))
         ev.obj.frozen = True
         if ev.prev is not None:
             ev.prev.frozen = True

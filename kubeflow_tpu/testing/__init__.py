@@ -1,0 +1,6 @@
+"""Deterministic concurrency-testing harnesses (model checking).
+
+`interleave` is the schedule-exploring model checker built on the
+INVARIANTS_STRICT yield points (utils/invariants.py); see
+docs/STATIC_ANALYSIS.md "Model checking protocols".
+"""

@@ -182,6 +182,12 @@ class CoreConfig:
     telemetry_publish_interval_s: float = 30.0  # TELEMETRY_PUBLISH_INTERVAL_S
     slo_fleet_mfu: float = 0.0                  # SLO_FLEET_MFU
     slo_straggler_rate: float = 0.0             # SLO_STRAGGLER_RATE
+    # schedule-exploring model checker (testing/interleave.py): per-test
+    # exploration budget — distinct-schedule cap and wall cap, whichever
+    # bites first.  The CI smoke lane runs the defaults; the chaos-soak
+    # lane raises them via INTERLEAVE_DEEP (ci/chaos_soak.sh).
+    interleave_max_schedules: int = 1200        # INTERLEAVE_MAX_SCHEDULES
+    interleave_budget_s: float = 60.0           # INTERLEAVE_BUDGET_S
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -257,6 +263,9 @@ class CoreConfig:
                 env, "TELEMETRY_PUBLISH_INTERVAL_S", 30.0),
             slo_fleet_mfu=_float(env, "SLO_FLEET_MFU", 0.0),
             slo_straggler_rate=_float(env, "SLO_STRAGGLER_RATE", 0.0),
+            interleave_max_schedules=max(1, _int(
+                env, "INTERLEAVE_MAX_SCHEDULES", 1200)),
+            interleave_budget_s=_float(env, "INTERLEAVE_BUDGET_S", 60.0),
         )
 
 

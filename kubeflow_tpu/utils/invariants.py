@@ -25,13 +25,21 @@ self-healing soaks at WORKQUEUE_WORKERS=8 — run with it on):
 
 Both hooks cost nothing when strict mode is off: `tracked()` returns the
 raw lock and the store skips the wrapper rebuild.
+
+The same instrumentation points double as the *schedule surface* for the
+model checker (`kubeflow_tpu/testing/interleave.py`): when a yield hook
+is installed via `set_yield_hook()`, every TrackedLock acquire/release,
+store commit and workqueue add/pop/done first calls the hook, which may
+suspend the calling thread and hand the schedule to another one.  With no
+hook installed (the default, including all of production) `yield_point()`
+is a None-check and a return.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 
 def strict_enabled() -> bool:
@@ -188,6 +196,37 @@ def deep_freeze(obj) -> None:
     meta.annotations = freeze_tree(meta.annotations)
 
 
+# -- schedule points ----------------------------------------------------------
+
+#: Installed by the InterleavingExplorer for the duration of one explored
+#: run; None in production and in every non-exploring test.  Signature:
+#: hook(kind, detail, token) where `kind` is the yield-point class
+#: ("lock.acquire", "lock.release", "store.commit", "queue.add",
+#: "queue.pop", "queue.done", "test.point", "test.wait"), `detail` is a
+#: small picklable payload naming the object (lock name, kind/ns/name
+#: tuple, queue key) and `token` identifies the concrete lock instance
+#: for ownership modelling (or a wait predicate for "test.wait").
+_yield_hook: Optional[Callable[[str, object, object], None]] = None
+
+
+def set_yield_hook(hook):
+    """Install (or with None, remove) the schedule hook.  Returns the
+    previous hook so explorers can nest/restore."""
+    global _yield_hook
+    prev = _yield_hook
+    _yield_hook = hook
+    return prev
+
+
+def yield_point(kind: str, detail=None, token=None) -> None:
+    """A point where the model checker may preempt this thread.  Callers
+    pass unformatted payloads (tuples, not f-strings) so the production
+    cost is one global read and a truth test."""
+    hook = _yield_hook
+    if hook is not None:
+        hook(kind, detail, token)
+
+
 # -- lock-order tracking ------------------------------------------------------
 
 class LockTracker:
@@ -283,6 +322,11 @@ class TrackedLock:
         self._tracker = tracker if tracker is not None else GLOBAL_TRACKER
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _yield_hook is not None and blocking:
+            # the hook must run BEFORE on_acquire/blocking so the explorer
+            # can park this thread while the lock is modelled as held
+            # elsewhere — a granted thread then never blocks for real
+            _yield_hook("lock.acquire", self.name, self._lock)
         self._tracker.on_acquire(self)
         ok = self._lock.acquire(blocking, timeout)
         if not ok:
@@ -290,6 +334,8 @@ class TrackedLock:
         return ok
 
     def release(self) -> None:
+        if _yield_hook is not None:
+            _yield_hook("lock.release", self.name, self._lock)
         self._lock.release()
         self._tracker.on_release(self)
 

@@ -16,7 +16,9 @@ from ci.analyzers import (
     cow_contract,
     hot_path,
     lock_order,
+    lockset,
     run_all,
+    write_ahead,
 )
 from ci.analyzers.allowlist import Allow
 from ci.analyzers import allowlist as allowlist_mod
@@ -247,6 +249,186 @@ class TestHotPathAnalyzer:
             "    def emit(self):\n"
             "        return self.api.list('Event')\n")
         assert hot_path.analyze(mod(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# write-ahead dominance
+# ---------------------------------------------------------------------------
+
+SELFHEAL_REL = "kubeflow_tpu/core/selfheal.py"
+SCHEDULER_REL = "kubeflow_tpu/core/scheduler.py"
+
+
+class TestWriteAheadAnalyzer:
+    def test_conditional_persist_does_not_dominate(self):
+        src = (
+            "class RecoveryEngine:\n"
+            "    def maybe_recover(self, nb, restart_slice):\n"
+            "        if nb:\n"
+            "            self._write_bookkeeping(nb, {})\n"
+            "        restart_slice(['s'])\n")
+        v = write_ahead.analyze(mod(src, SELFHEAL_REL))
+        assert len(v) == 1
+        assert v[0].check == "writeahead"
+        assert "not dominated" in v[0].message
+
+    def test_clean_twin_unconditional_persist(self):
+        src = (
+            "class RecoveryEngine:\n"
+            "    def maybe_recover(self, nb, restart_slice):\n"
+            "        self._write_bookkeeping(nb, {})\n"
+            "        if nb:\n"
+            "            restart_slice(['s'])\n")
+        assert write_ahead.analyze(mod(src, SELFHEAL_REL)) == []
+
+    def test_one_statement_cannot_satisfy_itself(self):
+        # persist+destroy inside a single helper: ordering is invisible
+        # statically, so the strict check still fires
+        src = (
+            "class RecoveryEngine:\n"
+            "    def maybe_recover(self, nb, restart_slice):\n"
+            "        self._both(nb, restart_slice)\n"
+            "    def _both(self, nb, restart_slice):\n"
+            "        self._write_bookkeeping(nb, {})\n"
+            "        restart_slice(['s'])\n")
+        v = write_ahead.analyze(mod(src, SELFHEAL_REL))
+        assert len(v) == 1
+
+    def test_callback_passed_by_name_is_destructive(self):
+        src = (
+            "class RecoveryEngine:\n"
+            "    def maybe_recover(self, nb, restart_slice):\n"
+            "        self._run(restart_slice)\n"
+            "    def _run(self, fn):\n"
+            "        fn()\n")
+        assert len(write_ahead.analyze(mod(src, SELFHEAL_REL))) == 1
+
+    def test_missing_configured_flow_is_flagged(self):
+        src = "class RecoveryEngine:\n    pass\n"
+        v = write_ahead.analyze(mod(src, SELFHEAL_REL))
+        assert any("not found" in x.message for x in v)
+
+    def test_repo_protocols_clean(self):
+        for rel in (SELFHEAL_REL, SCHEDULER_REL):
+            src = (Path(rel)).read_text()
+            assert write_ahead.analyze(mod(src, rel)) == [], rel
+
+    @pytest.mark.parametrize("which", ["A", "B"])
+    def test_interleave_mutants_also_fail_statically(self, which):
+        # the same textual mutants the explorer kills dynamically
+        # (tests/test_interleave.py) must fail the static gate too
+        import test_interleave as ti
+        rel, muts = {
+            "A": (SELFHEAL_REL, ti.MUTANT_A),
+            "B": (SCHEDULER_REL, ti.MUTANT_B),
+        }[which]
+        src = Path(rel).read_text()
+        for old, new in muts:
+            assert src.count(old) == 1
+            src = src.replace(old, new)
+        v = write_ahead.analyze(mod(src, rel))
+        assert v, f"mutant {which} not caught"
+        assert all("not dominated" in x.message for x in v)
+
+
+# ---------------------------------------------------------------------------
+# lockset (lock-inconsistent field access)
+# ---------------------------------------------------------------------------
+
+CLUSTER_REL = "kubeflow_tpu/kube/cluster.py"
+
+
+class TestLocksetAnalyzer:
+    def test_mixed_access_flagged_per_field(self):
+        src = (
+            "class C:\n"
+            "    def guarded(self):\n"
+            "        with self._lock:\n"
+            "            self._items['k'] = 1\n"
+            "    def naked(self):\n"
+            "        self._items.pop('k', None)\n")
+        v = lockset.analyze(mod(src, CLUSTER_REL))
+        assert len(v) == 1
+        assert v[0].check == "lockset"
+        assert v[0].context == "C._items"
+        assert "naked:6" in v[0].message
+
+    def test_clean_twin_consistent_locking(self):
+        src = (
+            "class C:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._items['k'] = 1\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self._items.pop('k', None)\n")
+        assert lockset.analyze(mod(src, CLUSTER_REL)) == []
+
+    def test_private_helper_inherits_callers_lock(self):
+        src = (
+            "class C:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._flush()\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self._items['y'] = 2\n"
+            "    def _flush(self):\n"
+            "        self._items['x'] = 1\n")
+        assert lockset.analyze(mod(src, CLUSTER_REL)) == []
+
+    def test_public_method_never_inherits(self):
+        # a public method is callable from outside with nothing held
+        src = (
+            "class C:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self.flush()\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self._items['y'] = 2\n"
+            "    def flush(self):\n"
+            "        self._items['x'] = 1\n")
+        v = lockset.analyze(mod(src, CLUSTER_REL))
+        assert len(v) == 1 and v[0].context == "C._items"
+
+    def test_read_only_after_init_exempt(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._cfg = {}\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._x = self._cfg.get('k')\n"
+            "    def b(self):\n"
+            "        return self._cfg.get('k')\n")
+        assert not any(v.context == "C._cfg"
+                       for v in lockset.analyze(mod(src, CLUSTER_REL)))
+
+    def test_init_callsites_do_not_dilute_inheritance(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._index()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._index()\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self._items['y'] = 2\n"
+            "    def _index(self):\n"
+            "        self._items['x'] = 1\n")
+        assert lockset.analyze(mod(src, CLUSTER_REL)) == []
+
+    def test_out_of_scope_module_skipped(self):
+        src = (
+            "class C:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self._items['k'] = 1\n"
+            "    def naked(self):\n"
+            "        self._items.pop('k', None)\n")
+        assert lockset.analyze(mod(src)) == []
 
 
 # ---------------------------------------------------------------------------

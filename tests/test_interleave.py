@@ -1,0 +1,482 @@
+"""Bounded-exhaustive model checking of the three core concurrency
+protocols (testing/interleave.py): warm-pool claim/release under racing
+schedulers, workqueue park/re-dispatch under racing workers, and the
+self-healing write-ahead restore protocol under manager failover.
+
+Each protocol test enumerates thousands of DISTINCT schedules (CHESS
+iterative preemption bounding + sleep-set pruning over the
+INVARIANTS_STRICT yield points) and asserts its invariant holds on every
+one.  The seeded-mutant tests then prove the harness can actually FAIL:
+a textual mutant deleting the write-ahead bookkeeping (selfheal) or
+reordering the claim commit after the intent write (scheduler) must be
+caught by a failing schedule that shrinks to a handful of preemption
+directives — the same mutants ci/analyzers/write_ahead.py flags
+statically.
+
+The suite is control-plane only (no jax import) and honours the CI
+budget knobs INTERLEAVE_MAX_SCHEDULES / INTERLEAVE_BUDGET_S
+(utils/config.py); ci/chaos_soak.sh raises them for deep exploration.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import types
+from collections import Counter
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.scheduler import SliceScheduler, pool_object_name
+from kubeflow_tpu.core.selfheal import RecoveryEngine
+from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+from kubeflow_tpu.kube import (
+    ApiServer,
+    KubeObject,
+    Manager,
+    ObjectMeta,
+    Request,
+)
+from kubeflow_tpu.kube.events import EventRecorder
+from kubeflow_tpu.testing.interleave import InterleavingExplorer, await_cond
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+SPEC = TPUSpec("v5e", "4x4")
+POOL_NAME = pool_object_name("v5e", "4x4")
+
+# acceptance floor: every protocol test must cover at least this many
+# distinct schedules inside the CI budget
+MIN_SCHEDULES = 1000
+
+
+@pytest.fixture(autouse=True)
+def _strict(monkeypatch):
+    """The yield points the explorer schedules on only exist when the
+    sanitizer substrate is armed (invariants.tracked returns the raw lock
+    otherwise).  Scenario factories construct their ApiServer/Manager
+    inside the fixture's scope, so the flag is read when it is set."""
+    monkeypatch.setenv("INVARIANTS_STRICT", "1")
+
+
+def _budget():
+    """CI budget knobs, via the same env parsing production uses."""
+    cfg = CoreConfig.from_env(dict(os.environ))
+    return cfg.interleave_max_schedules, cfg.interleave_budget_s
+
+
+def _explore(scenario, *, max_preemptions=2, min_schedules=MIN_SCHEDULES):
+    max_schedules, budget_s = _budget()
+    ex = InterleavingExplorer(
+        scenario, max_preemptions=max_preemptions,
+        max_schedules=max_schedules, budget_s=budget_s)
+    res = ex.explore()
+    assert res.ok, "invariant violated:\n%s" % res.failure.narrative
+    assert res.schedules >= min_schedules, (
+        "explored only %d distinct schedules (%s after %d runs; floor %d)"
+        % (res.schedules, res.stopped, res.runs, min_schedules))
+    return ex, res
+
+
+# -- protocol A: warm-pool claim/release ---------------------------------------
+def _scheduler_cfg():
+    return CoreConfig.from_env({
+        "ENABLE_SLICE_SCHEDULER": "true",
+        "WARMPOOL_SIZE": "0",
+        "WARMPOOL_PROVISION_S": "120",
+    })
+
+
+def warmpool_scenario():
+    """Two schedulers race to claim from a 2-slice Ready pool for two
+    notebooks.  Every schedule must end with the two claims DISJOINT
+    (chips never double-sold) and both PRESENT (claims never lost across
+    conflict retries), each matching its notebook's placement intent."""
+    api = ApiServer()
+    clock = FakeClock()
+    cfg = _scheduler_cfg()
+    metrics = NotebookMetrics(api)
+    api.create(KubeObject(
+        api_version="kubeflow.org/v1", kind=C.WARMPOOL_KIND,
+        metadata=ObjectMeta(name=POOL_NAME),
+        body={"spec": {"accelerator": "v5e", "topology": "4x4"},
+              "status": {"slices": {
+                  "ws-0001": {"state": "Ready", "pool": "warm-a"},
+                  "ws-0002": {"state": "Ready", "pool": "warm-b"},
+              }}}))
+    names = ("nb-a", "nb-b")
+    for name in names:
+        api.create(Notebook.new(name, "default", tpu=SPEC).obj)
+    scheds = {name: SliceScheduler(api, cfg, metrics, clock=clock)
+              for name in names}
+
+    def reconciler(name):
+        def run():
+            scheds[name].reconcile(Request("default", name))
+        return run
+
+    def check():
+        pool = api.get(C.WARMPOOL_KIND, "", POOL_NAME)
+        slices = (pool.body.get("status") or {}).get("slices") or {}
+        owners: dict[str, list[str]] = {}
+        for sid, e in slices.items():
+            if e.get("claimedBy"):
+                owners.setdefault(e["claimedBy"], []).append(sid)
+        intent_pools = {}
+        for name in names:
+            ann = api.get("Notebook", "default", name) \
+                .metadata.annotations.get(C.ANNOTATION_PLACEMENT)
+            assert ann, f"{name}: placement intent lost"
+            intent_pools[name] = {
+                e["pool"]
+                for e in json.loads(ann)["slices"].values()}
+        # never double-sold: the two intents reference disjoint capacity
+        assert not (intent_pools["nb-a"] & intent_pools["nb-b"]), (
+            "double-sold: %r" % intent_pools)
+        # never lost: both notebooks hold exactly one persisted claim
+        assert sorted(owners) == ["default/nb-a", "default/nb-b"], (
+            "claims lost or leaked: %r" % owners)
+        for name in names:
+            sids = owners[f"default/{name}"]
+            assert len(sids) == 1, (name, sids)
+            assert slices[sids[0]]["pool"] in intent_pools[name], (
+                "claim/intent mismatch for %s: %r vs %r"
+                % (name, slices[sids[0]]["pool"], intent_pools[name]))
+
+    return [(name, reconciler(name)) for name in names], check
+
+
+def test_warmpool_claims_hold_under_all_schedules():
+    _explore(warmpool_scenario)
+
+
+# -- protocol B: workqueue park / re-dispatch ----------------------------------
+def workqueue_scenario():
+    """A producer enqueues keys (including a re-enqueue of a key that may
+    be in flight) while two workers pop/process/done.  Every schedule
+    must keep the per-key serialization contract: no key is ever
+    processed by two workers at once (park, don't double-dispatch) and no
+    dirty key is dropped (re-queue on done)."""
+    api = ApiServer()
+    clock = FakeClock()
+    mgr = Manager(api, clock=clock)
+    mgr.register("wq", lambda req: None, "Notebook")
+    keys = ("k1", "k2")
+    done = [False]
+    inflight: set = set()
+    processed: list[str] = []
+
+    def has_work():
+        return done[0] or any(mgr._queues.values())
+
+    def producer():
+        for name in keys:
+            mgr.enqueue("wq", Request("ns", name))
+        # dirty re-add: if k1 is mid-flight this must PARK and re-queue
+        # on _done, never dispatch a second concurrent reconcile
+        mgr.enqueue("wq", Request("ns", keys[0]))
+        done[0] = True
+
+    def worker():
+        while True:
+            await_cond("work-available", has_work)
+            item = mgr._pop()
+            if item is None:
+                if done[0] and not any(mgr._queues.values()):
+                    return
+                continue
+            assert item not in inflight, (
+                "duplicate in-flight key: %r" % (item,))
+            inflight.add(item)
+            processed.append(item[1].name)
+            inflight.discard(item)
+            mgr._done(item)
+
+    def check():
+        assert not mgr._queued, "dirty keys dropped: %r" % mgr._queued
+        assert not mgr._processing, (
+            "in-flight keys leaked: %r" % mgr._processing)
+        assert not any(mgr._queues.values()), "queued work left behind"
+        counts = Counter(processed)
+        for name in keys:
+            assert counts[name] >= 1, (
+                "key %s never processed: %r" % (name, processed))
+        # the re-enqueue is processed at most once more (dedup while
+        # queued, park+redispatch while in flight)
+        assert counts[keys[0]] <= 2, processed
+
+    return [("producer", producer), ("worker-1", worker),
+            ("worker-2", worker)], check
+
+
+def test_workqueue_park_redispatch_under_all_schedules():
+    _explore(workqueue_scenario)
+
+
+# -- protocol C: write-ahead restore vs manager failover -----------------------
+def _failed_pod(name):
+    return KubeObject(
+        api_version="v1", kind="Pod",
+        metadata=ObjectMeta(name=name, namespace="u1"),
+        body={"spec": {}, "status": {"phase": "Failed"}})
+
+
+def _selfheal_scenario(engine_cls):
+    """Two recovery engines (the manager and its failover twin) race
+    maybe_recover for the same disrupted slice.  The write-ahead protocol
+    must guarantee, on EVERY schedule: no pod restart before the restore
+    intent and the attempt charge are persisted, the restored generation
+    is never a retired one, and no engine restores twice."""
+    api = ApiServer()
+    clock = FakeClock()
+    cfg = CoreConfig()
+    metrics = NotebookMetrics(api)
+    store = InMemorySessionStore(clock=clock)
+    snap = store.put("u1", "heal", 0, b"session", trigger="interval")
+    nb = Notebook.new("heal", "u1", tpu=SPEC)
+    api.create(nb.obj)
+    pods = [_failed_pod("heal-0-0")]
+    restarts: list[str] = []
+    stamped: list[object] = []
+
+    def persisted_session():
+        status = api.get("Notebook", "u1", "heal").body.get("status") or {}
+        return ((status.get("sessionState") or {}).get("0") or {},
+                (status.get("sliceRecovery") or {}).get("0") or {})
+
+    def make_callbacks(mgr_name):
+        def restart_slice(live_name):
+            sess, rec = persisted_session()
+            # the write-ahead core: by the time any pod dies, failover
+            # can resume the migration from status alone
+            assert sess.get("phase") == "migrating", (
+                "%s: restart before the restore intent was persisted "
+                "(sessionState=%r)" % (mgr_name, sess))
+            assert sess.get("restoreGeneration") == snap.generation, (
+                "%s: restoring retired generation %r (live is %d)"
+                % (mgr_name, sess.get("restoreGeneration"),
+                   snap.generation))
+            assert rec.get("attempts"), (
+                "%s: restart before the attempt charge was persisted"
+                % mgr_name)
+            restarts.append(mgr_name)
+
+        def stamp_restore(live_name, idx):
+            sess, _rec = persisted_session()
+            stamped.append(sess.get("restoreGeneration"))
+
+        return restart_slice, stamp_restore
+
+    engines = {}
+    for mgr_name in ("mgr-a", "mgr-b"):
+        engines[mgr_name] = engine_cls(
+            api, cfg, metrics, EventRecorder(api, mgr_name),
+            clock=clock, session=store)
+
+    def recover(mgr_name):
+        restart_slice, stamp_restore = make_callbacks(mgr_name)
+
+        def run():
+            engines[mgr_name].maybe_recover(
+                Notebook(api.get("Notebook", "u1", "heal")),
+                ["heal-0"], lambda live_name: pods,
+                restart_slice, stamp_restore=stamp_restore)
+        return run
+
+    def check():
+        sess, rec = persisted_session()
+        assert sess.get("phase") == "migrating", sess
+        assert sess.get("restoreGeneration") == snap.generation, sess
+        assert rec.get("attempts"), rec
+        assert 1 <= len(restarts) <= 2, restarts
+        # never restore twice: each engine executes at most one restart,
+        # and every stamped restore targets the one live generation
+        assert all(n == 1 for n in Counter(restarts).values()), restarts
+        assert stamped and all(g == snap.generation for g in stamped), (
+            stamped)
+
+    return [("mgr-a", recover("mgr-a")), ("mgr-b", recover("mgr-b"))], check
+
+
+def migrate_scenario():
+    return _selfheal_scenario(RecoveryEngine)
+
+
+def test_write_ahead_restore_under_all_schedules():
+    _explore(migrate_scenario)
+
+
+# -- byte-exact replay ---------------------------------------------------------
+def test_replay_is_byte_identical():
+    ex = InterleavingExplorer(warmpool_scenario)
+    base = ex.replay(())          # the default run-until-blocked schedule
+    again = ex.replay(base.choices)
+    assert not base.failed and not again.failed
+    assert again.choices == base.choices
+    assert ex.render(again.trace) == ex.render(base.trace)
+    # a schedule that DIVERGES from the default at the first branchy step
+    # must also replay byte-identically
+    for i, (enabled, _ops, chosen) in enumerate(base.nodes):
+        alts = [t for t in enabled if t != chosen]
+        if alts:
+            forked = tuple(base.choices[:i]) + (alts[0],)
+            break
+    else:
+        pytest.skip("scenario never had two enabled threads")
+    r1 = ex.replay(forked)
+    r2 = ex.replay(r1.choices)
+    assert r1.choices == r2.choices
+    assert ex.render(r1.trace) == ex.render(r2.trace)
+    assert ex.render(r1.trace) != ex.render(base.trace)
+
+
+# -- seeded mutants: the harness must be falsifiable ---------------------------
+def _load_mutant(module: str, mutations, name: str):
+    """Compile a textually mutated copy of `module` under a fresh module
+    name (same package, so relative imports resolve)."""
+    src_path = importlib.import_module(module).__file__
+    with open(src_path, encoding="utf-8") as fh:
+        src = fh.read()
+    for old, new in mutations:
+        assert src.count(old) == 1, (
+            "mutation anchor not unique in %s: %r" % (module, old))
+        src = src.replace(old, new)
+    mod = types.ModuleType(name)
+    mod.__package__ = module.rsplit(".", 1)[0]
+    mod.__file__ = src_path
+    sys.modules[name] = mod
+    try:
+        exec(compile(src, src_path, "exec"), mod.__dict__)
+    finally:
+        sys.modules.pop(name, None)
+    return mod
+
+
+# Mutant A: delete the write-ahead bookkeeping in maybe_recover — the
+# budget charge and restore intent no longer persist before pod deletes.
+MUTANT_A = [(
+    """            self._write_bookkeeping(nb, recovery, exhausted, session_state,
+                                    skip_if_unchanged=(prev_recovery,
+                                                       prev_session))""",
+    "            pass  # MUTANT A: write-ahead bookkeeping dropped",
+)]
+
+# Mutant B: reorder the claim commit after the intent write in _place —
+# the pool status claim is no longer persisted ahead of the annotation.
+MUTANT_B = [
+    (
+        """            if st != before:
+                live.status = st
+                self.api.update_status(live)
+            out.update(waiting=waiting, assignments=assignments,
+                       slices=copy.deepcopy(slices), claims=claims)""",
+        """            out.update(waiting=waiting, assignments=assignments,
+                       slices=copy.deepcopy(slices), claims=claims,
+                       _commit=(live, st, before))""",
+    ),
+    (
+        """        retry_on_conflict(write_intent)
+        if wrote[0]:""",
+        """        retry_on_conflict(write_intent)
+
+        def late_commit() -> None:
+            live, st, before = out["_commit"]
+            if st != before:
+                live.status = st
+                self.api.update_status(live)
+
+        retry_on_conflict(late_commit)
+        if wrote[0]:""",
+    ),
+]
+
+
+def _explore_mutant(scenario):
+    ex = InterleavingExplorer(scenario, max_preemptions=2,
+                              max_schedules=600, budget_s=120.0)
+    res = ex.explore()
+    assert res.failure is not None, (
+        "mutant survived %d schedules — the harness cannot falsify"
+        % res.schedules)
+    fail = res.failure
+    # acceptance: the shrunk repro needs at most 4 preemptions
+    assert fail.preemptions <= 4, fail.narrative
+    assert len(fail.directives) <= 4, fail.narrative
+    # regression artifact: the shrunk schedule replays byte-identically
+    r1 = ex.replay(fail.choices)
+    r2 = ex.replay(fail.choices)
+    assert r1.failed and r2.failed
+    assert ex.render(r1.trace) == ex.render(r2.trace)
+    return fail
+
+
+def test_mutant_dropped_write_ahead_is_caught():
+    mod = _load_mutant("kubeflow_tpu.core.selfheal", MUTANT_A,
+                       "kubeflow_tpu.core._selfheal_mutant_a")
+
+    fail = _explore_mutant(lambda: _selfheal_scenario(mod.RecoveryEngine))
+    # pinned shrunk schedule: the very first (sequential, zero-preemption)
+    # schedule already restarts pods with nothing persisted
+    assert fail.preemptions == 0, fail.narrative
+    assert fail.directives == {}, fail.narrative
+    assert "restore intent was persisted" in fail.message \
+        or "attempt charge" in fail.message, fail.message
+
+
+def test_mutant_reordered_claim_commit_is_caught():
+    mod = _load_mutant("kubeflow_tpu.core.scheduler", MUTANT_B,
+                       "kubeflow_tpu.core._scheduler_mutant_b")
+
+    # the warmpool scenario, but with the mutated scheduler class
+    def mutant_scenario():
+        api = ApiServer()
+        clock = FakeClock()
+        cfg = _scheduler_cfg()
+        metrics = NotebookMetrics(api)
+        api.create(KubeObject(
+            api_version="kubeflow.org/v1", kind=C.WARMPOOL_KIND,
+            metadata=ObjectMeta(name=POOL_NAME),
+            body={"spec": {"accelerator": "v5e", "topology": "4x4"},
+                  "status": {"slices": {
+                      "ws-0001": {"state": "Ready", "pool": "warm-a"},
+                      "ws-0002": {"state": "Ready", "pool": "warm-b"},
+                  }}}))
+        names = ("nb-a", "nb-b")
+        for name in names:
+            api.create(Notebook.new(name, "default", tpu=SPEC).obj)
+        scheds = {name: mod.SliceScheduler(api, cfg, metrics, clock=clock)
+                  for name in names}
+
+        def run(name):
+            def go():
+                scheds[name].reconcile(Request("default", name))
+            return go
+
+        def check():
+            pool = api.get(C.WARMPOOL_KIND, "", POOL_NAME)
+            slices = (pool.body.get("status") or {}).get("slices") or {}
+            intent_pools = {}
+            for name in names:
+                ann = api.get("Notebook", "default", name) \
+                    .metadata.annotations.get(C.ANNOTATION_PLACEMENT)
+                assert ann, f"{name}: placement intent lost"
+                intent_pools[name] = {
+                    e["pool"]
+                    for e in json.loads(ann)["slices"].values()}
+            assert not (intent_pools["nb-a"] & intent_pools["nb-b"]), (
+                "double-sold: %r" % intent_pools)
+
+        return [(name, run(name)) for name in names], check
+
+    fail = _explore_mutant(mutant_scenario)
+    # pinned shrunk schedule: one scheduler's claim read slips between
+    # the other's in-memory claim and its (now too-late) commit
+    assert 1 <= fail.preemptions <= 4, fail.narrative
+    assert fail.directives, fail.narrative
+    assert "double-sold" in fail.message or "Conflict" in fail.message, (
+        fail.message)
