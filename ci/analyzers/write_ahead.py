@@ -58,6 +58,12 @@ FLOWS: tuple[Flow, ...] = (
     Flow("kubeflow_tpu/core/scheduler.py", "SliceScheduler._release",
          destructive=("self.api.update",),
          persist=("self.api.update_status",)),
+    # sharding: the membership commit (epoch bump + handoff record) lands
+    # on the shard map before the replica drains or adopts any key —
+    # adopting from local intent would reconcile keys nobody committed
+    Flow("kubeflow_tpu/kube/shard.py", "ShardedReplica.join_fleet",
+         destructive=("self._drain_and_adopt",),
+         persist=("self.member.join",)),
 )
 
 

@@ -16,7 +16,13 @@
 # resolves on recovery with a flight-recorder-resolvable trace id, ZERO
 # alerts firing at soak end, /debug/fleet counts matching apiserver
 # ground truth, profiler overhead < 5%, and an ops.diagnose bundle that
-# reconstructs the slowest attempt offline).  All driven on the
+# reconstructs the slowest attempt offline), plus the active-active
+# kill/rejoin soak (TestShardKillRejoinSoak: a 3-replica sharded fleet
+# under seeded kill / zombie-write / rejoin / churn rounds — zero
+# cross-process double-reconciles over the MERGED flight-recorder
+# histories, every zombie write fenced and counted, epoch strictly
+# monotonic, and per-replica diagnose bundles merged offline agreeing
+# with the in-process sweep).  All driven on the
 # FakeClock so wall time stays in seconds regardless of how much backoff
 # the injected faults provoke.
 #
@@ -29,6 +35,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
+SHARD_ROUNDS="${SHARD_SOAK_ROUNDS:-10}"
 HEAL_ROUNDS="${SELFHEAL_SOAK_ROUNDS:-16}"
 MIGRATE_ROUNDS="${MIGRATE_SOAK_ROUNDS:-12}"
 SEED="${CHAOS_SOAK_SEED:-20260804}"
@@ -47,21 +54,24 @@ if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} shard_rounds=${SHARD_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
     SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" MIGRATE_SOAK_ROUNDS="$MIGRATE_ROUNDS" \
+    SHARD_SOAK_ROUNDS="$SHARD_ROUNDS" \
     WORKQUEUE_WORKERS="$WORKERS" INVARIANTS_STRICT="$STRICT" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
       tests/test_chaos.py::TestSliceRecoverySoak \
       tests/test_chaos.py::TestMigrationRecoverySoak \
-      tests/test_chaos.py::TestFleetSLOSoak -q "$@"; then
+      tests/test_chaos.py::TestFleetSLOSoak \
+      tests/test_chaos.py::TestShardKillRejoinSoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
   echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
   echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} MIGRATE_SOAK_ROUNDS=${MIGRATE_ROUNDS} \\" >&2
+  echo "    SHARD_SOAK_ROUNDS=${SHARD_ROUNDS} \\" >&2
   echo "    WORKQUEUE_WORKERS=${WORKERS} ci/chaos_soak.sh" >&2
   exit 1
 fi
-echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, workers=${WORKERS})"
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, shard_rounds=${SHARD_ROUNDS}, workers=${WORKERS})"
 
 # INTERLEAVE_DEEP=1: re-run the schedule-exploring protocol tests
 # (tests/test_interleave.py) with a much larger enumeration budget than

@@ -109,6 +109,16 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   echo "== loadtest bursty warm-pool smoke =="
   python loadtest/convergence.py --bursty 24 --bursts 3 --warm-size 8 \
     --tpu v5e:4x4 --check-warm-budget ci/warmpool_budget.json
+  # active-active gate: 600 notebooks over a 3-replica sharded fleet
+  # with a kill+rejoin cycle mid-run — converge under the committed
+  # wall-clock + p99 event->reconcile-start ceilings with the ring
+  # balanced (ci/fleet_budget.json "sharded"), zero cross-process
+  # overlapping reconciles over the merged flight-recorder histories,
+  # and a zero-data-plane-write steady state
+  echo "== loadtest sharded fleet convergence (3 shards) =="
+  python loadtest/convergence.py --count 600 --shards 3 \
+    --check-budget ci/fleet_budget.json \
+    --out "${SHARD_RESULT_OUT:-/tmp/shard_fleet_result.json}"
   # fleet-scale convergence gate: 10k notebooks must converge at the same
   # reconciles/notebook as the 200-notebook smoke (within tolerance),
   # reach a zero-write steady state, and stay under the committed

@@ -73,8 +73,14 @@ def _rv_int(obj: KubeObject) -> int:
 class InformerCache:
     """Watch-fed object cache with registrable indexers (see module doc)."""
 
-    def __init__(self, api, registry=None) -> None:
+    def __init__(self, api, registry=None, key_filter=None) -> None:
         self.api = api
+        # sharded control plane (kube/shard.py): `key_filter(kind, ns,
+        # name)` scopes what this cache stores — a replica's cache holds
+        # only the keys its shard owns, so cache memory scales per-shard.
+        # Events for keys that moved away EVICT the stale copy; resync()
+        # realigns the map after ownership changes.
+        self._key_filter = key_filter
         self._lock = invariants.tracked(
             threading.Lock(), "InformerCache._lock")
         # kind -> (namespace, name) -> KubeObject
@@ -143,6 +149,14 @@ class InformerCache:
                     del store[key]
                     self._deindex(kind, key, old)
             else:
+                if self._key_filter is not None and \
+                        not self._key_filter(kind, key[0], key[1]):
+                    # not this shard's key: never store it, and evict any
+                    # copy left from before ownership moved away
+                    if old is not None:
+                        del store[key]
+                        self._deindex(kind, key, old)
+                    return
                 if old is not None and _rv_int(old) > rv:
                     return  # stale replay (resume overlap); keep the newer
                 self._reindex(kind, key, old, ev.obj)
@@ -350,6 +364,14 @@ class InformerCache:
             return sorted((store[k] for k in hits if k in store),
                           key=lambda o: (o.namespace, o.name))
 
+    def resync(self, kind: str) -> None:
+        """Realign the kind map with the live store under the CURRENT key
+        filter — shard adoption (kube/shard.py) calls this after gaining
+        keys, so objects whose events this cache skipped while another
+        shard owned them appear, and keys that moved away drop."""
+        self._ensure_primed(kind)
+        self._sync_kind(kind, prune=True)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -456,14 +478,21 @@ class InformerCache:
                 self._tombstones.pop(kind, None)
             raise
         fresh = {(o.namespace, o.name): o for o in objs}
+        if self._key_filter is not None:
+            fresh = {k: o for k, o in fresh.items()
+                     if self._key_filter(kind, k[0], k[1])}
         with self._lock:
             tombstones = self._tombstones.pop(kind, set())
             store = self._objects.setdefault(kind, {})
             if prune:
                 for key in [k for k in store if k not in fresh]:
                     cur = store[key]
-                    if snapshot_rv and _rv_int(cur) > snapshot_rv:
+                    owned = self._key_filter is None or \
+                        self._key_filter(kind, key[0], key[1])
+                    if owned and snapshot_rv and _rv_int(cur) > snapshot_rv:
                         continue  # created after the snapshot; event is live
+                    # a key that moved to another shard drops regardless of
+                    # its resourceVersion: not owned is not stored
                     del store[key]
                     self._deindex(kind, key, cur)
             for key, obj in fresh.items():

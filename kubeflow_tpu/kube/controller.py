@@ -336,9 +336,14 @@ class Manager:
                  rate_limiter=None, registry: Optional[Registry] = None,
                  flight_recorder: Optional[FlightRecorder] = None,
                  workers: Optional[int] = None,
-                 cache: Optional[InformerCache] = None) -> None:
+                 cache: Optional[InformerCache] = None,
+                 key_filter=None) -> None:
         self.api = api
         self.clock = clock or Clock()
+        # sharded control plane (kube/shard.py): admit only requests this
+        # replica owns.  Checked at enqueue AND re-checked at dispatch, so
+        # a key that moved away while queued is dropped, not reconciled.
+        self._key_filter = key_filter
         if workers is None:
             try:
                 workers = int(os.environ.get("WORKQUEUE_WORKERS", "") or 1)
@@ -539,6 +544,9 @@ class Manager:
     def _enqueue(self, reg_name: str, req: Request,
                  enqueued_at: Optional[float] = None,
                  cause: Optional[tuple[float, float]] = None) -> None:
+        if self._key_filter is not None and \
+                not self._key_filter(req.namespace, req.name):
+            return  # not ours: rejected before the queue, not a mutation
         invariants.yield_point("queue.add", (reg_name, req.namespace,
                                              req.name))
         with self._lock:
@@ -688,6 +696,12 @@ class Manager:
                    None)
         if reg is None:
             return  # unregistered while queued: drop the item
+        if self._key_filter is not None and \
+                not self._key_filter(req.namespace, req.name):
+            # ownership moved while the key sat queued (shard handoff):
+            # the new owner adopts it; dispatching here would be a
+            # double-reconcile in the new epoch
+            return
 
         def alive() -> bool:
             # unregister() may run DURING the reconcile; its queue/retry
@@ -956,6 +970,13 @@ class Manager:
     def pending_delayed(self) -> list[tuple[str, Request, float]]:
         with self._lock:
             return [(d.reg_name, d.request, d.due) for d in self._delayed]
+
+    def inflight_requests(self) -> list[tuple[str, Request]]:
+        """The (controller, request) keys currently being reconciled —
+        the shard drain gate (kube/shard.py) acks a handoff only once
+        none of these belongs to a departed key."""
+        with self._lock:
+            return list(self._processing)
 
     def queue_stats(self) -> dict:
         """Workqueue observability snapshot (scraped into Prometheus gauges

@@ -7,6 +7,18 @@ per manager, acquired/renewed with optimistic concurrency; a candidate takes
 over only when the holder's renewTime is older than the lease duration.
 Works identically against the in-memory ApiServer and a real cluster via
 KubeClient (Lease is just another object to both).
+
+Leadership alone is not enough to keep a paused-then-resumed replica from
+racing its successor: the old holder's threads may wake AFTER a rival
+legally took over and issue writes under authority they no longer have.
+The elector therefore carries a **fencing token** — the lease's
+`leaseTransitions` count doubles as the fencing epoch, stamped onto every
+lease write (`spec.fencingEpoch`) and latched into `self.token` on each
+successful acquire/renew.  Any failure path (a lost round, `release()`)
+invalidates the token BEFORE any subsequent write could race the new
+leader, and `verify()` re-checks the lease so a deposed holder's late
+write raises `StaleEpochError` instead of landing (see `kube/shard.py`
+`FencedApi`, which proxies write verbs through `verify()`).
 """
 
 from __future__ import annotations
@@ -17,7 +29,7 @@ from datetime import datetime, timezone
 from typing import Callable, Optional
 
 from ..utils.clock import Clock, parse_iso
-from .errors import ApiError, ConflictError, NotFoundError
+from .errors import ApiError, ConflictError, ForbiddenError, NotFoundError
 from .meta import KubeObject, ObjectMeta
 
 logger = logging.getLogger("kubeflow_tpu.kube.leader")
@@ -29,6 +41,35 @@ LEASE_API_VERSION = "coordination.k8s.io/v1"
 def _iso(t: float) -> str:
     return datetime.fromtimestamp(t, tz=timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+class StaleEpochError(ForbiddenError):
+    """A write carried a fencing epoch that is no longer the authority's
+    current one (deposed leader, evicted shard member, zombie process).
+    Forbidden-family, not Conflict: retrying cannot help — the caller
+    lost its authority and must stop writing."""
+
+
+class FencingToken:
+    """The local half of a fencing-token lease: the epoch the holder last
+    proved authority at, plus a validity latch.  The latch is flipped off
+    BEFORE any code path that could let a rival take over observes the
+    loss — so a holder that merely *suspects* it lost (failed renew,
+    release) stops writing immediately, and a holder that provably lost
+    gets `StaleEpochError` from `verify()`."""
+
+    __slots__ = ("epoch", "valid")
+
+    def __init__(self) -> None:
+        self.epoch = -1
+        self.valid = False
+
+    def renew(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+        self.valid = True
+
+    def invalidate(self) -> None:
+        self.valid = False
 
 
 class LeaderElector:
@@ -68,6 +109,10 @@ class LeaderElector:
         self.renew_deadline_s = renew_deadline_s
         self.clock = clock or Clock()
         self.is_leader = False
+        #: fencing token: epoch = the lease's leaseTransitions at the last
+        #: successful acquire/renew; invalidated before any write can race
+        #: a successor (see verify())
+        self.token = FencingToken()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -89,10 +134,11 @@ class LeaderElector:
                         "acquireTime": _iso(now),
                         "renewTime": _iso(now),
                         "leaseTransitions": 0,
+                        "fencingEpoch": 0,
                     }},
                 )
                 self.api.create(lease)
-                return self._became(True)
+                return self._became(True, epoch=0)
             spec = lease.body.get("spec", {})
             holder = spec.get("holderIdentity", "")
             renew = parse_iso(spec["renewTime"]) if spec.get("renewTime") else 0.0
@@ -101,34 +147,49 @@ class LeaderElector:
             if holder == self.identity:
                 spec["renewTime"] = _iso(now)
             elif renew + duration < now:
-                # stale holder: take over (transition count is observability,
-                # client-go bumps it the same way)
+                # stale holder: take over (the transition count doubles as
+                # the fencing epoch — client-go bumps it the same way, the
+                # bump is what deposes the old holder's token)
                 spec["holderIdentity"] = self.identity
                 spec["acquireTime"] = _iso(now)
                 spec["renewTime"] = _iso(now)
                 spec["leaseTransitions"] = int(spec.get("leaseTransitions", 0)) + 1
             else:
                 return self._became(False)
+            epoch = int(spec.get("leaseTransitions", 0))
+            spec["fencingEpoch"] = epoch
             lease.body["spec"] = spec
             self.api.update(lease)
-            return self._became(True)
+            return self._became(True, epoch=epoch)
         except (ConflictError, NotFoundError):
             return self._became(False)  # raced another candidate; retry later
         except ApiError as err:
             logger.warning("leader election round failed: %s", err)
             return self._became(False)
 
-    def _became(self, leader: bool) -> bool:
+    def _became(self, leader: bool, epoch: Optional[int] = None) -> bool:
+        if not leader:
+            # invalidate FIRST: from this instant no write under this
+            # elector's authority may land, even if a worker thread is
+            # already past its own is_leader check
+            self.token.invalidate()
         if leader != self.is_leader:
             logger.info("leader election: %s is now %s", self.identity,
                         "leader" if leader else "follower")
         self.is_leader = leader
+        if leader and epoch is not None:
+            self.token.renew(epoch)
         return leader
 
     def release(self) -> None:
-        """Graceful handoff on shutdown (client-go ReleaseOnCancel)."""
+        """Graceful handoff on shutdown (client-go ReleaseOnCancel).
+        Leadership and the fencing token drop BEFORE the lease write: a
+        successor may legally acquire the instant our update lands, so
+        any of our writes racing past this point must already be fenced."""
         if not self.is_leader:
             return
+        self.is_leader = False
+        self.token.invalidate()
         try:
             lease = self.api.try_get(LEASE_KIND, self.namespace, self.lease_name)
             if lease and lease.body.get("spec", {}).get(
@@ -138,7 +199,34 @@ class LeaderElector:
                 self.api.update(lease)
         except ApiError:
             pass
-        self.is_leader = False
+
+    def verify(self) -> int:
+        """Fencing check for writes issued under this elector's authority
+        (kube/shard.py FencedApi calls this before every proxied write):
+        returns the fencing epoch, or raises StaleEpochError unless the
+        token is valid AND the lease still names this identity at the
+        token's epoch.  A verify failure invalidates the token, so every
+        later write fails fast without re-reading the lease."""
+        tok = self.token
+        if not tok.valid:
+            raise StaleEpochError(
+                f"{self.identity}: fencing token invalidated (leadership "
+                "lost or released)")
+        try:
+            lease = self.api.try_get(LEASE_KIND, self.namespace,
+                                     self.lease_name)
+        except ApiError:
+            lease = None
+        spec = (lease.body.get("spec") or {}) if lease is not None else {}
+        if spec.get("holderIdentity") != self.identity or \
+                int(spec.get("leaseTransitions", 0) or 0) != tok.epoch:
+            tok.invalidate()
+            raise StaleEpochError(
+                f"{self.identity}: lease epoch moved on (held epoch "
+                f"{tok.epoch}, holder now "
+                f"{spec.get('holderIdentity', '<gone>')!r} at epoch "
+                f"{int(spec.get('leaseTransitions', 0) or 0)})")
+        return tok.epoch
 
     # -- blocking run loop ----------------------------------------------------
     def run(
@@ -193,4 +281,5 @@ class LeaderElector:
             self._thread = None
 
 
-__all__ = ["LeaderElector", "LEASE_KIND", "LEASE_API_VERSION"]
+__all__ = ["FencingToken", "LeaderElector", "StaleEpochError",
+           "LEASE_KIND", "LEASE_API_VERSION"]

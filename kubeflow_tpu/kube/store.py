@@ -40,6 +40,7 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import logging
 import os
 import threading
 from contextlib import ExitStack, contextmanager
@@ -567,8 +568,30 @@ class ApiServer:
                 d[(kind, "skipped")] = \
                     d.get((kind, "skipped"), 0) + \
                     (len(self._watch_entries) - delivered)
+        errors = 0
         for e in interested:
-            e.fn(ev)
+            try:
+                e.fn(ev)
+            except Exception:
+                # watcher isolation: the committing writer and the watcher
+                # are different actors — in the sharded control plane
+                # (kube/shard.py) a peer replica's map-event callback runs
+                # on OUR commit path, and coupling our write to its bug
+                # would turn one bad watcher into a fleet-wide outage.
+                # Strict mode re-raises: tests and the model checker want
+                # escaped-mutation traps and invariant failures loud.
+                if self._strict:
+                    raise
+                errors += 1
+                logging.getLogger("kubeflow_tpu.store").exception(
+                    "watch callback failed for %s %s/%s",
+                    kind, ev.obj.namespace, ev.obj.name)
+        if errors:
+            with shard.lock:
+                with self._watch_lock:
+                    d = self._dispatch_counts
+                    d[(kind, "callback_errors")] = \
+                        d.get((kind, "callback_errors"), 0) + errors
 
     def _next_rv(self) -> int:
         with self._rv_lock:

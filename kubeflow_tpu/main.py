@@ -348,6 +348,40 @@ def build_manager(
     return mgr, api, cluster, metrics
 
 
+def build_sharded_fleet(
+    core_cfg: Optional[CoreConfig] = None,
+    count: Optional[int] = None,
+    with_fake_cluster: bool = True,
+    clock=None,
+):
+    """Active-active standalone control plane (SHARD_COUNT > 1): `count`
+    ShardedReplicas over one in-memory ApiServer, each running the full
+    core controller set against its fenced client (kube/shard.py), so a
+    deposed shard's late writes are rejected with a stale epoch instead
+    of racing the new owner.  Returns (fleet, api, cluster, metrics);
+    per-shard health lands in /debug/fleet via metrics.attach_shard()."""
+    core_cfg = core_cfg or CoreConfig.from_env()
+    count = count or core_cfg.shard_count
+    api = ApiServer(history_size=core_cfg.watch_history_size)
+    cluster = FakeCluster(api) if with_fake_cluster else None
+    metrics = NotebookMetrics(api)
+
+    def controllers(replica):
+        # replica.manager.api is the FencedApi: every controller write is
+        # epoch-checked against the committed shard map before it lands
+        setup_core_controllers(replica.manager, core_cfg, metrics,
+                               provisioner=cluster)
+        setup_culling(replica.manager, core_cfg, metrics=metrics)
+
+    from .kube import ShardedFleet
+
+    fleet = ShardedFleet(
+        api, count=count, clock=clock, controller_factory=controllers,
+        lease_duration_s=core_cfg.shard_lease_duration_s)
+    metrics.attach_shard(fleet)
+    return fleet, api, cluster, metrics
+
+
 def build_real_backend(args):
     """KubeClient from --kubeconfig/--in-cluster with qps/burst knobs
     (notebook-controller/main.go:71-89)."""

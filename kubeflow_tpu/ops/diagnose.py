@@ -22,6 +22,12 @@ Two collection modes:
 Config capture is REDACTED: only recognized configuration variables are
 included, and any name that smells like a credential has its value
 masked — the bundle is made to be shared.
+
+A third, offline mode — `--merge a.json b.json c.json` — takes one
+bundle per manager replica of a sharded fleet and sweeps the COMBINED
+attempt histories for same-key reconciles with overlapping real-time
+windows: the cross-process double-reconcile audit that no single
+replica's recorder can run alone.
 """
 
 from __future__ import annotations
@@ -169,6 +175,53 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
     }
 
 
+def merge_records(bundles) -> list:
+    """Every recorded attempt across several managers' bundles, deduped
+    by span id (an attempt retained in both the ring and a slowest/
+    errored set must count once).  The input of the offline
+    cross-process double-reconcile sweep."""
+    from ..utils.flightrecorder import record_from_dict
+
+    records, seen = [], set()
+    for bundle in bundles:
+        reconciles = bundle.get("reconciles") or {}
+        for section in ("attempts", "slowest", "errored"):
+            for d in reconciles.get(section) or ():
+                key = d.get("span_id") or (
+                    d.get("trace_id"), d.get("object"), d.get("attempt"),
+                    d.get("mono_start"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                records.append(record_from_dict(d))
+    return records
+
+
+def merge_overlaps(bundles) -> list:
+    """Cross-process serialization audit: pairs of attempts for the same
+    (controller, object) whose real-time windows overlap, swept over the
+    MERGED attempt histories of several managers' bundles.  In a sharded
+    fleet each replica records only its own attempts; an overlap that
+    only exists across bundles is exactly a cross-process
+    double-reconcile — the thing the shard map's fencing must prevent."""
+    from ..utils.flightrecorder import sweep_overlaps
+
+    return sweep_overlaps(merge_records(bundles))
+
+
+def summarize_merge(bundles, records, overlaps) -> str:
+    lines = [
+        f"merged {len(bundles)} bundles: {len(records)} distinct attempts, "
+        f"{len(overlaps)} overlapping pairs"
+    ]
+    for prev, cur in overlaps:
+        lines.append(
+            f"  OVERLAP {cur.controller} {cur.object_key}: "
+            f"[{prev.mono_start:.6f}, {prev.mono_end:.6f}] vs "
+            f"[{cur.mono_start:.6f}, {cur.mono_end:.6f}]")
+    return "\n".join(lines)
+
+
 def summarize(bundle: dict) -> str:
     """One human line per bundle — printed by the CLI so the operator
     sees what they captured."""
@@ -200,7 +253,27 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--out", default="bundle.json",
                         help="bundle output path (default %(default)s)")
     parser.add_argument("--timeout", type=float, default=10.0)
+    parser.add_argument("--merge", nargs="+", metavar="BUNDLE",
+                        help="offline mode: merge several managers' "
+                             "bundles and sweep the combined attempt "
+                             "histories for cross-process overlapping "
+                             "reconciles (exit 1 when any pair overlaps)")
     args = parser.parse_args(argv)
+
+    if args.merge:
+        bundles = []
+        for path in args.merge:
+            try:
+                with open(path) as f:
+                    bundles.append(json.load(f))
+            except (OSError, ValueError) as err:
+                print(f"diagnose: cannot load {path}: {err}",
+                      file=sys.stderr)
+                return 1
+        records = merge_records(bundles)
+        overlaps = merge_overlaps(bundles)
+        print(summarize_merge(bundles, records, overlaps))
+        return 1 if overlaps else 0
 
     try:
         bundle = collect_http(args.addr, timeout=args.timeout)

@@ -182,6 +182,16 @@ class CoreConfig:
     telemetry_publish_interval_s: float = 30.0  # TELEMETRY_PUBLISH_INTERVAL_S
     slo_fleet_mfu: float = 0.0                  # SLO_FLEET_MFU
     slo_straggler_rate: float = 0.0             # SLO_STRAGGLER_RATE
+    # active-active sharded control plane (kube/shard.py): SHARD_COUNT > 1
+    # runs that many in-process manager replicas over a fenced
+    # ControlPlaneShardMap; shard_lease_duration_s is each member's lease
+    # (a dead replica is evicted once its lease ages past it).
+    # slo_shard_handoff_p99_s bounds the handoff duration (commit ->
+    # last ack) — a stalled handoff burns that objective's budget and
+    # fires the multi-window burn alert; <= 0 disables it.
+    shard_count: int = 1                        # SHARD_COUNT
+    shard_lease_duration_s: float = 15.0        # SHARD_LEASE_DURATION_S
+    slo_shard_handoff_p99_s: float = 0.0        # SLO_SHARD_HANDOFF_P99_S
     # schedule-exploring model checker (testing/interleave.py): per-test
     # exploration budget — distinct-schedule cap and wall cap, whichever
     # bites first.  The CI smoke lane runs the defaults; the chaos-soak
@@ -263,6 +273,11 @@ class CoreConfig:
                 env, "TELEMETRY_PUBLISH_INTERVAL_S", 30.0),
             slo_fleet_mfu=_float(env, "SLO_FLEET_MFU", 0.0),
             slo_straggler_rate=_float(env, "SLO_STRAGGLER_RATE", 0.0),
+            shard_count=max(1, _int(env, "SHARD_COUNT", 1)),
+            shard_lease_duration_s=_float(
+                env, "SHARD_LEASE_DURATION_S", 15.0),
+            slo_shard_handoff_p99_s=_float(
+                env, "SLO_SHARD_HANDOFF_P99_S", 0.0),
             interleave_max_schedules=max(1, _int(
                 env, "INTERLEAVE_MAX_SCHEDULES", 1200)),
             interleave_budget_s=_float(env, "INTERLEAVE_BUDGET_S", 60.0),

@@ -116,7 +116,61 @@ class AttemptRecord:
             "span_id": self.span_id,
             "error": self.error,
             "faults": [dict(f) for f in self.faults],
+            # the real-time execution window rides into diagnostics
+            # bundles so the cross-process overlap sweep (ops/diagnose
+            # --merge over several managers' bundles) can run offline
+            "mono_start": self.mono_start,
+            "mono_end": self.mono_end,
         }
+
+
+def record_from_dict(d: dict) -> AttemptRecord:
+    """Rebuild an AttemptRecord from its to_dict() form — the read half
+    of the diagnostics-bundle round trip (ops/diagnose --merge)."""
+    return AttemptRecord(
+        object_key=str(d.get("object", "")),
+        controller=str(d.get("controller", "")),
+        attempt=int(d.get("attempt", 0)),
+        result=str(d.get("result", "unknown")),
+        start_time=float(d.get("start_time", 0.0)),
+        end_time=float(d.get("end_time", 0.0)),
+        duration_s=float(d.get("duration_s", 0.0)),
+        phases=dict(d.get("phases") or {}),
+        trace_id=str(d.get("trace_id", "")),
+        span_id=str(d.get("span_id", "")),
+        error=str(d.get("error", "")),
+        faults=[dict(f) for f in d.get("faults") or ()],
+        mono_start=float(d.get("mono_start", 0.0) or 0.0),
+        mono_end=float(d.get("mono_end", 0.0) or 0.0),
+    )
+
+
+def sweep_overlaps(records) -> list[tuple[AttemptRecord, AttemptRecord]]:
+    """Pairs of attempts for the SAME (controller, object) whose real-time
+    execution windows overlap — each pair is a serialization violation.
+    Takes ANY iterable of AttemptRecords (one recorder's history, or
+    several managers' histories merged), so the same sweep audits a
+    single process and a sharded fleet: two replicas reconciling one key
+    in the same wall-clock window is exactly a cross-process
+    double-reconcile.  Attempts without monotonic stamps are skipped.
+
+    Sort-by-start sweep with an active min-heap on window end:
+    O(n log n + v) per key; touching endpoints are clean."""
+    per_key: dict[tuple[str, str], list[AttemptRecord]] = {}
+    for r in records:
+        if r.mono_end > r.mono_start > 0.0:
+            per_key.setdefault((r.object_key, r.controller), []).append(r)
+    violations: list[tuple[AttemptRecord, AttemptRecord]] = []
+    for runs in per_key.values():
+        runs.sort(key=lambda r: r.mono_start)
+        active: list[tuple[float, int, AttemptRecord]] = []
+        for i, cur in enumerate(runs):
+            while active and active[0][0] <= cur.mono_start:
+                heapq.heappop(active)
+            for _, _, prev in active:
+                violations.append((prev, cur))
+            heapq.heappush(active, (cur.mono_end, i, cur))
+    return violations
 
 
 class FlightRecorder:
@@ -251,32 +305,16 @@ class FlightRecorder:
         without stamps (records from before the Manager stamped them) are
         skipped.
 
-        Sort-by-start sweep with an active min-heap on window end:
-        O(n log n + v) per key instead of the quadratic all-pairs scan —
-        what keeps the chaos-soak audit cheap at WORKQUEUE_WORKERS=8
-        fleet scale — and, unlike the old adjacent-pair check, it reports
-        EVERY overlapping pair (one long attempt spanning several later
-        ones yields a pair per victim; equivalence against the
-        brute-force result is pinned by tests/test_slo.py)."""
+        Delegates to the module-level `sweep_overlaps`, which also runs
+        over several managers' MERGED histories (the sharded fleet's
+        cross-process audit and ops/diagnose --merge); equivalence
+        against the brute-force all-pairs result is pinned by
+        tests/test_slo.py."""
         with self._lock:
             histories = {k: list(v) for k, v in self._by_object.items()}
         violations: list[tuple[AttemptRecord, AttemptRecord]] = []
         for records in histories.values():
-            per_ctrl: dict[str, list[AttemptRecord]] = {}
-            for r in records:
-                if r.mono_end > r.mono_start > 0.0:
-                    per_ctrl.setdefault(r.controller, []).append(r)
-            for runs in per_ctrl.values():
-                runs.sort(key=lambda r: r.mono_start)
-                # (mono_end, tiebreak, record) heap of still-open windows;
-                # touching endpoints (prev.end == cur.start) are clean
-                active: list[tuple[float, int, AttemptRecord]] = []
-                for i, cur in enumerate(runs):
-                    while active and active[0][0] <= cur.mono_start:
-                        heapq.heappop(active)
-                    for _, _, prev in active:
-                        violations.append((prev, cur))
-                    heapq.heappush(active, (cur.mono_end, i, cur))
+            violations.extend(sweep_overlaps(records))
         return violations
 
     def snapshot(self, object_key: Optional[str] = None) -> dict:
@@ -310,4 +348,5 @@ class FlightRecorder:
             self._traces.clear()
 
 
-__all__ = ["AttemptRecord", "FlightRecorder", "span_to_dict"]
+__all__ = ["AttemptRecord", "FlightRecorder", "record_from_dict",
+           "span_to_dict", "sweep_overlaps"]

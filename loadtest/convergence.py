@@ -494,6 +494,151 @@ def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
     }
 
 
+def run_sharded_fleet(count: int, shards: int = 3,
+                      kill_shard: bool = True) -> dict:
+    """Active-active convergence benchmark: `count` notebooks over a
+    `shards`-replica sharded control plane (kube/shard.py via
+    main.build_sharded_fleet), then a kill + rejoin cycle mid-run.
+    Measures rollout wall time, merged p99 event->reconcile-start,
+    merged reconciles/notebook, ring balance, and handoff durations —
+    and PROVES the run: zero cross-process overlapping reconciles over
+    the merged flight-recorder histories, zero data-plane writes from a
+    converged fleet (shard-map lease renewals are the protocol's
+    heartbeat and are accounted separately), every zombie write
+    fenced."""
+    from kubeflow_tpu.kube.shard import SHARD_MAP_KIND
+    from kubeflow_tpu.main import build_sharded_fleet
+
+    clock = FakeClock()
+    cfg = CoreConfig.from_env({})  # hermetic: culling off, defaults only
+    fleet, api, cluster, metrics = build_sharded_fleet(
+        core_cfg=cfg, count=shards, clock=clock)
+    cluster.add_node("cpu-node", allocatable={"cpu": str(count * 8),
+                                              "memory": "8192Gi"})
+
+    def assert_converged(tag: str) -> None:
+        not_ready = [f"nb-{i:04d}" for i in range(count)
+                     if (api.get("Notebook", NAMESPACE,
+                                 f"nb-{i:04d}").body.get("status") or {}
+                         ).get("readyReplicas") != 1]
+        if not_ready:
+            raise AssertionError(
+                f"{tag}: {len(not_ready)} notebooks never converged "
+                f"(first: {not_ready[:3]})")
+
+    t0 = time.perf_counter()
+    for i in range(count):
+        api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE).obj)
+    rollout_reconciles_total = fleet.settle()
+    rollout_wall_s = time.perf_counter() - t0
+    assert_converged("rollout")
+
+    snap = fleet.shard_snapshot()
+    owned = {sid: r["keys_owned"]
+             for sid, r in snap["replicas"].items() if r["alive"]}
+    if sum(owned.values()) != count:
+        raise AssertionError(
+            f"ring does not partition the keyspace: {owned} "
+            f"(want sum == {count})")
+
+    # kill one replica, let survivors evict + adopt, then rejoin it —
+    # the handoff path under the same measurement harness
+    killed = ""
+    handoff_wall_s = 0.0
+    if kill_shard and shards > 1:
+        killed = sorted(owned)[0]
+        t1 = time.perf_counter()
+        fleet.kill(killed)
+        for _ in range(3):  # sub-lease steps: only the dead lease ages
+            clock.advance(fleet.lease_duration_s * 0.55)
+            fleet.settle()
+        if killed in fleet.shard_snapshot()["members"]:
+            raise AssertionError(f"dead shard {killed} never evicted")
+        fleet.rejoin(killed)
+        fleet.settle()
+        handoff_wall_s = time.perf_counter() - t1
+        assert_converged("kill/rejoin")
+
+    # steady-state probe: a converged sharded fleet must issue ZERO
+    # data-plane writes on a full resync — only the shard map moves
+    # (member lease renewals), and that traffic is reported, not hidden
+    api.clear_verb_counts()
+    for r in fleet.alive_replicas():
+        r.manager.enqueue_all()
+    fleet.settle()
+    steady_writes = {
+        f"{verb}:{kind}": n
+        for (verb, kind), n in sorted(api.verb_counts().items())
+        if verb in _WRITE_VERBS or verb.endswith("_status")}
+    heartbeat = {k: n for k, n in steady_writes.items()
+                 if k.endswith(":" + SHARD_MAP_KIND)}
+    data_plane = {k: n for k, n in steady_writes.items()
+                  if not k.endswith(":" + SHARD_MAP_KIND)}
+    if data_plane:
+        raise AssertionError(
+            f"write verbs issued by a converged sharded fleet: "
+            f"{data_plane}")
+
+    overlaps = fleet.cross_process_overlaps()
+    if overlaps:
+        a, b = overlaps[0]
+        raise AssertionError(
+            f"cross-process serialization violated: {len(overlaps)} "
+            f"overlapping pairs (first: {a.controller} {a.object_key})")
+
+    reconciles: dict[str, int] = {}
+    latency: list[float] = []
+    for r in fleet.replicas.values():
+        for ctrl, n in _reconciles_per_controller(r.manager).items():
+            reconciles[ctrl] = reconciles.get(ctrl, 0) + n
+        latency.extend(r.manager.event_latency_samples())
+    final = fleet.shard_snapshot()
+    result = {
+        "count": count,
+        "notebooks": count,
+        "shards": shards,
+        "wall_s": round(rollout_wall_s, 3),
+        "handoff_wall_s": round(handoff_wall_s, 3),
+        "killed_shard": killed,
+        "epoch": final["epoch"],
+        "rollout_reconciles_total": rollout_reconciles_total,
+        "reconciles_per_notebook": {
+            c: round(n / count, 3) for c, n in sorted(reconciles.items())},
+        "keys_owned": owned,
+        "p50_event_to_reconcile_s": round(_percentile(latency, 0.50), 6),
+        "p99_event_to_reconcile_s": round(_percentile(latency, 0.99), 6),
+        "event_to_reconcile_samples": len(latency),
+        "handoff_durations_s": [
+            round(d, 3) for r in fleet.replicas.values()
+            for d in r.handoff_durations],
+        "fenced_rejections": sum(
+            r["fenced_rejections"]
+            for r in final["replicas"].values()),
+        "cross_process_overlaps": 0,
+        "steady_data_plane_writes": 0,
+        "steady_heartbeat_writes": sum(heartbeat.values()),
+    }
+    for r in fleet.replicas.values():
+        r.manager.stop()
+    return result
+
+
+def check_shard_budget(result: dict, budget: dict) -> list[str]:
+    """CI gate over the sharded-fleet run (ci/fleet_budget.json
+    "sharded" section): wall-clock + p99 ceilings like the flat fleet,
+    plus ring balance — no live shard may own more than
+    `max_owned_fraction` of the keyspace."""
+    failures = check_budget(result, budget)
+    max_frac = budget.get("max_owned_fraction")
+    if max_frac is not None and result["keys_owned"]:
+        worst = max(result["keys_owned"].values())
+        if worst > result["count"] * max_frac:
+            failures.append(
+                f"ring imbalance: one shard owns {worst}/{result['count']} "
+                f"keys (> {max_frac:.0%})")
+    return failures
+
+
 def check_warm_budget(warm: dict, cold: dict, budget: dict) -> list[str]:
     """CI gate over the warm-vs-cold comparison: warm-pool-on p50 ready
     time strictly below the cold path, a minimum warm hit rate, and a
@@ -595,7 +740,29 @@ def main(argv=None) -> int:
     parser.add_argument("--check-warm-budget", default="",
                         help="warm-vs-cold budget JSON (min hit rate, p50 "
                         "ratio); fail on regression")
+    parser.add_argument("--shards", type=int, default=0, metavar="N",
+                        help="sharded mode: run --count notebooks over an "
+                        "N-replica active-active fleet with a kill+rejoin "
+                        "cycle; --check-budget reads the 'sharded' section "
+                        "of the budget JSON")
     args = parser.parse_args(argv)
+
+    if args.shards:
+        result = run_sharded_fleet(args.count, args.shards)
+        rc = 0
+        if args.check_budget:
+            budget = json.loads(Path(args.check_budget).read_text())
+            failures = check_shard_budget(result,
+                                          budget.get("sharded", budget))
+            result["budget_ok"] = not failures
+            for f in failures:
+                print(f"SHARD BUDGET FAIL: {f}", file=sys.stderr)
+                rc = 1
+        print(json.dumps(result))
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=2,
+                                                 sort_keys=True) + "\n")
+        return rc
 
     if args.bursty:
         tpu = args.tpu or "v5e:4x4"

@@ -1273,3 +1273,129 @@ class TestStragglerSoak:
             assert checks[("ok",)] > checks[("straggler",)]
         finally:
             mgr.stop()
+
+
+class TestShardKillRejoinSoak:
+    """Active-active acceptance (kube/shard.py): a 3-replica sharded
+    fleet survives seeded rounds of kill / zombie-write / rejoin /
+    notebook churn with
+
+      1. every notebook converged (StatefulSet present, status stamped)
+         after each round,
+      2. ZERO cross-process double-reconciles over the MERGED
+         flight-recorder histories of all replicas — the single-owner
+         proof, swept by the same `sweep_overlaps` that backs
+         `ops.diagnose --merge`,
+      3. every zombie write REJECTED with a stale epoch and counted in
+         the shard snapshot (fenced_rejections),
+      4. the map epoch strictly monotonic across membership changes, and
+      5. one diagnose bundle per replica, merged offline, agreeing with
+         the in-process sweep (0 overlapping pairs).
+    """
+
+    REPLICAS = 3
+    NOTEBOOKS = 12
+    ROUNDS = int(os.environ.get("SHARD_SOAK_ROUNDS", "8"))
+
+    def _expire_dead(self, fleet, clock, steps=3, step=8):
+        # sub-lease steps: survivors renew every settle pass, so only
+        # the dead member's lease ages past the 15s duration
+        for _ in range(steps):
+            clock.advance(step)
+            fleet.settle()
+
+    def test_kill_rejoin_soak(self):
+        from kubeflow_tpu.kube.leader import StaleEpochError
+        from kubeflow_tpu.main import build_sharded_fleet
+        from kubeflow_tpu.ops.diagnose import (collect_local,
+                                               merge_overlaps,
+                                               merge_records)
+
+        clock = FakeClock()
+        fleet, api, cluster, metrics = build_sharded_fleet(
+            core_cfg=CoreConfig(), count=self.REPLICAS, clock=clock)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        keys = [(f"user{i % 4}", f"soak-{i}")
+                for i in range(self.NOTEBOOKS)]
+        for ns, name in keys:
+            api.create(Notebook.new(name, ns).obj)
+        fleet.settle()
+
+        def assert_converged(round_i):
+            for ns, name in keys:
+                assert api.try_get("StatefulSet", ns, name) is not None, \
+                    (round_i, ns, name, "statefulset missing")
+                nb = api.get("Notebook", ns, name)
+                assert nb.body.get("status", {}).get("conditions"), \
+                    (round_i, ns, name, "status never stamped")
+
+        assert_converged(-1)
+        print(f"\nshard soak: seed={SOAK_SEED} rounds={self.ROUNDS} "
+              "(reproduce with CHAOS_SOAK_SEED/SHARD_SOAK_ROUNDS)")
+        rng = random.Random(SOAK_SEED ^ 0x5AAD)
+        epochs = [fleet.shard_snapshot()["epoch"]]
+        zombie_attempts = 0
+        for round_i in range(self.ROUNDS):
+            alive = sorted(r.shard_id for r in fleet.alive_replicas())
+            dead = sorted(set(fleet.replicas) - set(alive))
+            # choose: kill a replica (keep >= 1 alive), or rejoin one
+            if dead and (len(alive) <= 1 or rng.random() < 0.5):
+                fleet.rejoin(rng.choice(dead))
+                fleet.settle()
+            else:
+                victim_id = rng.choice(alive)
+                victim = fleet.replicas[victim_id]
+                fleet.kill(victim_id)
+                self._expire_dead(fleet, clock)
+                # the zombie still holds its (stale) token: every write
+                # it attempts must fence, not land
+                ns, name = rng.choice(keys)
+                with api.fault_exempt():
+                    nb = api.get("Notebook", ns, name)
+                nb.metadata.annotations["chaos/zombie"] = str(round_i)
+                try:
+                    victim.fenced.update(nb)
+                    raise AssertionError(
+                        f"round {round_i}: zombie {victim_id} write "
+                        "landed after eviction")
+                except StaleEpochError:
+                    zombie_attempts += 1
+            # churn: touch a few notebooks, let the survivors reconcile
+            for ns, name in rng.sample(keys, 3):
+                with api.fault_exempt():
+                    nb = api.get("Notebook", ns, name)
+                    nb.metadata.annotations["chaos/touch"] = \
+                        f"{round_i}.{rng.random()}"
+                    api.update(nb)
+            for r in fleet.alive_replicas():
+                r.manager.enqueue_all()
+            fleet.settle()
+
+            snap = fleet.shard_snapshot()
+            assert snap["members"] == sorted(
+                r.shard_id for r in fleet.alive_replicas()), round_i
+            assert snap["handoff"] is None, round_i
+            assert snap["epoch"] > epochs[-1], (
+                f"round {round_i}: epoch must move on every membership "
+                f"change ({epochs[-1]} -> {snap['epoch']})")
+            epochs.append(snap["epoch"])
+            assert_converged(round_i)
+
+        # (2) the single-owner proof: merged histories, zero overlaps
+        assert fleet.merged_records(), "soak recorded no attempts"
+        overlaps = fleet.cross_process_overlaps()
+        assert not overlaps, (
+            f"{len(overlaps)} cross-process double-reconciles; first: "
+            f"{overlaps[0][0].controller} {overlaps[0][0].object_key}")
+        # (3) every zombie write was rejected AND counted
+        assert zombie_attempts > 0, "soak never exercised a zombie"
+        rejected = sum(s["fenced_rejections"] for s in
+                       fleet.shard_snapshot()["replicas"].values())
+        assert rejected >= zombie_attempts, (rejected, zombie_attempts)
+        # (5) offline agreement: one bundle per replica, merged
+        bundles = [collect_local(r.manager, env={})
+                   for r in fleet.replicas.values()]
+        merged = merge_records(bundles)
+        assert merged, "bundles carried no attempts"
+        assert merge_overlaps(bundles) == []

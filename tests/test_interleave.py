@@ -311,6 +311,106 @@ def test_write_ahead_restore_under_all_schedules():
     _explore(migrate_scenario)
 
 
+# -- protocol D: sharded control-plane handoff ---------------------------------
+def shard_handoff_scenario(shard_mod=None):
+    """Replica A owns the whole keyspace; replica B joins after A's lease
+    lapses (evicting it) while A's zombie threads keep writing.  Every
+    schedule must keep the single-owner contract: each SUCCESSFUL
+    notebook write was issued by the key's committed owner at the time
+    the map was last read, every zombie write fences with StaleEpochError
+    (and is counted), no key is dropped (B rewrites all of them), and the
+    membership change is exactly one committed epoch bump whose handoff
+    record completes.
+
+    A's churn finishes before B's takeover begins — that sequencing is
+    the renew-deadline contract from kube/leader.py, not a test
+    convenience: a live member stops writing at its renew deadline,
+    strictly before any peer may evict it, so check-then-write fencing
+    never races a legitimate writer."""
+    if shard_mod is None:
+        shard_mod = importlib.import_module("kubeflow_tpu.kube.shard")
+    api = ApiServer()
+    clock = FakeClock()
+    names = ("nb-a", "nb-b", "nb-c")
+    for name in names:
+        api.create(Notebook.new(name, "default").obj)
+    a = shard_mod.ShardedReplica(api, "shard-a", clock=clock)
+    b = shard_mod.ShardedReplica(api, "shard-b", clock=clock)
+    a.join_fleet()
+    a_quiet = [False]
+    b_committed = [False]
+    owner_log: list[tuple] = []        # (writer, key, committed owner)
+    zombie_attempts: list[str] = []
+    zombie_successes: list[str] = []
+
+    def touch(replica, writer):
+        for name in names:
+            obj = api.get("Notebook", "default", name)
+            obj.metadata.annotations["touched-by"] = writer
+            replica.fenced.update(obj)
+            members = sorted(
+                replica.member.read_status().get("members") or {})
+            owner_log.append((
+                writer, name,
+                shard_mod.HashRing(members).owner_of("default", name)))
+
+    def a_churn():
+        touch(a, "shard-a")
+        a_quiet[0] = True
+
+    def b_join():
+        await_cond("a-quiet", lambda: a_quiet[0])
+        clock.advance(a.member.lease_duration_s + 1)
+        b.join_fleet()      # ONE commit: eviction + admission + handoff
+        b_committed[0] = True
+        touch(b, "shard-b")
+
+    def a_zombie():
+        # await_cond predicates run on the scheduler thread, so they may
+        # only read plain Python state published by logical threads —
+        # touching the store here would deadlock against a paused thread
+        # holding a store lock.
+        await_cond("deposed", lambda: b_committed[0])
+        for name in names:
+            zombie_attempts.append(name)
+            try:
+                obj = api.get("Notebook", "default", name)
+                obj.metadata.annotations["touched-by"] = "zombie"
+                a.fenced.update(obj)
+                zombie_successes.append(name)
+            except shard_mod.StaleEpochError:
+                pass
+
+    def check():
+        assert not zombie_successes, (
+            "stale-epoch writes landed: %r" % zombie_successes)
+        assert a.fenced.rejected_total == len(zombie_attempts) == \
+            len(names), (a.fenced.rejected_total, zombie_attempts)
+        for writer, name, owner in owner_log:
+            assert writer == owner, (
+                "successful write by a non-owner: %s wrote %s owned by %s"
+                % (writer, name, owner))
+        status = a.member.read_status()
+        assert sorted(status.get("members") or {}) == ["shard-b"], status
+        assert status.get("epoch") == 2, (
+            "membership change must be exactly one epoch bump: %r"
+            % status.get("epoch"))
+        assert status.get("handoff") is None, (
+            "handoff record left open: %r" % status.get("handoff"))
+        assert (status.get("lastHandoff") or {}).get("epoch") == 2, status
+        for name in names:                    # no key dropped
+            ann = api.get("Notebook", "default", name) \
+                .metadata.annotations.get("touched-by")
+            assert ann == "shard-b", (name, ann)
+
+    return [("a-churn", a_churn), ("b-join", b_join),
+            ("a-zombie", a_zombie)], check
+
+
+def test_shard_handoff_single_owner_under_all_schedules():
+    _explore(shard_handoff_scenario)
+
+
 # -- byte-exact replay ---------------------------------------------------------
 def test_replay_is_byte_identical():
     ex = InterleavingExplorer(warmpool_scenario)
@@ -426,6 +526,52 @@ def test_mutant_dropped_write_ahead_is_caught():
     assert fail.directives == {}, fail.narrative
     assert "restore intent was persisted" in fail.message \
         or "attempt charge" in fail.message, fail.message
+
+
+# Mutant C: adopt from the join PREVIEW instead of the commit — the map
+# write is no longer ahead of adoption, so the joiner acts on membership
+# nobody committed (and its token never activates off a committed view).
+MUTANT_SHARD = [(
+    "        view = self.member.join()",
+    "        view = self.member.preview_join()"
+    "  # MUTANT C: adopt before the commit",
+)]
+
+
+def test_mutant_adopt_before_commit_is_caught():
+    mod = _load_mutant("kubeflow_tpu.kube.shard", MUTANT_SHARD,
+                       "kubeflow_tpu.kube._shard_mutant_c")
+
+    _explore_mutant(lambda: shard_handoff_scenario(mod))
+
+
+def test_mutant_adopt_before_commit_fails_writeahead_analyzer():
+    """The same mutant must also trip the STATIC half of the gate: with
+    the commit gone from join_fleet, the destructive drain/adopt call has
+    no persist dominator on the CFG (ci/analyzers/write_ahead.py)."""
+    import ast as _ast
+    from pathlib import Path
+
+    from ci.analyzers import Module
+    from ci.analyzers import write_ahead as wa
+
+    src_path = importlib.import_module("kubeflow_tpu.kube.shard").__file__
+    rel = "kubeflow_tpu/kube/shard.py"
+    src = Path(src_path).read_text()
+    clean = Module(Path(src_path), rel, src,
+                   _ast.parse(src, filename=rel))
+    assert [v for v in wa.analyze(clean)
+            if v.context == "ShardedReplica.join_fleet"] == [], \
+        "the committed order must satisfy the analyzer"
+    old, new = MUTANT_SHARD[0]
+    assert src.count(old) == 1
+    mutated_src = src.replace(old, new)
+    mutated = Module(Path(src_path), rel, mutated_src,
+                     _ast.parse(mutated_src, filename=rel))
+    found = [v for v in wa.analyze(mutated)
+             if v.context == "ShardedReplica.join_fleet"]
+    assert found, "analyzer missed the commit-after-adopt reorder"
+    assert "not dominated" in found[0].message
 
 
 def test_mutant_reordered_claim_commit_is_caught():

@@ -132,6 +132,92 @@ class TestLeaderElection:
         finally:
             elector.stop()
 
+    def test_paused_old_leader_write_is_fenced(self):
+        """The GC-pause classic: a leader deposed while descheduled must
+        have its late writes REJECTED, not raced — verify() is the fencing
+        check every write under the elector's authority goes through."""
+        from kubeflow_tpu.kube.leader import StaleEpochError
+        from kubeflow_tpu.kube.shard import FencedApi
+        import pytest
+
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_elector(api, "mgr-a", clock), make_elector(api, "mgr-b", clock)
+        assert a.try_acquire_or_renew()
+        assert a.verify() == 0
+        # a pauses past the lease; b takes over (epoch bump deposes a)
+        clock.advance(16)
+        assert b.try_acquire_or_renew()
+        assert b.verify() == 1
+        # a resumes believing it still leads: its token is still locally
+        # "valid", but the lease re-read sees the moved epoch
+        assert a.token.valid
+        with pytest.raises(StaleEpochError):
+            a.verify()
+        assert not a.token.valid, "failed verify must latch the invalidation"
+        # and every write proxied under a's authority is rejected + counted
+        fenced = FencedApi(api, a)
+        from kubeflow_tpu.api.types import Notebook
+        with pytest.raises(StaleEpochError):
+            fenced.create(Notebook.new("late", "default").obj)
+        assert fenced.rejected_total == 1
+        assert api.try_get("Notebook", "default", "late") is None, \
+            "the stale write must never reach the store"
+        # the new leader's writes flow
+        FencedApi(api, b).create(Notebook.new("fresh", "default").obj)
+        assert api.try_get("Notebook", "default", "fresh") is not None
+
+    def test_release_drops_authority_before_the_lease_write(self):
+        """release() must invalidate is_leader AND the token BEFORE its
+        lease update lands: a successor may acquire the instant that write
+        commits, so any of our writes racing past it must already fence."""
+        observed = []
+
+        class SpyApi:
+            def __init__(self, api):
+                self._api = api
+                self.elector = None
+
+            def update(self, obj, *a, **kw):
+                if obj.kind == "Lease":
+                    observed.append(
+                        (self.elector.is_leader, self.elector.token.valid))
+                return self._api.update(obj, *a, **kw)
+
+            def __getattr__(self, name):
+                return getattr(self._api, name)
+
+        api, clock = ApiServer(), FakeClock()
+        spy = SpyApi(api)
+        a = make_elector(spy, "mgr-a", clock)
+        spy.elector = a
+        assert a.try_acquire_or_renew()
+        observed.clear()  # acquire's own write is legitimately authoritative
+        a.release()
+        assert observed == [(False, False)], \
+            "lease write landed while leadership/token were still live"
+
+    def test_failed_renew_invalidates_token(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_elector(api, "mgr-a", clock), make_elector(api, "mgr-b", clock)
+        assert a.try_acquire_or_renew()
+        clock.advance(16)
+        assert b.try_acquire_or_renew()
+        assert not a.try_acquire_or_renew(), "deposed leader must observe loss"
+        assert not a.token.valid, \
+            "failed renew must invalidate before any worker can write"
+
+    def test_fencing_epoch_stamped_on_every_lease_write(self):
+        api, clock = ApiServer(), FakeClock()
+        a = make_elector(api, "mgr-a", clock)
+        assert a.try_acquire_or_renew()
+        spec = api.get("Lease", "system", "test-mgr").body["spec"]
+        assert spec["fencingEpoch"] == spec.get("leaseTransitions", 0) == 0
+        clock.advance(16)
+        b = make_elector(api, "mgr-b", clock)
+        assert b.try_acquire_or_renew()
+        spec = api.get("Lease", "system", "test-mgr").body["spec"]
+        assert spec["fencingEpoch"] == spec["leaseTransitions"] == 1
+
     def test_background_run_invokes_callbacks(self):
         api = ApiServer()
         started, stopped = [], []

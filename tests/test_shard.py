@@ -1,0 +1,655 @@
+"""Active-active sharded control plane (kube/shard.py): consistent-hash
+ownership, fenced writes, write-ahead handoff, kill/rejoin survival.
+
+The headline invariant — one owner per key at every instant, across
+processes — is asserted three ways here: the dispatch filter agrees with
+the committed ring, a deposed incarnation's writes raise StaleEpochError,
+and the merged flight-recorder sweep finds zero cross-replica overlaps.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook
+from kubeflow_tpu.kube import ApiServer
+from kubeflow_tpu.kube.controller import Result
+from kubeflow_tpu.kube.shard import (
+    DEFAULT_LEASE_DURATION_S,
+    FencedApi,
+    HashRing,
+    SHARD_MAP_KIND,
+    ShardMember,
+    ShardedFleet,
+    ShardedReplica,
+    StaleEpochError,
+    WRITE_VERBS,
+)
+from kubeflow_tpu.utils.clock import FakeClock
+
+
+def nb(name, ns="default"):
+    return Notebook.new(name, ns).obj
+
+
+def make_member(api, sid, clock, lease=DEFAULT_LEASE_DURATION_S):
+    return ShardMember(api, sid, clock=clock, lease_duration_s=lease)
+
+
+class _Recorder:
+    def __init__(self, shard_id):
+        self.shard_id = shard_id
+        self.seen = []
+
+    def reconcile(self, req):
+        self.seen.append((req.namespace, req.name))
+        return Result()
+
+
+class TestHashRing:
+    def test_deterministic_across_observers(self):
+        keys = [("default", f"nb-{i}") for i in range(200)]
+        a = HashRing(["s0", "s1", "s2"])
+        b = HashRing(["s2", "s0", "s1"])  # order must not matter
+        assert [a.owner_of(*k) for k in keys] == [b.owner_of(*k) for k in keys]
+
+    def test_every_member_owns_a_share(self):
+        ring = HashRing(["s0", "s1", "s2"])
+        owners = {ring.owner_of("default", f"nb-{i}") for i in range(200)}
+        assert owners == {"s0", "s1", "s2"}
+
+    def test_join_moves_a_fraction_not_half(self):
+        """Consistent hashing's point: a 4th member takes roughly 1/4 of
+        the keyspace; keys that don't move to it don't move at all."""
+        keys = [("default", f"nb-{i}") for i in range(500)]
+        before = HashRing(["s0", "s1", "s2"])
+        after = HashRing(["s0", "s1", "s2", "s3"])
+        moved = sum(1 for k in keys
+                    if before.owner_of(*k) != after.owner_of(*k))
+        assert 0 < moved < len(keys) / 2
+        for k in keys:
+            if after.owner_of(*k) != "s3":
+                assert after.owner_of(*k) == before.owner_of(*k), \
+                    "a key not gained by the joiner must not move"
+
+    def test_departure_only_moves_the_departed_keys(self):
+        keys = [("default", f"nb-{i}") for i in range(500)]
+        before = HashRing(["s0", "s1", "s2"])
+        after = HashRing(["s0", "s1"])
+        for k in keys:
+            if before.owner_of(*k) != "s2":
+                assert after.owner_of(*k) == before.owner_of(*k)
+
+    def test_empty_ring_owns_nothing(self):
+        assert HashRing(()).owner_of("default", "nb") is None
+
+
+class TestShardMember:
+    def test_first_join_creates_map_and_activates_token(self):
+        api, clock = ApiServer(), FakeClock()
+        a = make_member(api, "a", clock)
+        view = a.join()
+        assert view["epoch"] == 1
+        assert a.token.valid and a.token.epoch == 1
+        assert api.get(SHARD_MAP_KIND, "", "control-plane") is not None
+        # solo joiner: nobody to drain, self-adoption is the only ack
+        assert view["handoff"]["adopters"] == ["a"]
+        assert view["handoff"]["drains"] == []
+
+    def test_second_join_bumps_epoch_and_writes_handoff_ahead(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join()
+        a.ack_adopt()
+        view = b.join()
+        assert view["epoch"] == 2
+        assert b.token.epoch == 2
+        assert a.token.epoch == 1, "survivor incarnation must not move"
+        # the SAME commit that admitted b names the key movement
+        assert view["handoff"] == {
+            "epoch": 2, "startedAt": view["handoff"]["startedAt"],
+            "adopters": ["b"], "drains": ["a"]}
+
+    def test_ack_lifecycle_completes_handoff_with_duration(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        b.join()
+        clock.advance(2.5)
+        view = a.ack_drain()
+        assert view["handoff"]["drains"] == []
+        assert view["handoff"]["adopters"] == ["b"]
+        view, duration = b.ack_adopt()
+        assert "handoff" not in view
+        assert duration == pytest.approx(2.5)
+        assert view["lastHandoff"]["epoch"] == 2
+        assert view["lastHandoff"]["durationSeconds"] == pytest.approx(2.5)
+
+    def test_adopt_before_drain_does_not_complete(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        b.join()
+        view, duration = b.ack_adopt()
+        assert duration is None
+        assert view["handoff"]["drains"] == ["a"], \
+            "the record must survive until the drain acks too"
+
+    def test_renew_keeps_incarnation_and_evicts_expired(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        b.join(); a.ack_drain(); b.ack_adopt()
+        # b goes dark; a keeps renewing in sub-lease steps
+        for _ in range(3):
+            clock.advance(8)
+            assert a.renew()
+        status = a.read_status()
+        assert sorted(status["members"]) == ["a"]
+        assert status["epoch"] == 3, "eviction must bump the epoch"
+        assert a.token.epoch == 1, "renewals never change the incarnation"
+        # the eviction commit hands the dead member's keys to survivors
+        assert status["handoff"]["adopters"] == ["a"]
+
+    def test_evicted_member_renew_fails_and_invalidates(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        b.join(); a.ack_drain(); b.ack_adopt()
+        for _ in range(3):
+            clock.advance(8)
+            b.renew()  # a never renews -> b evicts it
+        assert not a.renew()
+        assert not a.token.valid
+        with pytest.raises(StaleEpochError):
+            a.verify()
+
+    def test_leave_kills_token_before_the_commit(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        b.join(); a.ack_drain(); b.ack_adopt()
+        view = a.leave()
+        assert not a.token.valid
+        assert sorted(view["members"]) == ["b"]
+        assert view["epoch"] == 3
+        assert view["handoff"]["adopters"] == ["b"]
+
+    def test_preview_join_never_writes(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        rv_before = api.get(SHARD_MAP_KIND, "", "control-plane") \
+            .metadata.resource_version
+        preview = b.preview_join()
+        assert preview["epoch"] == 2
+        assert "b" in preview["members"]
+        assert api.get(SHARD_MAP_KIND, "", "control-plane") \
+            .metadata.resource_version == rv_before
+        assert not b.token.valid, "a preview must never activate the token"
+        assert a.read_status()["epoch"] == 1
+
+
+class TestFencedApi:
+    def test_reads_delegate_unfenced(self):
+        api, clock = ApiServer(), FakeClock()
+        a = make_member(api, "a", clock)  # never joined: token invalid
+        fenced = FencedApi(api, a)
+        api.create(nb("plain"))
+        assert fenced.get("Notebook", "default", "plain") is not None
+        assert fenced.rejected_total == 0
+
+    def test_valid_member_writes_flow(self):
+        api, clock = ApiServer(), FakeClock()
+        a = make_member(api, "a", clock)
+        a.join()
+        FencedApi(api, a).create(nb("ok"))
+        assert api.try_get("Notebook", "default", "ok") is not None
+
+    def test_every_write_verb_is_fenced(self):
+        api, clock = ApiServer(), FakeClock()
+        a = make_member(api, "a", clock)
+        rejected = []
+        fenced = FencedApi(api, a, on_rejected=lambda: rejected.append(1))
+        for i, verb in enumerate(WRITE_VERBS):
+            with pytest.raises(StaleEpochError):
+                getattr(fenced, verb)(None)
+            assert fenced.rejected_total == i + 1
+        assert len(rejected) == len(WRITE_VERBS)
+
+    def test_deposed_incarnation_is_rejected(self):
+        api, clock = ApiServer(), FakeClock()
+        a, b = make_member(api, "a", clock), make_member(api, "b", clock)
+        a.join(); a.ack_adopt()
+        fenced_a = FencedApi(api, a)
+        b.join(); a.ack_drain(); b.ack_adopt()
+        for _ in range(3):
+            clock.advance(8)
+            b.renew()  # evicts a
+        with pytest.raises(StaleEpochError):
+            fenced_a.create(nb("zombie"))
+        assert fenced_a.rejected_total == 1
+        assert api.try_get("Notebook", "default", "zombie") is None
+
+
+def make_fleet(api, clock, count=3, recs=None):
+    def factory(replica):
+        rec = _Recorder(replica.shard_id)
+        if recs is not None:
+            recs[replica.shard_id] = rec
+        replica.manager.register("nb", rec, for_kind="Notebook")
+    return ShardedFleet(api, count=count, clock=clock,
+                        controller_factory=factory)
+
+
+def expire_dead_lease(fleet, clock, steps=3, step=8):
+    """Walk time past the dead member's lease in sub-lease increments so
+    survivors keep renewing (the production pattern under FakeClock)."""
+    for _ in range(steps):
+        clock.advance(step)
+        fleet.settle()
+
+
+class TestShardedFleet:
+    def test_keyspace_partitions_exactly_once(self):
+        api, clock = ApiServer(), FakeClock()
+        recs = {}
+        fleet = make_fleet(api, clock, recs=recs)
+        names = [f"nb-{i}" for i in range(20)]
+        for name in names:
+            api.create(nb(name))
+        fleet.settle()
+        snap = fleet.shard_snapshot()
+        assert snap["members"] == ["shard-0", "shard-1", "shard-2"]
+        assert snap["handoff"] is None
+        owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
+        assert sum(owned.values()) == 20
+        assert all(v > 0 for v in owned.values())
+        # dispatch filter and committed ring agree, exactly one owner each
+        for name in names:
+            owner = fleet.owner_of("default", name)
+            claimants = [sid for sid, r in fleet.replicas.items()
+                         if r.owns_key("default", name)]
+            assert claimants == [owner]
+            assert recs[owner].seen.count(("default", name)) >= 1
+
+    def test_kill_evicts_and_survivors_adopt(self):
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        names = [f"nb-{i}" for i in range(20)]
+        for name in names:
+            api.create(nb(name))
+        fleet.settle()
+        epoch_before = fleet.shard_snapshot()["epoch"]
+        fleet.kill("shard-1")
+        expire_dead_lease(fleet, clock)
+        snap = fleet.shard_snapshot()
+        assert snap["members"] == ["shard-0", "shard-2"]
+        assert snap["epoch"] > epoch_before
+        assert snap["handoff"] is None, "eviction handoff must complete"
+        owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
+        assert owned["shard-1"] == 0
+        assert owned["shard-0"] + owned["shard-2"] == 20
+        assert snap["lastHandoff"]["epoch"] == snap["epoch"]
+
+    def test_zombie_write_after_eviction_is_fenced(self):
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        for i in range(10):
+            api.create(nb(f"nb-{i}"))
+        fleet.settle()
+        fleet.kill("shard-1")
+        expire_dead_lease(fleet, clock)
+        zombie = fleet.replicas["shard-1"]
+        with pytest.raises(StaleEpochError):
+            zombie.fenced.create(nb("from-the-grave"))
+        assert zombie.fenced.rejected_total == 1
+        assert api.try_get("Notebook", "default", "from-the-grave") is None
+        assert zombie.snapshot()["fenced_rejections"] == 1
+
+    def test_rejoin_restores_membership_with_fresh_incarnation(self):
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        for i in range(20):
+            api.create(nb(f"nb-{i}"))
+        fleet.settle()
+        old_incarnation = fleet.replicas["shard-1"].member.token.epoch
+        fleet.kill("shard-1")
+        expire_dead_lease(fleet, clock)
+        fleet.rejoin("shard-1")
+        fleet.settle()
+        snap = fleet.shard_snapshot()
+        assert snap["members"] == ["shard-0", "shard-1", "shard-2"]
+        assert snap["handoff"] is None
+        assert snap["replicas"]["shard-1"]["incarnation"] > old_incarnation
+        owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
+        assert sum(owned.values()) == 20
+        assert all(v > 0 for v in owned.values())
+
+    def test_no_cross_process_overlaps_through_kill_and_rejoin(self):
+        """The merged flight-recorder sweep: across every replica's
+        history, no key was ever inside two reconcile windows at once —
+        the single-owner proof the chaos soak scales up."""
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        for i in range(20):
+            api.create(nb(f"nb-{i}"))
+        fleet.settle()
+        fleet.kill("shard-2")
+        expire_dead_lease(fleet, clock)
+        fleet.rejoin("shard-2")
+        fleet.settle()
+        assert len(fleet.merged_records()) > 0
+        assert fleet.cross_process_overlaps() == []
+
+    def test_graceful_leave_hands_off_without_expiry(self):
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        for i in range(12):
+            api.create(nb(f"nb-{i}"))
+        fleet.settle()
+        fleet.replicas["shard-0"].leave_fleet()
+        fleet.settle()  # no clock advance needed: leave commits the record
+        snap = fleet.shard_snapshot()
+        assert snap["members"] == ["shard-1", "shard-2"]
+        assert snap["handoff"] is None
+        owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
+        assert owned["shard-0"] == 0
+        assert owned["shard-1"] + owned["shard-2"] == 12
+
+
+class TestDrainGate:
+    def test_gained_key_not_dispatchable_until_drain_acked(self):
+        """Write-ahead handoff, observable edge: the commit admitting a
+        joiner grants it keys, but the joiner must not dispatch them
+        while the loser is still in `drains` — the loser may have one in
+        flight."""
+        api, clock = ApiServer(), FakeClock()
+        r0 = ShardedReplica(api, "shard-0", clock=clock)
+        r0.manager.register("nb", _Recorder("shard-0"), for_kind="Notebook")
+        r0.join_fleet()
+        names = [f"nb-{i}" for i in range(20)]
+        for name in names:
+            api.create(nb(name))
+        r0.manager.run_until_idle()
+        r1 = ShardedReplica(api, "shard-1", clock=clock)
+        r1.manager.register("nb", _Recorder("shard-1"), for_kind="Notebook")
+        # commit the join WITHOUT running r1's drain/adopt step: the
+        # handoff is now pending with drains=[shard-0]
+        view = r1.member.join()
+        r1._install_status(view)
+        gained = [n for n in names
+                  if HashRing(["shard-0", "shard-1"])
+                  .owner_of("default", n) == "shard-1"]
+        assert gained, "the joiner must gain part of the keyspace"
+        for name in gained:
+            assert not r1.owns_key("default", name), \
+                "gained key dispatched before the loser drained"
+            assert not r0.owns_key("default", name), \
+                "the ring moved the key: the loser must stop dispatching"
+        # the loser acks its drain; the gate opens
+        r0.sync()
+        for name in gained:
+            assert r1.owns_key("default", name)
+
+    def test_cache_realigns_on_both_sides(self):
+        api, clock = ApiServer(), FakeClock()
+        r0 = ShardedReplica(api, "shard-0", clock=clock)
+        r0.manager.register("nb", _Recorder("shard-0"), for_kind="Notebook")
+        r0.join_fleet()
+        for i in range(20):
+            api.create(nb(f"nb-{i}"))
+        r0.manager.run_until_idle()
+        r0.sync()
+        assert r0.keys_owned() == 20
+        r1 = ShardedReplica(api, "shard-1", clock=clock)
+        r1.manager.register("nb", _Recorder("shard-1"), for_kind="Notebook")
+        r1.join_fleet()
+        r0.sync()
+        r1.sync()
+        r1.alive = True
+        assert r0.keys_owned() + r1.keys_owned() == 20
+        assert r0.keys_owned() < 20, "the loser's cache must shed moved keys"
+
+
+def make_adoption_fleet(cfg, count=2, session=False, tpu_nodes=4):
+    """A 2-shard fleet running the full core controller set over a fake
+    cluster — the cross-process bookkeeping-adoption harness."""
+    from kubeflow_tpu.core.metrics import NotebookMetrics
+    from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+    from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+    from kubeflow_tpu.kube import FakeCluster
+
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-node",
+                     allocatable={"cpu": "64", "memory": "256Gi"})
+    if tpu_nodes:
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    tpu_nodes, 4)
+    clock = FakeClock()
+    metrics = NotebookMetrics(api)
+    store = None
+    if session:
+        store = InMemorySessionStore(clock=clock)
+        cluster.attach_session_store(store)
+
+    def factory(replica):
+        setup_core_controllers(replica.manager, cfg, metrics,
+                               provisioner=cluster, session=store)
+
+    fleet = ShardedFleet(api, count=count, clock=clock,
+                         controller_factory=factory)
+    return api, cluster, clock, fleet, store
+
+
+def recovery_state(api, ns="u1", name="heal", slice_id="0"):
+    status = api.get("Notebook", ns, name).body.get("status", {})
+    return (status.get("sliceRecovery") or {}).get(slice_id)
+
+
+def session_entry(api, ns="u1", name="heal", slice_id="0"):
+    status = api.get("Notebook", ns, name).body.get("status", {})
+    return (status.get("sessionState") or {}).get(slice_id)
+
+
+def pod_delete_groups(api, name, hosts=4):
+    """Audited worker-pod delete attempts, partitioned into consecutive
+    whole-slice groups (slice-atomicity assert from test_selfheal.py)."""
+    recs = [r for r in api.audit_log(verb="delete", kind="Pod")
+            if r.name.startswith(name + "-")]
+    expected = {f"{name}-{i}" for i in range(hosts)}
+    groups = 0
+    for i in range(0, len(recs), hosts):
+        chunk = {r.name for r in recs[i:i + hosts]}
+        assert chunk == expected, (
+            "partial-slice pod deletion observed",
+            [(r.name, r.ok) for r in recs])
+        groups += 1
+    return groups
+
+
+class TestCrossProcessAdoption:
+    """A shard replica dies mid-recovery/mid-migration; the adopter must
+    resume from status alone — the in-flight budget never resets, the
+    warm-pool claim never moves, the restore intent is never replayed.
+    This is the cross-process proof of the write-ahead bookkeeping
+    claims in core/selfheal.py and core/scheduler.py."""
+
+    def test_recovery_budget_adopted_not_reset(self):
+        from kubeflow_tpu.api.types import TPUSpec
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        cfg = CoreConfig(recovery_backoff_base_s=10.0,
+                         recovery_backoff_max_s=300.0,
+                         recovery_max_attempts=4,
+                         recovery_window_s=100000.0)
+        api, cluster, clock, fleet, _ = make_adoption_fleet(cfg)
+        api.create(Notebook.new("heal", "u1",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+        fleet.settle()
+        owner = fleet.owner_of("u1", "heal")
+        adopter_id = next(s for s in fleet.replicas if s != owner)
+        victim, adopter = fleet.replicas[owner], fleet.replicas[adopter_id]
+        cluster.poison_statefulset("u1", "heal")  # permanently broken
+        victim.manager.enqueue_all()
+        victim.manager.run_until_idle(advance_clock=False)  # attempt 1
+        st = recovery_state(api)
+        assert len(st["attempts"]) == 1
+        first_charge = st["attempts"][0]
+        assert pod_delete_groups(api, "heal") == 1
+
+        fleet.kill(owner)
+        for _ in range(3):
+            clock.advance(8)
+            fleet.settle()
+        assert fleet.shard_snapshot()["members"] == sorted([adopter_id])
+        # the adopter resumed A's ledger: the original charge survives
+        st = recovery_state(api)
+        assert st["attempts"][0] == first_charge, \
+            "adoption reset the in-flight recovery budget"
+        # drive to exhaustion: the cap holds EXACTLY across processes
+        for _ in range(6):
+            adopter.manager.advance(300)
+        st = recovery_state(api)
+        assert st["exhausted"] is True
+        assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+        assert st["attempts"][0] == first_charge
+        adopter.manager.advance(10000)  # still capped after the handoff
+        assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+
+    def test_warmpool_claim_adopted_not_reclaimed(self):
+        from kubeflow_tpu.api.types import TPUSpec
+        from kubeflow_tpu.core import constants as C
+        from kubeflow_tpu.core.scheduler import pool_object_name
+        from kubeflow_tpu.kube import KubeObject, ObjectMeta
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        cfg = CoreConfig.from_env({
+            "ENABLE_SLICE_SCHEDULER": "true",
+            "WARMPOOL_SIZE": "0",
+            "WARMPOOL_PROVISION_S": "120",
+            "ENABLE_SELF_HEALING": "false",
+        })
+        api, cluster, clock, fleet, _ = make_adoption_fleet(cfg)
+        pool_name = pool_object_name("v5e", "4x4")
+        api.create(KubeObject(
+            api_version="kubeflow.org/v1", kind=C.WARMPOOL_KIND,
+            metadata=ObjectMeta(name=pool_name),
+            body={"spec": {"accelerator": "v5e", "topology": "4x4"},
+                  "status": {"slices": {
+                      "ws-0001": {"state": "Ready", "pool": "warm-a"},
+                      "ws-0002": {"state": "Ready", "pool": "warm-b"},
+                  }}}))
+        api.create(Notebook.new("heal", "u1",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+        fleet.settle()
+
+        def claims():
+            pool = api.get(C.WARMPOOL_KIND, "", pool_name)
+            slices = (pool.body.get("status") or {}).get("slices") or {}
+            return {sid: e["claimedBy"] for sid, e in slices.items()
+                    if e.get("claimedBy")}
+
+        before = claims()
+        assert list(before.values()) == ["u1/heal"]
+        intent_before = api.get("Notebook", "u1", "heal") \
+            .metadata.annotations.get(C.ANNOTATION_PLACEMENT)
+        assert intent_before
+
+        owner = fleet.owner_of("u1", "heal")
+        fleet.kill(owner)
+        for _ in range(3):
+            clock.advance(8)
+            fleet.settle()
+        # the adopter reconciled the notebook: the persisted claim is the
+        # ground truth it resumes from — same slice, never re-sold
+        assert claims() == before, "warm-pool claim moved across the handoff"
+        assert api.get("Notebook", "u1", "heal") \
+            .metadata.annotations.get(C.ANNOTATION_PLACEMENT) \
+            == intent_before, "placement intent rewritten by the adopter"
+
+    def test_migrate_intent_resumed_never_replayed(self):
+        from kubeflow_tpu.api.types import TPUSpec
+        from kubeflow_tpu.core import constants as C
+        from kubeflow_tpu.kube import FaultPlan, FaultRule
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        cfg = CoreConfig(checkpoint_store_uri="mem://session-state",
+                         checkpoint_max_age_s=1e6,
+                         recovery_backoff_base_s=5.0,
+                         recovery_max_attempts=6,
+                         recovery_window_s=100000.0)
+        api, cluster, clock, fleet, store = make_adoption_fleet(
+            cfg, session=True)
+        api.create(Notebook.new("heal", "u1",
+                                tpu=TPUSpec("v5e", "4x4")).obj)
+        fleet.settle()
+        cluster.set_session_payload("u1", "heal", b"kernel-state-A")
+        (snap,) = cluster.snapshot_sessions("u1", "heal")
+        owner = fleet.owner_of("u1", "heal")
+        adopter_id = next(s for s in fleet.replicas if s != owner)
+        victim, adopter = fleet.replicas[owner], fleet.replicas[adopter_id]
+
+        # A's restart sweep dies mid-migration: the restore intent and
+        # the attempt charge are already persisted (write-ahead), but no
+        # pod delete lands
+        cluster.fail_pod("u1", "heal-1")
+        api.install_fault_plan(FaultPlan(
+            [FaultRule(verbs=("delete",), kinds=("Pod",), error="server",
+                       max_matches=100)]))
+        victim.manager.enqueue_all()
+        victim.manager.run_until_idle(advance_clock=False)
+        api.clear_fault_plan()
+        entry = session_entry(api)
+        assert entry["phase"] == "migrating"
+        assert entry["restoreGeneration"] == snap.generation
+        charges_before = len(recovery_state(api)["attempts"])
+        assert charges_before >= 1
+
+        fleet.kill(owner)
+        for _ in range(3):
+            clock.advance(8)
+            fleet.settle()
+        for _ in range(10):
+            adopter.manager.advance(10)
+            status = api.get("Notebook", "u1", "heal").body["status"]
+            if status.get("sliceHealth") == "Healthy" and \
+                    (session_entry(api) or {}).get("phase") == "restored":
+                break
+        entry = session_entry(api)
+        assert entry["phase"] == "restored", entry
+        # the SAME generation A committed — the intent was resumed, not
+        # replaced by a fresh snapshot or a cold restart
+        assert entry["restoreGeneration"] == snap.generation
+        assert store.latest("u1", "heal", 0).generation == snap.generation
+        for pod in api.list("Pod", namespace="u1"):
+            got = pod.metadata.annotations.get(
+                C.ANNOTATION_RESTORED_GENERATION)
+            assert got == str(snap.generation), (pod.name, got)
+
+
+class TestMainWiring:
+    def test_build_sharded_fleet_runs_full_controllers(self):
+        from kubeflow_tpu.main import build_sharded_fleet
+
+        clock = FakeClock()
+        fleet, api, cluster, metrics = build_sharded_fleet(
+            count=3, clock=clock)
+        for i in range(6):
+            api.create(nb(f"nb-{i}"))
+        fleet.settle()
+        snap = fleet.shard_snapshot()
+        assert snap["members"] == ["shard-0", "shard-1", "shard-2"]
+        owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
+        assert sum(owned.values()) == 6
+        # the real reconcilers ran: every notebook has a StatefulSet
+        for i in range(6):
+            assert api.try_get("StatefulSet", "default", f"nb-{i}") \
+                is not None
+        text = metrics.scrape()
+        for family in ("notebook_shard_keys_owned", "notebook_shard_epoch",
+                       "notebook_shard_fenced_writes_total",
+                       "notebook_shard_handoff_duration_seconds"):
+            assert family in text
+        assert "shards" in metrics.fleet_snapshot()

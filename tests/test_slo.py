@@ -762,6 +762,76 @@ class TestDiagnoseBundle:
         assert not (tmp_path / "b.json").exists()
 
 
+class TestDiagnoseMerge:
+    """ops.diagnose --merge: the offline cross-process double-reconcile
+    sweep over several managers' bundles."""
+
+    @staticmethod
+    def _attempt(obj, span_id, mono_start, mono_end, controller="core"):
+        return {"object": obj, "controller": controller, "attempt": 0,
+                "result": "success", "start_time": 0.0, "end_time": 0.0,
+                "duration_s": 0.1, "phases": {}, "trace_id": "t-" + span_id,
+                "span_id": span_id, "error": "", "faults": [],
+                "mono_start": mono_start, "mono_end": mono_end}
+
+    @staticmethod
+    def _bundle(attempts, slowest=()):
+        return {"bundle_format": 1,
+                "reconciles": {"attempts": list(attempts),
+                               "slowest": list(slowest), "errored": []}}
+
+    def test_merge_dedupes_ring_and_retained_sets(self):
+        from kubeflow_tpu.ops.diagnose import merge_records
+
+        a = self._attempt("u1/nb", "s1", 10.0, 11.0)
+        # the same attempt retained in the ring AND the slowest set of
+        # the same bundle must count once in the merged history
+        records = merge_records([self._bundle([a], slowest=[a])])
+        assert len(records) == 1
+        assert records[0].object_key == "u1/nb"
+
+    def test_merge_flags_cross_bundle_overlap(self, tmp_path, capsys):
+        from kubeflow_tpu.ops.diagnose import merge_overlaps
+
+        # replica A and replica B each look clean in isolation — the
+        # overlap only exists across their merged histories
+        bundle_a = self._bundle([self._attempt("u1/nb", "a1", 10.0, 12.0)])
+        bundle_b = self._bundle([self._attempt("u1/nb", "b1", 11.0, 13.0)])
+        assert merge_overlaps([bundle_a]) == []
+        assert merge_overlaps([bundle_b]) == []
+        pairs = merge_overlaps([bundle_a, bundle_b])
+        assert len(pairs) == 1
+
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(bundle_a))
+        pb.write_text(json.dumps(bundle_b))
+        rc = diagnose_main(["--merge", str(pa), str(pb)])
+        assert rc == 1, "overlapping bundles must fail the merge sweep"
+        out = capsys.readouterr().out
+        assert "1 overlapping pairs" in out and "OVERLAP core u1/nb" in out
+
+    def test_merge_clean_bundles_pass(self, tmp_path, capsys):
+        # same key, touching endpoints across replicas: a handoff, not a
+        # double-reconcile
+        bundle_a = self._bundle([self._attempt("u1/nb", "a1", 10.0, 12.0)])
+        bundle_b = self._bundle([self._attempt("u1/nb", "b1", 12.0, 13.0),
+                                 self._attempt("u2/nb", "b2", 10.5, 11.5)])
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(bundle_a))
+        pb.write_text(json.dumps(bundle_b))
+        rc = diagnose_main(["--merge", str(pa), str(pb)])
+        assert rc == 0
+        assert "3 distinct attempts, 0 overlapping pairs" in \
+            capsys.readouterr().out
+
+    def test_merge_unreadable_bundle_fails_cleanly(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert diagnose_main(["--merge", str(bad)]) == 1
+        assert diagnose_main(
+            ["--merge", str(tmp_path / "missing.json")]) == 1
+
+
 class TestLoadtestSLOVerdicts:
     def test_run_fleet_records_slo_verdicts(self):
         import importlib.util
