@@ -15,6 +15,10 @@
 #   - /debug/profile serves the continuous profiler's aggregation (the
 #     manager runs with ENABLE_CONTINUOUS_PROFILER=true here) and its
 #     overhead gauge stays under 5%,
+#   - /debug/criticalpath serves the lifecycle ledger's stage ranking
+#     with the demo notebook finalized and its conservation check clean,
+#   - /debug/timeline serves the in-process TSDB inventory, a per-series
+#     query, and the full ?dump=1 capture,
 #   - `python -m kubeflow_tpu.ops.diagnose` captures a bundle over the
 #     same surface from which the slowest attempt resolves offline.
 # Wired into ci/run_tests.sh (controlplane lane).
@@ -139,6 +143,52 @@ _, _, body = get("/metrics")
 assert 'notebook_dataplane_mfu_ratio{namespace="default",name="demo"}' \
     in body, "dataplane gauge missing from scrape"
 
+# lifecycle critical path: the demo notebook's event->ready window is
+# attributed to stages, the fleet ranking is served, and the conservation
+# check (attributed sum == measured wall time) holds with zero violations
+deadline = time.time() + 15
+while True:
+    _, _, body = get("/debug/criticalpath")
+    cp = json.loads(body)
+    if cp.get("conservation", {}).get("finalized", 0) >= 1:
+        break
+    if time.time() > deadline:
+        raise SystemExit("/debug/criticalpath never finalized a notebook")
+    time.sleep(0.25)
+assert cp["conservation"]["violations"] == 0, cp["conservation"]
+assert isinstance(cp["ranking"], list), cp
+for r in cp["ranking"]:
+    assert r["stage"] and r["count"] >= 1 and r["total_s"] >= 0.0, r
+assert "default" in cp["namespaces"], cp["namespaces"].keys()
+
+# the stage histogram surfaces on /metrics with the ledger's buckets
+_, _, body = get("/metrics")
+assert "# TYPE notebook_stage_duration_seconds histogram" in body, \
+    "stage histogram missing from scrape"
+
+# /debug/fleet carries the per-namespace stage-latency rollup
+_, _, body = get("/debug/fleet")
+fleet = json.loads(body)
+assert "default" in fleet["stage_latency"], fleet.get("stage_latency")
+assert fleet["criticalpath"]["conservation"]["violations"] == 0, fleet
+
+# in-process TSDB: the /metrics scrapes above each fed one sample, so
+# the inventory is non-empty, a known series queries at every tier, and
+# ?dump=1 returns the full multi-tier capture a bundle embeds
+_, _, body = get("/debug/timeline")
+tl = json.loads(body)
+assert tl["tiers"] == ["raw", "10s", "60s"], tl
+assert tl["samples_total"] > 0 and tl["series"], tl
+name = sorted(tl["series"])[0]
+for tier in ("raw", "10s", "60s"):
+    _, _, body = get(f"/debug/timeline?series={name}&tier={tier}")
+    q = json.loads(body)
+    assert q["series"] == name and q["tier"] == tier, q
+    assert "error" not in q and q["points"], q
+_, _, body = get("/debug/timeline?dump=1")
+dump = json.loads(body)
+assert dump["series"][name]["raw"], dump.get("bounds")
+
 # continuous profiler: enabled for this boot, samples flowing, overhead
 # gauge under the 5% always-on budget
 _, _, body = get("/debug/profile")
@@ -151,7 +201,7 @@ assert status == 200 and ctype.startswith("text/plain")
 
 print("debug smoke: OK (/debug/reconciles, /debug/traces, "
       "/debug/workqueue, /debug/alerts, /debug/fleet, /debug/profile, "
-      "OpenMetrics negotiation)")
+      "/debug/criticalpath, /debug/timeline, OpenMetrics negotiation)")
 EOF
 
 # one-shot diagnostics bundle over the same loopback surface: the CLI
@@ -175,6 +225,15 @@ assert "config" in bundle
 telem = bundle["telemetry"]
 assert telem and telem["notebooks"]["default/demo"]["workers"], telem
 assert bundle["fleet"]["dataplane"]["notebooks"], bundle["fleet"].keys()
+# critical-path attribution and the full TSDB capture ride the bundle:
+# a run's p99-vs-time curve reconstructs offline from `timeline.series`
+cp = bundle["criticalpath"]
+assert cp["conservation"]["finalized"] >= 1, cp["conservation"]
+assert cp["conservation"]["violations"] == 0, cp["conservation"]
+tl = bundle["timeline"]
+assert tl["samples_total"] > 0 and tl["series"], tl.get("bounds")
+for name, tiers in tl["series"].items():
+    assert set(tiers) == {"raw", "10s", "60s"}, (name, tiers.keys())
 print("diagnose smoke: OK (bundle resolves its slowest attempt offline, "
-      "worker telemetry included)")
+      "worker telemetry + critical path + timeline included)")
 EOF

@@ -203,6 +203,12 @@ _BARE_LOCK_EXEMPT = {
     "kubeflow_tpu/utils/slo.py":
         "SLO engine sample-window lock, telemetry only — same "
         "rationale as tracing.py",
+    "kubeflow_tpu/utils/lifecycle.py":
+        "lifecycle-ledger leaf lock (attempt fold + read-side "
+        "snapshots), telemetry only — same rationale as tracing.py",
+    "kubeflow_tpu/utils/tsdb.py":
+        "time-series ring lock, append/query telemetry only — same "
+        "rationale as tracing.py",
 }
 
 _LOCK_CTORS = ("threading.Lock", "threading.RLock")

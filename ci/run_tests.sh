@@ -109,20 +109,26 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   echo "== loadtest bursty warm-pool smoke =="
   python loadtest/convergence.py --bursty 24 --bursts 3 --warm-size 8 \
     --tpu v5e:4x4 --check-warm-budget ci/warmpool_budget.json
-  # active-active gate: 600 notebooks over a 3-replica sharded fleet
-  # with a kill+rejoin cycle mid-run — converge under the committed
-  # wall-clock + p99 event->reconcile-start ceilings with the ring
-  # balanced (ci/fleet_budget.json "sharded"), zero cross-process
-  # overlapping reconciles over the merged flight-recorder histories,
-  # and a zero-data-plane-write steady state
-  echo "== loadtest sharded fleet convergence (3 shards) =="
-  python loadtest/convergence.py --count 600 --shards 3 \
+  # active-active gate, swept: 200 then 600 notebooks over a 3-replica
+  # sharded fleet with a kill+rejoin cycle per point — each point prints
+  # its per-stage critical-path table and must conserve (attributed stage
+  # time == measured event->ready wall time per notebook), the largest
+  # point must converge under the committed wall-clock + p99
+  # event->reconcile-start ceilings with the ring balanced
+  # (ci/fleet_budget.json "sharded"), zero cross-process overlapping
+  # reconciles, and a zero-data-plane-write steady state; the per-point
+  # attribution records land in the --out artifact
+  echo "== loadtest sharded fleet sweep (3 shards) =="
+  python loadtest/convergence.py --sweep 200,600 --shards 3 \
     --check-budget ci/fleet_budget.json \
-    --out "${SHARD_RESULT_OUT:-/tmp/shard_fleet_result.json}"
+    --out "${SHARD_RESULT_OUT:-/tmp/shard_fleet_sweep.json}"
   # fleet-scale convergence gate: 10k notebooks must converge at the same
   # reconciles/notebook as the 200-notebook smoke (within tolerance),
   # reach a zero-write steady state, and stay under the committed
   # wall-clock + p99 event->reconcile-start ceilings (ci/fleet_budget.json).
+  # The run arrives in batches so the in-process TSDB holds the p99-vs-time
+  # curve, and the lifecycle ledger's conservation gate must hold for all
+  # 10k notebooks (attributed stage time == event->ready wall time, <=5%).
   # On a budget failure the run re-executes under cProfile and dumps the
   # top-25 cumulative listing so the regression is diagnosable from CI
   # output alone.

@@ -9,6 +9,7 @@ from typing import Optional
 
 from ..api.types import CONDITION_RECOVERY_EXHAUSTED
 from ..kube import ApiServer, parse_quantity
+from ..utils.lifecycle import register_lifecycle_metrics
 from ..utils.metrics import Registry
 from ..utils.profiler import register_profiler_metrics
 from ..utils.slo import register_slo_metrics
@@ -48,6 +49,35 @@ def fleet_state(nb) -> str:
         return "degraded"
     # CPU notebook (or no status yet)
     return "ready" if status.get("readyReplicas") else "pending"
+
+
+def histogram_quantile(hist, q: float) -> float:
+    """Prometheus-style quantile estimate over ALL label sets of one
+    histogram: cumulative bucket counts summed across series, then linear
+    interpolation inside the target bucket (the +Inf bucket clamps to the
+    largest finite bound).  Feeds the TSDB's p99-vs-time series without
+    needing raw samples retained anywhere."""
+    totals: dict[float, float] = {}
+    for key in hist.collect():
+        for bound, c in hist.bucket_counts(*key).items():
+            totals[bound] = totals.get(bound, 0.0) + c
+    if not totals:
+        return 0.0
+    count = totals.get(float("inf"), 0.0)
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    bounds = sorted(b for b in totals if b != float("inf"))
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = totals[b]
+        if cum >= rank:
+            if cum == prev_cum:
+                return b
+            return prev_bound + (b - prev_bound) * \
+                (rank - prev_cum) / (cum - prev_cum)
+        prev_bound, prev_cum = b, cum
+    return bounds[-1] if bounds else 0.0
 
 
 class NotebookMetrics:
@@ -217,6 +247,10 @@ class NotebookMetrics:
             register_slo_metrics(self.registry)
         self.profiler_overhead, self.profiler_samples = \
             register_profiler_metrics(self.registry)
+        # lifecycle critical-path family (utils/lifecycle.py): registered
+        # here for inventory stability; an attached LifecycleLedger
+        # re-registers identically and feeds the same histogram
+        self.stage_duration = register_lifecycle_metrics(self.registry)
         # data-plane rollup families (core/telemetry.py): registered here
         # so the inventory is identical whether or not a
         # WorkerTelemetryAggregator is attached; the aggregator
@@ -260,6 +294,15 @@ class NotebookMetrics:
         # evaluated at every scrape, BEFORE the SLO engine so its verdict
         # counters are fresh when the burn rates read them
         self.dataplane = None
+        # LifecycleLedger attached via attach_lifecycle(): fleet_snapshot
+        # grows the per-namespace stage-latency rollup and the TSDB feed
+        # samples its stage p99s
+        self.lifecycle = None
+        # TimeSeriesStore attached via attach_tsdb(): every scrape()
+        # appends one sample per selected series (the /debug/timeline and
+        # diagnostics-bundle history)
+        self.tsdb = None
+        self._tsdb_clock = None
         # last snapshot of the manager's cumulative totals, so each scrape
         # feeds the counters exactly the delta since the previous scrape
         self._counter_snapshots: dict[tuple, float] = {}
@@ -291,6 +334,20 @@ class NotebookMetrics:
         the notebook_shard_* families from its replicas' snapshots and
         fleet_snapshot() grows a `shards` section."""
         self.shards = fleet
+
+    def attach_lifecycle(self, ledger) -> None:
+        """Attach a LifecycleLedger (utils/lifecycle.py); fleet_snapshot()
+        grows the per-namespace stage-latency rollup and the TSDB feed
+        samples the ledger's stage p99s each scrape."""
+        self.lifecycle = ledger
+
+    def attach_tsdb(self, store, clock=None) -> None:
+        """Attach a TimeSeriesStore (utils/tsdb.py); every scrape()
+        appends one sample per selected series, timestamped off `clock`
+        (falls back to the attached manager's clock) so the history is
+        FakeClock-deterministic in tests."""
+        self.tsdb = store
+        self._tsdb_clock = clock
 
     def _feed_counter(self, counter, label, total: float) -> None:
         """Advance a monotonic counter to `total` using deltas against the
@@ -432,7 +489,47 @@ class NotebookMetrics:
             # burn rates / budget gauges / alert lifecycle advance at
             # scrape resolution, exactly like a Prometheus-side burn rule
             self.slo.evaluate()
+        if self.tsdb is not None:
+            # last, so the sample reads this scrape's fresh evaluations
+            self._feed_tsdb()
         return self.render(openmetrics=openmetrics)
+
+    def _feed_tsdb(self) -> None:
+        """One TSDB sample per scrape: the handful of series whose curves
+        answer 'where does it bend' — ready/reaction p99s, queue state,
+        fleet size, and the lifecycle stage p99s."""
+        clock = self._tsdb_clock or getattr(self.manager, "clock", None)
+        if clock is None:
+            return
+        values: dict[str, float] = {
+            "ready_p99_s": histogram_quantile(
+                self.notebook_ready_seconds, 0.99),
+            "event_to_reconcile_p99_s": 0.0,
+            "notebooks_running": sum(
+                self.running.collect().values()),
+        }
+        mgr_registry = getattr(self.manager, "metrics_registry", None)
+        if mgr_registry is not None:
+            e2r = mgr_registry.get("notebook_event_to_reconcile_seconds")
+            if e2r is not None:
+                values["event_to_reconcile_p99_s"] = \
+                    histogram_quantile(e2r, 0.99)
+            rt = mgr_registry.get("controller_runtime_reconcile_total")
+            if rt is not None:
+                values["reconciles_total"] = sum(rt.collect().values())
+        if self.manager is not None:
+            stats = self.manager.queue_stats()
+            values["workqueue_depth"] = float(
+                sum(stats["depth"].values()))
+            values["workqueue_backoff_pending"] = float(
+                sum(stats["backoff_pending"].values()))
+        if self.lifecycle is not None:
+            for stage, p99 in self.lifecycle.stage_p99s().items():
+                values["stage_p99.%s" % stage] = p99
+            cons = self.lifecycle.conservation()
+            values["criticalpath_finalized"] = float(cons["finalized"])
+            values["criticalpath_violations"] = float(cons["violations"])
+        self.tsdb.sample(clock.now(), values)
 
     def _scrape_shards(self) -> None:
         """Feed the notebook_shard_* families from the attached fleet:
@@ -497,6 +594,16 @@ class NotebookMetrics:
             out["dataplane"] = self.dataplane.snapshot()
         if self.shards is not None:
             out["shards"] = self.shards.shard_snapshot()
+        if self.lifecycle is not None:
+            # the tenants view: ready-time and stage-latency by namespace
+            # (the seed signal for fairness/starvation gates), plus the
+            # fleet critical path so /debug/fleet alone answers "which
+            # stage dominates and for whom"
+            out["stage_latency"] = self.lifecycle.namespace_rollup()
+            out["criticalpath"] = {
+                "ranking": self.lifecycle.ranking(),
+                "conservation": self.lifecycle.conservation(),
+            }
         return out
 
     def _scrape_census_from_cache(self, cache) -> None:

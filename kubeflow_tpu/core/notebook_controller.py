@@ -78,8 +78,9 @@ class NotebookReconciler:
         # first-readiness tracking for the notebook_to_ready_seconds
         # histogram: first-seen clock time per live notebook (keyed by uid
         # so a delete+recreate measures afresh), dropped once observed
-        self._first_seen: dict[tuple[str, str, str], float] = {}
-        self._ready_observed: set[tuple[str, str, str]] = set()
+        self._first_seen: dict[tuple, float] = {}
+        self._ready_observed: set[tuple] = set()  # per (uid-key, generation)
+        self._ready_measured: set[tuple[str, str, str]] = set()  # per uid
 
     # -- main loop (reference Reconcile, notebook_controller.go:94-294) -------
     def reconcile(self, req: Request) -> Result:
@@ -92,6 +93,11 @@ class NotebookReconciler:
         if obj is None:
             return Result()
         nb = Notebook(obj)
+        # lifecycle ledger identity: the attempt's root span carries the
+        # spec generation so stage attribution keys (ns, name, generation)
+        # and a spec update opens a fresh ledger entry
+        _TRACER.current_span().set_attribute(
+            "generation", int(obj.metadata.generation or 1))
         # jupyter-web-app deletes with foreground policy: while terminating,
         # recreating owned objects would fight the API server (:138)
         if obj.metadata.deletion_timestamp is not None:
@@ -507,24 +513,47 @@ class NotebookReconciler:
 
         # first-readiness latency, measured on the injected clock from the
         # first reconcile that saw this notebook (uid-keyed: delete+recreate
-        # measures afresh; no wall-clock reads, deterministic under FakeClock)
+        # measures afresh; no wall-clock reads, deterministic under FakeClock).
+        # The ready span event fires once per GENERATION — a spec update
+        # opens a fresh lifecycle ledger entry that must finalize on its
+        # own rollout — while the histogram observes once per uid.
         key = (nb.namespace, nb.name, nb.obj.metadata.uid)
-        first_seen = self._first_seen.setdefault(key, self.clock.now())
+        genkey = (key, int(nb.obj.metadata.generation or 1))
+        first_seen = self._first_seen.setdefault(genkey, self.clock.now())
         if ready >= expected_hosts and expected_hosts > 0 \
-                and key not in self._ready_observed:
+                and genkey not in self._ready_observed:
             # exemplar the readiness latency with the attempt's trace: the
             # scrape's fat readiness bucket points at the reconcile that
             # finally turned the notebook Ready
             tid = span.trace_id
-            self.metrics.notebook_ready_seconds.labels(nb.namespace).observe(
-                self.clock.now() - first_seen,
-                exemplar={"trace_id": tid} if tid else None)
-            self._ready_observed.add(key)
-            self._first_seen.pop(key, None)
+            if key not in self._ready_measured:
+                self.metrics.notebook_ready_seconds.labels(
+                    nb.namespace).observe(
+                        self.clock.now() - first_seen,
+                        exemplar={"trace_id": tid} if tid else None)
+                self._ready_measured.add(key)
+            self._ready_observed.add(genkey)
+            self._first_seen.pop(genkey, None)
             span.add_event("notebook.ready", {"seconds":
                                               self.clock.now() - first_seen})
+        elif ready < expected_hosts and expected_hosts > 0 and \
+                C.STOP_ANNOTATION not in nb.metadata.annotations:
+            # what the notebook is waiting ON right now — the lifecycle
+            # ledger classifies the idle gap after this attempt with it
+            if scheduling:
+                waiting_on = "scheduling"
+            else:
+                pods_found = len(worker_states) if tpu is not None else \
+                    (1 if pod0 is not None else 0)
+                waiting_on = "pod_start" if pods_found >= expected_hosts \
+                    else "pod_schedule"
+            span.add_event("notebook.waiting", {
+                "on": waiting_on, "ready": ready,
+                "expected": expected_hosts})
         if len(self._ready_observed) > 8192:
             self._ready_observed.clear()
+        if len(self._ready_measured) > 8192:
+            self._ready_measured.clear()
         if len(self._first_seen) > 8192:
             self._first_seen.clear()
 

@@ -267,6 +267,10 @@ class SliceScheduler:
         if obj is None:
             return Result()  # deletion: the pool controller GCs claims
         nb = Notebook(obj)
+        # lifecycle ledger identity: scheduler attempts land on the same
+        # (ns, name, generation) stage ledger as the notebook controller's
+        _TRACER.current_span().set_attribute(
+            "generation", int(obj.metadata.generation or 1))
         tpu = nb.tpu
         if tpu is None or obj.metadata.deletion_timestamp is not None:
             return Result()

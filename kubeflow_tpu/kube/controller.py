@@ -356,9 +356,17 @@ class Manager:
         # optional fleet observers (build_manager wires them): the SLO
         # engine receives every completed AttemptRecord (exemplar latching
         # for burn alerts — utils/slo.py), the continuous profiler hangs
-        # here so /debug/profile can reach it
+        # here so /debug/profile can reach it, the lifecycle ledger folds
+        # every attempt into its notebook's stage partition
+        # (utils/lifecycle.py), and the TSDB hangs here for /debug/timeline
         self.slo_engine = None
         self.profiler = None
+        self.lifecycle = None
+        self.tsdb = None
+        # replica identity for lifecycle attribution: a sharded fleet sets
+        # this to the shard id so a manager change between consecutive
+        # attempts of one notebook reads as handoff/adoption wait
+        self.manager_id = ""
         self._limiter = rate_limiter or default_rate_limiter(self.clock)
         self._registrations: list[_Registration] = []
         self._lock = invariants.tracked(
@@ -418,6 +426,9 @@ class Manager:
         # per-key cause stamps: (clock time, monotonic wall time) of the
         # event that put the key in the queue
         self._cause_stamps: dict[tuple[str, Request], tuple[float, float]] = {}
+        # cause clock-time carried from _pop to the attempt's root span
+        # (per-key serialization guarantees no concurrent writer per key)
+        self._attempt_cause: dict[tuple[str, Request], float] = {}
         # exact wall-clock samples for percentile reporting (FakeClock runs
         # collapse the injected-clock delta to ~0, so the loadtest reads
         # real reaction time from here); bounded for long-lived managers
@@ -503,7 +514,7 @@ class Manager:
             self._retries = {k: v for k, v in self._retries.items()
                              if k[0] != name}
             for d in (self._enqueued_at, self._trace_ids, self._attempt_seq,
-                      self._cause_stamps):
+                      self._cause_stamps, self._attempt_cause):
                 for k in [k for k in d if k[0] == name]:
                     del d[k]
         for k in dropped:
@@ -626,6 +637,11 @@ class Manager:
             enqueued_at = self._enqueued_at.pop(key, None)
             cause = self._cause_stamps.pop(key, None)
             tid = self._trace_ids.get(key, "")
+            if cause is not None:
+                # ride the cause clock-time to _process_item so the
+                # lifecycle ledger can anchor the notebook's event->ready
+                # window at the event the fleet reacted to
+                self._attempt_cause[key] = cause[0]
         if cause is not None:
             # event -> reconcile-start: the injected-clock delta feeds the
             # deterministic histogram; the wall-clock delta feeds the exact
@@ -716,6 +732,7 @@ class Manager:
         with self._lock:
             attempt = self._attempt_seq.get(item, 0) + 1
             self._attempt_seq[item] = attempt
+            cause_ts = self._attempt_cause.pop(item, None)
         start = self.clock.now()
         # monotonic wall-time stamps ride the root span into the flight
         # recorder: under a FakeClock every attempt collapses to the same
@@ -736,6 +753,8 @@ class Manager:
                 trace_id=self._trace_ids.get(item, ""),
             ) as span:
                 root_span = span
+                if cause_ts is not None:
+                    span.set_attribute("cause_ts", cause_ts)
                 if span.recording and item not in self._trace_ids:
                     self._trace_ids[item] = span.trace_id
                 try:
@@ -826,6 +845,11 @@ class Manager:
                         # attempts become the exemplar trace an alert
                         # links back into this very recorder
                         self.slo_engine.observe_attempt(rec)
+                    if rec is not None and self.lifecycle is not None:
+                        # attempt stream -> lifecycle ledger: the stage
+                        # partition behind /debug/criticalpath
+                        self.lifecycle.observe_attempt(
+                            rec, root_span, self.manager_id)
                 except Exception:  # noqa: BLE001 — observability must
                     # never take the reconcile loop down with it
                     logger.exception("flight recorder rejected a span")

@@ -92,6 +92,12 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
     - /debug/fleet — per-namespace / per-shape health rollup off the
       informer cache's incremental census (O(series) per request) plus
       the SLO verdicts;
+    - /debug/criticalpath — the lifecycle ledger's fleet-wide stage
+      ranking (mean/p99 contribution per stage to event->ready) and its
+      conservation check (attributed sum vs measured wall time);
+    - /debug/timeline — the in-process TSDB: ?series=<name>&tier=raw|10s|
+      60s returns one downsampled series; ?dump=1 the full multi-tier
+      capture (what ops/diagnose bundles); without either the inventory;
     - /state    — in-memory store dump (includes Secret data; additionally
       gated on --expose-state)."""
 
@@ -228,6 +234,30 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
             self._respond(200, json.dumps(self.metrics.fleet_snapshot(),
                                           default=str),
                           "application/json")
+        elif path == "/debug/criticalpath":
+            ledger = getattr(mgr, "lifecycle", None)
+            body = ledger.snapshot() if ledger is not None else {
+                "enabled": False,
+                "error": "no lifecycle ledger attached to this manager"}
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
+        elif path == "/debug/timeline":
+            store = getattr(mgr, "tsdb", None)
+            if store is None:
+                body = {"enabled": False,
+                        "error": "no time-series store attached"}
+            else:
+                series = (query.get("series") or [None])[0]
+                tier = (query.get("tier") or ["raw"])[0]
+                dump = (query.get("dump") or [""])[0]
+                if series:
+                    body = store.query(series, tier=tier)
+                elif dump in ("1", "true"):
+                    body = store.dump()  # full capture, for bundles
+                else:
+                    body = store.snapshot()
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
         else:
             self._respond(404, "not found", "text/plain")
 
@@ -308,6 +338,28 @@ def build_manager(
         mfu_target=core_cfg.dataplane_mfu_target)
     metrics.attach_dataplane(aggregator)
     mgr.telemetry_aggregator = aggregator
+    # lifecycle stage ledger + in-process TSDB: the manager feeds the
+    # ledger with every finished attempt (critical-path attribution at
+    # /debug/criticalpath), and each metrics scrape appends one TSDB
+    # sample (p99-vs-time history at /debug/timeline, captured into the
+    # ops/diagnose bundle)
+    from .utils.lifecycle import LifecycleLedger
+    from .utils.tsdb import TimeSeriesStore
+
+    ledger = LifecycleLedger(
+        registry=metrics.registry,
+        max_notebooks=core_cfg.lifecycle_max_notebooks,
+        samples_per_stage=core_cfg.lifecycle_samples_per_stage,
+        tolerance=core_cfg.lifecycle_tolerance)
+    mgr.lifecycle = ledger
+    metrics.attach_lifecycle(ledger)
+    tsdb = TimeSeriesStore(
+        raw_capacity=core_cfg.tsdb_raw_capacity,
+        tier10_capacity=core_cfg.tsdb_tier10_capacity,
+        tier60_capacity=core_cfg.tsdb_tier60_capacity,
+        max_series=core_cfg.tsdb_max_series)
+    mgr.tsdb = tsdb
+    metrics.attach_tsdb(tsdb, clock=mgr.clock)
     if core_cfg.enable_continuous_profiler:
         # always-on (controller, phase) CPU attribution; self-overhead is
         # exported so "can it stay on" is a gauge (/debug/profile)
@@ -365,10 +417,34 @@ def build_sharded_fleet(
     api = ApiServer(history_size=core_cfg.watch_history_size)
     cluster = FakeCluster(api) if with_fake_cluster else None
     metrics = NotebookMetrics(api)
+    # ONE lifecycle ledger + TSDB across every replica: a notebook's
+    # attempts land on one timeline no matter which shard ran them, so a
+    # manager-id change between consecutive attempts reads as
+    # handoff/adoption wait (utils/lifecycle.py)
+    from .utils.lifecycle import LifecycleLedger
+    from .utils.tsdb import TimeSeriesStore
+
+    ledger = LifecycleLedger(
+        registry=metrics.registry,
+        max_notebooks=core_cfg.lifecycle_max_notebooks,
+        samples_per_stage=core_cfg.lifecycle_samples_per_stage,
+        tolerance=core_cfg.lifecycle_tolerance)
+    metrics.attach_lifecycle(ledger)
+    tsdb = TimeSeriesStore(
+        raw_capacity=core_cfg.tsdb_raw_capacity,
+        tier10_capacity=core_cfg.tsdb_tier10_capacity,
+        tier60_capacity=core_cfg.tsdb_tier60_capacity,
+        max_series=core_cfg.tsdb_max_series)
+    # clock=None falls back to the first replica manager's clock at feed
+    # time (setup_core_controllers attaches it to `metrics`)
+    metrics.attach_tsdb(tsdb, clock=clock)
 
     def controllers(replica):
         # replica.manager.api is the FencedApi: every controller write is
         # epoch-checked against the committed shard map before it lands
+        replica.manager.lifecycle = ledger
+        replica.manager.manager_id = replica.shard_id
+        replica.manager.tsdb = tsdb
         setup_core_controllers(replica.manager, core_cfg, metrics,
                                provisioner=cluster)
         setup_culling(replica.manager, core_cfg, metrics=metrics)

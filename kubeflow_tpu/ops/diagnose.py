@@ -10,7 +10,8 @@ Two collection modes:
 
   - **HTTP** (the CLI default): walk the manager's loopback debug
     surface (`/metrics`, `/debug/{fleet,alerts,reconciles,workqueue,
-    profile}`), then resolve the span trees of every retained slowest/
+    profile,criticalpath,timeline}`), then resolve the span trees of
+    every retained slowest/
     errored attempt via `/debug/traces/<id>` — so the bundle can
     reconstruct, offline, exactly the attempts an operator gets paged
     about.  Run it where the manager runs (`kubectl exec`), like every
@@ -54,7 +55,7 @@ CONFIG_PREFIXES = (
     "INVARIANTS_", "K8S_", "IDLENESS_", "CLUSTER_DOMAIN", "USE_ISTIO",
     "ISTIO_", "ADD_FSGROUP", "DEV", "SET_PIPELINE_", "GATEWAY_",
     "NOTEBOOK_GATEWAY_", "MLFLOW_", "INJECT_", "TPU_", "KUBE_",
-    "DATAPLANE_", "TELEMETRY_",
+    "DATAPLANE_", "TELEMETRY_", "LIFECYCLE_", "TSDB_",
 )
 _SECRET_RE = re.compile(r"TOKEN|SECRET|PASSWORD|PASSWD|CREDENTIAL|APIKEY"
                         r"|API_KEY|PRIVATE|CERT", re.IGNORECASE)
@@ -94,6 +95,8 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
     engine = getattr(manager, "slo_engine", None)
     profiler = getattr(manager, "profiler", None)
     aggregator = getattr(manager, "telemetry_aggregator", None)
+    ledger = getattr(manager, "lifecycle", None)
+    tsdb = getattr(manager, "tsdb", None)
     reconciles = manager.flight_recorder.snapshot()
     traces = {}
     for tid in _trace_ids(reconciles):
@@ -117,6 +120,11 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
                     else {"enabled": False}),
         "telemetry": (aggregator.snapshot() if aggregator is not None
                       else None),
+        "criticalpath": (ledger.snapshot() if ledger is not None
+                         else None),
+        # full multi-tier dump, not just the inventory: the bundle is
+        # what reconstructs a loadtest's p99-vs-time curve offline
+        "timeline": tsdb.dump() if tsdb is not None else None,
         "config": redacted_config(env),
     }
 
@@ -171,6 +179,8 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
         # lookup path for worker telemetry
         "telemetry": (fleet.get("dataplane")
                       if isinstance(fleet, dict) else None),
+        "criticalpath": get_json("/debug/criticalpath"),
+        "timeline": get_json("/debug/timeline?dump=1"),
         "config": redacted_config(),
     }
 

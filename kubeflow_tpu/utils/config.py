@@ -198,6 +198,21 @@ class CoreConfig:
     # lane raises them via INTERLEAVE_DEEP (ci/chaos_soak.sh).
     interleave_max_schedules: int = 1200        # INTERLEAVE_MAX_SCHEDULES
     interleave_budget_s: float = 60.0           # INTERLEAVE_BUDGET_S
+    # lifecycle stage ledger (utils/lifecycle.py): per-notebook
+    # event->ready critical-path attribution behind /debug/criticalpath.
+    # lifecycle_max_notebooks bounds the LRU of open/finalized ledgers,
+    # lifecycle_samples_per_stage the per-stage p99 sample ring, and
+    # lifecycle_tolerance the conservation check's relative-error gate.
+    lifecycle_max_notebooks: int = 4096         # LIFECYCLE_MAX_NOTEBOOKS
+    lifecycle_samples_per_stage: int = 2048     # LIFECYCLE_SAMPLES_PER_STAGE
+    lifecycle_tolerance: float = 0.05           # LIFECYCLE_TOLERANCE
+    # in-process time-series store (utils/tsdb.py): per-series raw ring
+    # plus 10s/60s downsampled tiers, fed once per metrics scrape and
+    # served at /debug/timeline; tsdb_max_series caps the name space.
+    tsdb_raw_capacity: int = 512                # TSDB_RAW_CAPACITY
+    tsdb_tier10_capacity: int = 1024            # TSDB_TIER10_CAPACITY
+    tsdb_tier60_capacity: int = 1024            # TSDB_TIER60_CAPACITY
+    tsdb_max_series: int = 256                  # TSDB_MAX_SERIES
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -281,6 +296,17 @@ class CoreConfig:
             interleave_max_schedules=max(1, _int(
                 env, "INTERLEAVE_MAX_SCHEDULES", 1200)),
             interleave_budget_s=_float(env, "INTERLEAVE_BUDGET_S", 60.0),
+            lifecycle_max_notebooks=max(1, _int(
+                env, "LIFECYCLE_MAX_NOTEBOOKS", 4096)),
+            lifecycle_samples_per_stage=max(1, _int(
+                env, "LIFECYCLE_SAMPLES_PER_STAGE", 2048)),
+            lifecycle_tolerance=_float(env, "LIFECYCLE_TOLERANCE", 0.05),
+            tsdb_raw_capacity=max(1, _int(env, "TSDB_RAW_CAPACITY", 512)),
+            tsdb_tier10_capacity=max(1, _int(
+                env, "TSDB_TIER10_CAPACITY", 1024)),
+            tsdb_tier60_capacity=max(1, _int(
+                env, "TSDB_TIER60_CAPACITY", 1024)),
+            tsdb_max_series=max(1, _int(env, "TSDB_MAX_SERIES", 256)),
         )
 
 

@@ -70,13 +70,16 @@ from kubeflow_tpu.core.notebook_controller import (  # noqa: E402
     setup_core_controllers,
 )
 from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager  # noqa: E402
+from kubeflow_tpu.utils import tracing  # noqa: E402
 from kubeflow_tpu.utils.clock import FakeClock  # noqa: E402
 from kubeflow_tpu.utils.config import CoreConfig  # noqa: E402
 from kubeflow_tpu.utils.flightrecorder import FlightRecorder  # noqa: E402
+from kubeflow_tpu.utils.lifecycle import LifecycleLedger  # noqa: E402
 from kubeflow_tpu.utils.slo import (  # noqa: E402
     SLOEngine,
     default_objectives,
 )
+from kubeflow_tpu.utils.tsdb import TimeSeriesStore  # noqa: E402
 
 NAMESPACE = "loadtest"
 
@@ -141,9 +144,22 @@ _WRITE_VERBS = ("create", "update", "patch", "delete")
 
 def run_fleet(count: int, workers: int, tpu: str = "",
               compute_state: bool = True) -> dict:
+    clock = FakeClock()
+    # span/recorder timestamps must share the manager's FakeClock, or the
+    # lifecycle ledger would attribute the wall-vs-fake clock skew to
+    # queue_wait (cause stamps come from the manager clock, span times
+    # from the tracer clock)
+    tracing.set_clock(clock)
+    try:
+        return _run_fleet(count, workers, tpu, compute_state, clock)
+    finally:
+        tracing.set_clock(None)
+
+
+def _run_fleet(count: int, workers: int, tpu: str,
+               compute_state: bool, clock: FakeClock) -> dict:
     api = ApiServer()
     cluster = FakeCluster(api)
-    clock = FakeClock()
     recorder = FlightRecorder(capacity=max(4096, count * 8),
                               max_objects=max(2048, count * 4))
     mgr = Manager(api, clock=clock, workers=workers,
@@ -159,6 +175,18 @@ def run_fleet(count: int, workers: int, tpu: str = "",
         clock=clock, recorder=recorder)
     mgr.slo_engine = slo_engine
     metrics.attach_slo(slo_engine)
+    # lifecycle stage ledger (critical-path attribution, conservation-
+    # gated below) + in-process TSDB (the p99-vs-time curve a diagnose
+    # bundle reconstructs offline); sized so EVERY notebook of the run is
+    # conservation-checked, not just an LRU window
+    ledger = LifecycleLedger(registry=metrics.registry,
+                             max_notebooks=max(4096, count),
+                             keep_conservation=max(4096, count))
+    mgr.lifecycle = ledger
+    metrics.attach_lifecycle(ledger)
+    tsdb = TimeSeriesStore()
+    mgr.tsdb = tsdb
+    metrics.attach_tsdb(tsdb, clock=clock)
 
     spec = None
     if tpu:
@@ -174,10 +202,23 @@ def run_fleet(count: int, workers: int, tpu: str = "",
 
     api.clear_audit_log()
     api.clear_verb_counts()
+    # the flood arrives in batches, each settled and scraped, so the TSDB
+    # holds a p99-vs-time curve (ready p99 climbing batch over batch) a
+    # diagnose bundle can reconstruct offline — one monolithic settle
+    # would leave a single point and no history
+    n_batches = min(8, count) or 1
     t0 = time.perf_counter()
-    for i in range(count):
-        api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE, tpu=spec).obj)
-    rollout_reconciles_total = mgr.settle(max_seconds=7200.0)
+    rollout_reconciles_total = 0
+    created = 0
+    for b in range(n_batches):
+        batch = count // n_batches + (1 if b < count % n_batches else 0)
+        for i in range(created, created + batch):
+            api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE, tpu=spec).obj)
+        created += batch
+        rollout_reconciles_total += mgr.settle(max_seconds=7200.0)
+        metrics.scrape()  # feeds one TSDB sample at this FakeClock instant
+        if b < n_batches - 1:
+            clock.advance(10.0)  # distinct timestamps across batches
     wall_s = time.perf_counter() - t0
 
     not_ready = []
@@ -235,6 +276,23 @@ def run_fleet(count: int, workers: int, tpu: str = "",
             f"per-key serialization violated: {len(overlaps)} overlapping "
             f"attempt pairs (first: {a.controller} {a.object_key})")
 
+    # conservation gate: every notebook's attributed stage durations must
+    # sum to its measured event->ready wall time within tolerance — the
+    # falsifiable contract of the lifecycle ledger (a double-count, gap
+    # misclassification, or leak across retries breaks the equality)
+    metrics.scrape()
+    cons = ledger.conservation()
+    if cons["finalized"] != count:
+        raise AssertionError(
+            f"lifecycle ledger finalized {cons['finalized']}/{count} "
+            "notebooks — some never saw a ready event or were evicted")
+    if cons["violations"]:
+        first = ledger.violations()[:3]
+        raise AssertionError(
+            f"stage attribution broke conservation for "
+            f"{cons['violations']}/{cons['checked']} notebooks "
+            f"(tolerance {cons['tolerance']:.0%}, first: {first})")
+
     # event->reconcile-start reaction latency (wall clock; the FakeClock
     # collapses the deterministic histogram to ~0 in this harness): exact
     # percentiles over every event-caused reconcile of the run
@@ -268,11 +326,39 @@ def run_fleet(count: int, workers: int, tpu: str = "",
         # the trajectory record carries a standing SLO verdict, not just
         # raw percentiles
         "slo": slo_engine.verdicts(),
+        # per-stage critical-path attribution + the conservation verdict
+        # (utils/lifecycle): where event->ready time actually went
+        "criticalpath": {
+            "ranking": ledger.ranking(),
+            "conservation": cons,
+        },
+        # TSDB inventory: the per-batch p99-vs-time history a diagnose
+        # bundle captures in full (/debug/timeline?dump=1)
+        "timeline_series": sorted(tsdb.series_names()),
     }
+    _print_criticalpath(f"{count} notebooks ({tpu or 'cpu'})",
+                        ledger.ranking())
     if compute_state:
         result["_state"] = normalized_state(api)
     mgr.stop()
     return result
+
+
+def _print_criticalpath(tag: str, ranking: list) -> None:
+    """The fleet-wide critical-path table (stderr; stdout carries the
+    machine-readable result JSON): which lifecycle stage the fleet
+    actually spent its event->ready time in, ranked."""
+    print(f"critical path [{tag}]:", file=sys.stderr)
+    if not ranking:
+        print("  (no stage time attributed — instantaneous "
+              "convergence on the fake clock)", file=sys.stderr)
+        return
+    print(f"  {'stage':<16} {'count':>7} {'total_s':>10} {'mean_s':>9} "
+          f"{'p99_s':>9} {'share':>7}", file=sys.stderr)
+    for r in ranking:
+        print(f"  {r['stage']:<16} {r['count']:>7} {r['total_s']:>10.3f} "
+              f"{r['mean_s']:>9.4f} {r['p99_s']:>9.4f} "
+              f"{r['share']:>6.1%}", file=sys.stderr)
 
 
 def _percentile(values: list[float], q: float) -> float:
@@ -361,6 +447,18 @@ def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
     reclamation resells its slices, a manager failover between waves 1
     and 2, and exact per-notebook ready-time measurement off the
     FakeClock."""
+    clock = FakeClock()
+    tracing.set_clock(clock)  # span times share the harness clock
+    try:
+        return _run_bursty(count, bursts, gap_s, tpu, warm_size,
+                           provision_s, failover, clock)
+    finally:
+        tracing.set_clock(None)
+
+
+def _run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
+                warm_size: int, provision_s: float, failover: bool,
+                clock: FakeClock) -> dict:
     from kubeflow_tpu.core import constants as C
     from kubeflow_tpu.core.metrics import NotebookMetrics
     from kubeflow_tpu.kube import retry_on_conflict
@@ -376,7 +474,11 @@ def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
     }
     api = ApiServer()
     cluster = FakeCluster(api)
-    clock = FakeClock()
+    # ONE ledger across the failover: the replacement manager adopts the
+    # same stage history, so conservation must survive the handoff (a
+    # leaked or double-counted stage across managers breaks the gate)
+    ledger = LifecycleLedger(max_notebooks=max(4096, count * bursts),
+                             keep_conservation=max(4096, count * bursts))
 
     def build() -> tuple[Manager, NotebookMetrics]:
         mgr = Manager(api, clock=clock,
@@ -385,6 +487,8 @@ def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
                           max_objects=max(2048, count * bursts * 4)))
         cfg = CoreConfig.from_env(env)
         metrics = NotebookMetrics(api, manager=mgr)
+        mgr.lifecycle = ledger
+        metrics.attach_lifecycle(ledger)
         setup_core_controllers(mgr, cfg, metrics, provisioner=cluster)
         return mgr, metrics
 
@@ -474,6 +578,16 @@ def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
         bypass += int(st.get("bypass", 0))
     served = hits + misses + bypass
     values = list(ready_s.values())
+    cons = ledger.conservation()
+    if cons["violations"]:
+        raise AssertionError(
+            f"bursty stage attribution broke conservation for "
+            f"{cons['violations']}/{cons['checked']} notebooks "
+            f"(first: {ledger.violations()[:3]})")
+    _print_criticalpath(
+        "%d notebooks %s (%s)" % (count * bursts, tpu,
+                                  "warm" if warm_size else "cold"),
+        ledger.ranking())
     mgr.stop()
     return {
         "mode": "warm" if warm_size else "cold",
@@ -491,6 +605,10 @@ def run_bursty(count: int, bursts: int, gap_s: float, tpu: str,
         "slice_utilization": utilization,
         "ready_histogram_count":
             metrics.notebook_ready_seconds.count_value(NAMESPACE),
+        "criticalpath": {
+            "ranking": ledger.ranking(),
+            "conservation": cons,
+        },
     }
 
 
@@ -510,9 +628,25 @@ def run_sharded_fleet(count: int, shards: int = 3,
     from kubeflow_tpu.main import build_sharded_fleet
 
     clock = FakeClock()
+    tracing.set_clock(clock)  # align span times with the fleet clock
+    try:
+        return _run_sharded_fleet(count, shards, kill_shard, clock)
+    finally:
+        tracing.set_clock(None)
+
+
+def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
+                       clock: FakeClock) -> dict:
+    from kubeflow_tpu.kube.shard import SHARD_MAP_KIND
+    from kubeflow_tpu.main import build_sharded_fleet
+
     cfg = CoreConfig.from_env({})  # hermetic: culling off, defaults only
+    # the shared lifecycle ledger must hold every pending notebook of the
+    # flood, or conservation can't be checked fleet-wide
+    cfg.lifecycle_max_notebooks = max(cfg.lifecycle_max_notebooks, count)
     fleet, api, cluster, metrics = build_sharded_fleet(
         core_cfg=cfg, count=shards, clock=clock)
+    ledger = metrics.lifecycle  # ONE ledger shared across all replicas
     cluster.add_node("cpu-node", allocatable={"cpu": str(count * 8),
                                               "memory": "8192Gi"})
 
@@ -532,6 +666,21 @@ def run_sharded_fleet(count: int, shards: int = 3,
     rollout_reconciles_total = fleet.settle()
     rollout_wall_s = time.perf_counter() - t0
     assert_converged("rollout")
+    metrics.scrape()  # one TSDB sample at rollout convergence
+
+    # conservation gate over the SHARED ledger: attempts from every
+    # replica (and handoff waits between them) must still partition each
+    # notebook's event->ready window exactly
+    cons = ledger.conservation()
+    if cons["finalized"] != count:
+        raise AssertionError(
+            f"sharded lifecycle ledger finalized {cons['finalized']}/"
+            f"{count} notebooks")
+    if cons["violations"]:
+        raise AssertionError(
+            f"sharded stage attribution broke conservation for "
+            f"{cons['violations']}/{cons['checked']} notebooks "
+            f"(first: {ledger.violations()[:3]})")
 
     snap = fleet.shard_snapshot()
     owned = {sid: r["keys_owned"]
@@ -617,7 +766,14 @@ def run_sharded_fleet(count: int, shards: int = 3,
         "cross_process_overlaps": 0,
         "steady_data_plane_writes": 0,
         "steady_heartbeat_writes": sum(heartbeat.values()),
+        "criticalpath": {
+            "ranking": ledger.ranking(),
+            "conservation": ledger.conservation(),
+        },
     }
+    metrics.scrape()  # post-kill/rejoin TSDB sample (clock moved on)
+    _print_criticalpath(f"{count} notebooks x {shards} shards",
+                        ledger.ranking())
     for r in fleet.replicas.values():
         r.manager.stop()
     return result
@@ -745,7 +901,17 @@ def main(argv=None) -> int:
                         "N-replica active-active fleet with a kill+rejoin "
                         "cycle; --check-budget reads the 'sharded' section "
                         "of the budget JSON")
+    parser.add_argument("--sweep", default="", metavar="N1,N2,...",
+                        help="scale sweep: run the fleet (sharded when "
+                        "--shards is set) at each point, print the "
+                        "per-stage critical-path table per point, record "
+                        "per-point stage attribution into --out, and "
+                        "budget-check the largest point — the "
+                        "where-does-it-bend curve")
     args = parser.parse_args(argv)
+
+    if args.sweep:
+        return _run_sweep(args)
 
     if args.shards:
         result = run_sharded_fleet(args.count, args.shards)
@@ -831,6 +997,53 @@ def main(argv=None) -> int:
     print(json.dumps(result))
     if args.out:
         Path(args.out).write_text(json.dumps(result, indent=2,
+                                             sort_keys=True) + "\n")
+    return rc
+
+
+def _run_sweep(args) -> int:
+    """`--sweep N1,N2,...`: the same fleet at increasing scale, one
+    critical-path table + attribution record per point.  The per-point
+    records land in --out so CI archives where each stage's contribution
+    starts to bend; the budget gates only the LARGEST point (the smaller
+    ones exist for the curve, not the ceiling)."""
+    points = sorted({int(x) for x in args.sweep.split(",") if x.strip()})
+    if not points:
+        print("SWEEP: no scale points parsed", file=sys.stderr)
+        return 1
+    sweep = []
+    for n in points:
+        if args.shards:
+            r = run_sharded_fleet(n, args.shards)
+        else:
+            r = run_fleet(n, args.workers, tpu=args.tpu,
+                          compute_state=False)
+            r.pop("_state", None)
+        sweep.append(r)
+    rc = 0
+    largest = sweep[-1]
+    if args.check_budget:
+        budget = json.loads(Path(args.check_budget).read_text())
+        if args.shards:
+            failures = check_shard_budget(largest,
+                                          budget.get("sharded", budget))
+        else:
+            failures = check_budget(largest, budget)
+        largest["budget_ok"] = not failures
+        for f in failures:
+            print(f"SWEEP BUDGET FAIL (count={largest['count']}): {f}",
+                  file=sys.stderr)
+            rc = 1
+    out = {
+        "mode": "sweep",
+        "points": points,
+        "shards": args.shards or 0,
+        "tpu": args.tpu or "cpu",
+        "sweep": sweep,
+    }
+    print(json.dumps(out))
+    if args.out:
+        Path(args.out).write_text(json.dumps(out, indent=2,
                                              sort_keys=True) + "\n")
     return rc
 

@@ -238,48 +238,69 @@ class TestChaosSoak:
         return kind
 
     def test_seeded_random_fault_soak(self, env):
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.lifecycle import LifecycleLedger
+
         api, cluster, mgr = env
-        nb = Notebook.new(
-            "soak", "user1", tpu=TPUSpec("v5e", "4x4"),
-            annotations={OC.ANNOTATION_INJECT_AUTH: "true"},
-        )
-        api.create(nb.obj)
-        mgr.run_until_idle()
-        assert_steady_state(api, "user1", "soak", self.EXPECTED_HOSTS)
-
-        print(f"\nchaos soak: seed={SOAK_SEED} rounds={SOAK_ROUNDS} "
-              "(reproduce with CHAOS_SOAK_SEED/CHAOS_SOAK_ROUNDS)")
-        rng = random.Random(SOAK_SEED)
-        total_faults = 0
-        for round_i in range(SOAK_ROUNDS):
-            plan_seed = rng.randrange(2**31)
-            plan = random_fault_plan(plan_seed, kinds=FAULT_KINDS,
-                                     clock=mgr.clock)
-            api.install_fault_plan(plan)
-            perturbation = self._perturb(rng, api, cluster, "soak")
-            with api.fault_exempt():
-                mgr.enqueue_all()
-            # converge WHILE faults fire (plans are bounded, so they drain)
-            mgr.settle(max_seconds=7200.0)
-            api.clear_fault_plan()
-            # faults cleared: one more level-triggered pass restores
-            # whatever the chaos window left behind
-            with api.fault_exempt():
-                mgr.enqueue_all()
-            mgr.settle(max_seconds=7200.0)
-
-            total_faults += len(plan.log)
-            assert not mgr.dropped_errors, (
-                f"round {round_i} (plan_seed={plan_seed}, "
-                f"perturb={perturbation}): retry budget exhausted: "
-                f"{mgr.dropped_errors}, injected={plan.summary()}")
+        # lifecycle conservation audit: every attempt the soak runs —
+        # including errored/retried ones — folds into the stage ledger,
+        # and the partition must stay exact under fault injection
+        # (registry=None: no histogram, pure bookkeeping)
+        ledger = LifecycleLedger()
+        mgr.lifecycle = ledger
+        tracing.set_clock(mgr.clock)
+        try:
+            nb = Notebook.new(
+                "soak", "user1", tpu=TPUSpec("v5e", "4x4"),
+                annotations={OC.ANNOTATION_INJECT_AUTH: "true"},
+            )
+            api.create(nb.obj)
+            mgr.run_until_idle()
             assert_steady_state(api, "user1", "soak", self.EXPECTED_HOSTS)
 
-        # the soak must actually have injected chaos to mean anything
-        assert total_faults > SOAK_ROUNDS, total_faults
-        # and in threaded mode (WORKQUEUE_WORKERS > 1) the worker pool must
-        # never have run two reconciles of one key concurrently
-        assert_no_concurrent_per_key_reconciles(mgr)
+            print(f"\nchaos soak: seed={SOAK_SEED} rounds={SOAK_ROUNDS} "
+                  "(reproduce with CHAOS_SOAK_SEED/CHAOS_SOAK_ROUNDS)")
+            rng = random.Random(SOAK_SEED)
+            total_faults = 0
+            for round_i in range(SOAK_ROUNDS):
+                plan_seed = rng.randrange(2**31)
+                plan = random_fault_plan(plan_seed, kinds=FAULT_KINDS,
+                                         clock=mgr.clock)
+                api.install_fault_plan(plan)
+                perturbation = self._perturb(rng, api, cluster, "soak")
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                # converge WHILE faults fire (plans are bounded, so they
+                # drain)
+                mgr.settle(max_seconds=7200.0)
+                api.clear_fault_plan()
+                # faults cleared: one more level-triggered pass restores
+                # whatever the chaos window left behind
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                mgr.settle(max_seconds=7200.0)
+
+                total_faults += len(plan.log)
+                assert not mgr.dropped_errors, (
+                    f"round {round_i} (plan_seed={plan_seed}, "
+                    f"perturb={perturbation}): retry budget exhausted: "
+                    f"{mgr.dropped_errors}, injected={plan.summary()}")
+                assert_steady_state(api, "user1", "soak",
+                                    self.EXPECTED_HOSTS)
+
+            # the soak must actually have injected chaos to mean anything
+            assert total_faults > SOAK_ROUNDS, total_faults
+            # and in threaded mode (WORKQUEUE_WORKERS > 1) the worker pool
+            # must never have run two reconciles of one key concurrently
+            assert_no_concurrent_per_key_reconciles(mgr)
+            # lifecycle conservation under chaos: the soak notebook's
+            # event->ready window finalized, its attributed stage time
+            # equals the measured wall time, and no retry double-counted
+            cons = ledger.conservation()
+            assert cons["finalized"] >= 1, cons
+            assert cons["violations"] == 0, ledger.violations()[:3]
+        finally:
+            tracing.set_clock(None)
 
     def test_trace_integrity_under_faults(self, env):
         """Observability acceptance: run soak rounds with a span exporter
@@ -433,7 +454,23 @@ class TestSliceRecoverySoak:
                      if c.get("type") == "RecoveryExhausted"), None)
 
     def test_recovery_soak_with_failover(self):
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.lifecycle import LifecycleLedger
+
         api, cluster, mgr, clock, cfg, metrics = self._env()
+        # ONE ledger across the failover (like a sharded fleet's shared
+        # ledger): the replacement manager keeps folding attempts into
+        # the same stage partition, and conservation must survive the
+        # handover plus every recovery excursion the soak provokes
+        ledger = LifecycleLedger()
+        mgr.lifecycle = ledger
+        tracing.set_clock(clock)
+        try:
+            self._recovery_soak_body(api, cluster, mgr, clock, ledger)
+        finally:
+            tracing.set_clock(None)
+
+    def _recovery_soak_body(self, api, cluster, mgr, clock, ledger):
         nb = Notebook.new("healsoak", "user1", tpu=TPUSpec("v5e", "4x4"))
         api.create(nb.obj)
         mgr.run_until_idle()
@@ -454,6 +491,7 @@ class TestSliceRecoverySoak:
                 mgr = Manager(api, clock=clock)
                 setup_core_controllers(mgr, CoreConfig(**self.CFG),
                                        NotebookMetrics(api))
+                mgr.lifecycle = ledger
                 with api.fault_exempt():
                     mgr.enqueue_all()
 
@@ -502,6 +540,13 @@ class TestSliceRecoverySoak:
         groups = self._assert_slice_atomic(api, "healsoak")
         assert groups > 0, "soak never exercised a recovery restart"
         assert_no_concurrent_per_key_reconciles(mgr)
+        # conservation across the failover: the notebook finalized once
+        # (ready is a per-generation event), the partition stayed exact,
+        # and every post-ready recovery round landed as an excursion
+        # instead of polluting the finalized window
+        cons = ledger.conservation()
+        assert cons["finalized"] >= 1, cons
+        assert cons["violations"] == 0, ledger.violations()[:3]
 
     def test_permanent_failure_exhausts_exactly_at_cap(self):
         api, cluster, mgr, clock, cfg, metrics = self._env()

@@ -1,0 +1,468 @@
+"""Lifecycle stage ledger: critical-path attribution of event->ready
+wall time (utils/lifecycle.py).
+
+The conservation contract is the spine of this suite: the ledger's
+partition of [cause_ts, ready_ts] must sum EXACTLY to the measured wall
+time — stages never overlap, never double-count, and never leak across
+retries, manager failover, shard handoff, or post-ready recover/migrate
+excursions.  Tests drive the ledger two ways: synthetic span trees built
+on the FakeClock (every boundary controlled to the microsecond), and the
+real Manager + controllers end-to-end (the feed path production runs)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+from kubeflow_tpu.utils.lifecycle import (
+    STAGES,
+    LifecycleLedger,
+    register_lifecycle_metrics,
+)
+from kubeflow_tpu.utils.metrics import Registry
+from kubeflow_tpu.utils.tracing import get_tracer
+
+
+@pytest.fixture()
+def clock():
+    c = FakeClock()
+    tracing.set_clock(c)
+    yield c
+    tracing.set_clock(None)
+
+
+class Harness:
+    """Feeds a ledger the way the Manager does: one finished root span +
+    its FlightRecorder AttemptRecord per reconcile attempt."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.tracer = get_tracer("lifecycle-test")
+        self.recorder = FlightRecorder()
+        self.ledger = LifecycleLedger()
+
+    def attempt(self, *, controller="notebook", ns="u1", name="nb", gen=1,
+                manager_id="", cause_ts=None, result="success",
+                body=None):
+        """Run one attempt NOW: `body(root)` executes inside the root span
+        (open phase spans, add events, advance the clock), then the
+        finished tree is fed to the ledger."""
+        attrs = {"controller": controller, "namespace": ns, "name": name,
+                 "generation": gen}
+        if cause_ts is not None:
+            attrs["cause_ts"] = cause_ts
+        with self.tracer.start_span("reconcile", attrs) as root:
+            if body is not None:
+                body(root)
+            root.set_attribute("reconcile.result", result)
+        rec = self.recorder.record(root)
+        self.ledger.observe_attempt(rec, root, manager_id)
+        return root
+
+    def phase(self, phase, seconds, events=()):
+        """A body step: one phase child span spanning `seconds`, with
+        optional (event_name, attrs) pairs added inside it."""
+        with self.tracer.start_span(phase, {"phase": phase}) as span:
+            for ev_name, ev_attrs in events:
+                span.add_event(ev_name, ev_attrs)
+            self.clock.advance(seconds)
+
+    def entry(self, ns="u1", name="nb", gen=1):
+        return self.ledger.entry(ns, name, gen)
+
+
+def assert_conserved(entry):
+    """The falsifiability check, exact: the stage partition sums to the
+    measured wall time and no stage is negative."""
+    assert entry["finalized"]
+    assert all(d >= 0.0 for d in entry["stages"].values()), entry["stages"]
+    assert sum(entry["stages"].values()) == pytest.approx(
+        entry["wall_s"], abs=1e-9), entry
+
+
+class TestConservingPartition:
+    def test_single_attempt_partitions_exactly(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+        clock.advance(3.0)  # sat in the workqueue
+
+        def body(root):
+            h.phase("render", 0.5)
+            h.phase("apply", 1.0)
+            clock.advance(0.25)  # un-phased reconcile work
+            root.add_event("notebook.ready", {"seconds": 4.75})
+
+        h.attempt(cause_ts=cause, body=body)
+        e = h.entry()
+        assert_conserved(e)
+        assert e["wall_s"] == pytest.approx(4.75)
+        assert e["stages"]["queue_wait"] == pytest.approx(3.0)
+        assert e["stages"]["render"] == pytest.approx(0.5)
+        assert e["stages"]["apply"] == pytest.approx(1.0)
+        assert e["stages"]["reconcile_other"] == pytest.approx(0.25)
+        cons = h.ledger.conservation()
+        assert cons["finalized"] == 1 and cons["violations"] == 0
+        assert cons["max_rel_err"] == 0.0
+
+    def test_retry_gap_is_backoff_never_double_counted(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+
+        h.attempt(cause_ts=cause, result="error",
+                  body=lambda root: h.phase("render", 0.5))
+        clock.advance(2.0)  # backoff between attempts
+        h.attempt(cause_ts=cause, result="error",
+                  body=lambda root: h.phase("render", 0.5))
+        clock.advance(4.0)  # second, longer backoff
+
+        def final(root):
+            h.phase("render", 0.5)
+            h.phase("apply", 1.0)
+            root.add_event("notebook.ready", {})
+
+        h.attempt(cause_ts=cause, body=final)
+        e = h.entry()
+        assert_conserved(e)
+        # three render phases of 0.5s each: counted once apiece, not
+        # re-summed per retry
+        assert e["stages"]["render"] == pytest.approx(1.5)
+        assert e["stages"]["retry_backoff"] == pytest.approx(6.0)
+        assert e["stages"]["apply"] == pytest.approx(1.0)
+
+    def test_pod_wait_gaps_follow_the_waiting_hint(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+
+        h.attempt(cause_ts=cause, body=lambda root: root.add_event(
+            "notebook.waiting", {"on": "pod_schedule", "ready": 0}))
+        clock.advance(5.0)  # kube-scheduler binding the gang
+        h.attempt(cause_ts=cause, body=lambda root: root.add_event(
+            "notebook.waiting", {"on": "pod_start", "ready": 1}))
+        clock.advance(7.0)  # image pull / container start
+        h.attempt(cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        e = h.entry()
+        assert_conserved(e)
+        assert e["stages"]["pod_schedule"] == pytest.approx(5.0)
+        assert e["stages"]["pod_start"] == pytest.approx(7.0)
+
+    def test_warm_vs_cold_resolution(self, clock):
+        h = Harness(clock)
+        # cold: the scheduler's wait event marks provisioning
+        cause = clock.now()
+        h.attempt(controller="slice-scheduler", cause_ts=cause,
+                  body=lambda root: h.phase(
+                      "schedule", 0.0,
+                      events=[("schedule.wait",
+                               {"reason": "provisioning"})]))
+        clock.advance(120.0)
+        h.attempt(cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        cold = h.entry()
+        assert_conserved(cold)
+        assert cold["stages"]["schedule_cold"] == pytest.approx(120.0)
+        assert "schedule_warm" not in cold["stages"]
+
+        # warm: same shape, no wait event -> the pool hit path
+        cause2 = clock.now()
+        h.attempt(name="nb2", controller="slice-scheduler", cause_ts=cause2,
+                  body=lambda root: h.phase("schedule", 0.5))
+        clock.advance(1.0)
+        h.attempt(name="nb2", cause_ts=cause2,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        warm = h.entry(name="nb2")
+        assert_conserved(warm)
+        # the schedule phase itself resolves warm; the idle gap after a
+        # placed (non-waiting) attempt stays queue_wait
+        assert warm["stages"]["schedule_warm"] == pytest.approx(0.5)
+        assert warm["stages"]["queue_wait"] == pytest.approx(1.0)
+        assert "schedule_cold" not in warm["stages"]
+
+    def test_overlapping_controller_windows_are_clipped(self, clock):
+        """Per-key serialization is per (controller, key): a notebook and
+        a slice-scheduler attempt CAN overlap in real time.  The watermark
+        sweep must clip the overlap instead of double-counting it."""
+        from types import SimpleNamespace
+
+        from kubeflow_tpu.utils.tracing import Span
+
+        ledger = LifecycleLedger()
+        t0 = 1000.0
+
+        def feed(controller, start, end, ready_ts=None):
+            root = Span(name="reconcile", attributes={
+                "controller": controller, "namespace": "u1", "name": "nb",
+                "generation": 1, "cause_ts": t0,
+            }, start_time=start, end_time=end, trace_id="ab" * 16)
+            if ready_ts is not None:
+                root.events.append(
+                    tracing.SpanEvent("notebook.ready", {}, ready_ts))
+            rec = SimpleNamespace(start_time=start, end_time=end,
+                                  trace_id=root.trace_id, result="success")
+            ledger.observe_attempt(rec, root, "")
+
+        feed("notebook", t0 + 1.0, t0 + 5.0)
+        feed("slice-scheduler", t0 + 2.0, t0 + 4.0)  # inside the first
+        feed("notebook", t0 + 6.0, t0 + 8.0, ready_ts=t0 + 8.0)
+        e = ledger.entry("u1", "nb", 1)
+        assert e["finalized"]
+        # wall = 8s; the nested scheduler window must not inflate it
+        assert e["wall_s"] == pytest.approx(8.0)
+        assert sum(e["stages"].values()) == pytest.approx(8.0, abs=1e-9)
+
+    def test_zero_wall_time_conserves_trivially(self, clock):
+        h = Harness(clock)
+        h.attempt(cause_ts=clock.now(),
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        e = h.entry()
+        assert e["finalized"] and e["wall_s"] == 0.0
+        assert h.ledger.conservation()["violations"] == 0
+
+
+class TestIsolationAndBounds:
+    def test_generation_keying_isolates_spec_updates(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+        clock.advance(1.0)
+        h.attempt(gen=1, cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        # spec update: a new generation opens a FRESH entry
+        cause2 = clock.now()
+        clock.advance(2.0)
+        h.attempt(gen=2, cause_ts=cause2,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        e1, e2 = h.entry(gen=1), h.entry(gen=2)
+        assert e1["finalized"] and e2["finalized"]
+        assert e1["wall_s"] == pytest.approx(1.0)
+        assert e2["wall_s"] == pytest.approx(2.0)
+        assert h.ledger.conservation()["finalized"] == 2
+
+    def test_generation_falls_back_to_last_observed(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+        h.attempt(gen=3, cause_ts=cause)
+        clock.advance(1.0)
+        # a stale-cache attempt without the generation attr joins the
+        # latest entry instead of opening a phantom gen-1 ledger
+        h.attempt(gen=0, cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        assert h.entry(gen=3)["finalized"]
+        assert h.entry(gen=1) is None
+
+    def test_untracked_controllers_are_ignored(self, clock):
+        h = Harness(clock)
+        h.attempt(controller="event-reemit")
+        h.attempt(controller="warm-pool")
+        assert h.ledger.pending_count() == 0
+
+    def test_lru_bound_holds(self, clock):
+        h = Harness(clock)
+        h.ledger.max_notebooks = 4
+        for i in range(10):
+            h.attempt(name=f"nb-{i}")
+        assert h.ledger.pending_count() == 4
+        assert h.entry(name="nb-0") is None
+        assert h.entry(name="nb-9") is not None
+
+    def test_excursions_do_not_touch_conservation(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+        clock.advance(1.0)
+        h.attempt(cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        before = h.ledger.conservation()
+
+        # post-ready self-healing: recover + migrate work lands in the
+        # stage histograms but NOT in the conserved window
+        h.attempt(body=lambda root: h.phase("recover", 2.5))
+        h.attempt(body=lambda root: h.phase("migrate", 1.5))
+        # a plain post-ready reconcile is not an excursion at all
+        h.attempt(body=lambda root: h.phase("status", 0.1))
+
+        after = h.ledger.conservation()
+        assert after == before  # wall/attributed untouched
+        assert h.ledger.excursions_total == 2
+        ranked = {r["stage"]: r for r in h.ledger.ranking()}
+        assert ranked["recover"]["total_s"] == pytest.approx(2.5)
+        assert ranked["migrate"]["total_s"] == pytest.approx(1.5)
+        assert "status" not in ranked or \
+            ranked["status"]["total_s"] == pytest.approx(0.0)
+
+
+class TestHandoffAndFailover:
+    def test_manager_id_change_marks_handoff_wait(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+        h.attempt(manager_id="shard-0", cause_ts=cause)
+        clock.advance(9.0)  # dead shard's lease aging + adoption
+        h.attempt(manager_id="shard-1", cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        e = h.entry()
+        assert_conserved(e)
+        assert e["stages"]["handoff_wait"] == pytest.approx(9.0)
+
+    def test_same_manager_gap_is_not_handoff(self, clock):
+        h = Harness(clock)
+        cause = clock.now()
+        h.attempt(manager_id="shard-0", cause_ts=cause)
+        clock.advance(9.0)
+        h.attempt(manager_id="shard-0", cause_ts=cause,
+                  body=lambda root: root.add_event("notebook.ready", {}))
+        e = h.entry()
+        assert_conserved(e)
+        assert "handoff_wait" not in e["stages"]
+        assert e["stages"]["queue_wait"] == pytest.approx(9.0)
+
+    def test_ledger_survives_manager_failover(self, clock):
+        """run_bursty's failover shape: attempts from manager A, a
+        replacement manager B adopting the SAME ledger mid-lifecycle —
+        conservation must hold across the seam."""
+        h = Harness(clock)
+        cause = clock.now()
+        h.attempt(cause_ts=cause, result="requeue")  # A sees it first
+        clock.advance(3.0)
+        # "failover": a new harness shares the ledger (fresh recorder +
+        # tracer, like a fresh Manager)
+        h2 = Harness(clock)
+        h2.ledger = h.ledger
+        h2.attempt(cause_ts=cause,
+                   body=lambda root: root.add_event("notebook.ready", {}))
+        e = h2.entry()
+        assert_conserved(e)
+        assert e["stages"]["retry_backoff"] == pytest.approx(3.0)
+        assert h.ledger.conservation()["violations"] == 0
+
+
+class TestReadSide:
+    def test_ranking_shares_sum_to_one(self, clock):
+        h = Harness(clock)
+        for i in range(3):
+            cause = clock.now()
+            clock.advance(float(i + 1))
+            h.attempt(name=f"nb-{i}", cause_ts=cause,
+                      body=lambda root: root.add_event("notebook.ready", {}))
+        ranking = h.ledger.ranking()
+        assert ranking and ranking[0]["stage"] == "queue_wait"
+        assert sum(r["share"] for r in ranking) == pytest.approx(1.0)
+        assert ranking[0]["p99_s"] == pytest.approx(3.0)
+        # every exported stage is in the closed vocabulary
+        assert all(r["stage"] in STAGES for r in ranking)
+
+    def test_namespace_rollup(self, clock):
+        h = Harness(clock)
+        for ns, wait in (("team-a", 2.0), ("team-b", 6.0)):
+            cause = clock.now()
+            clock.advance(wait)
+            h.attempt(ns=ns, cause_ts=cause,
+                      body=lambda root: root.add_event("notebook.ready", {}))
+        roll = h.ledger.namespace_rollup()
+        assert roll["team-a"]["ready_mean_s"] == pytest.approx(2.0)
+        assert roll["team-b"]["ready_p99_s"] == pytest.approx(6.0)
+        assert roll["team-b"]["stages"]["queue_wait"]["total_s"] == \
+            pytest.approx(6.0)
+
+    def test_snapshot_shape(self, clock):
+        h = Harness(clock)
+        h.attempt(body=lambda root: root.add_event("notebook.ready", {}))
+        snap = h.ledger.snapshot()
+        assert snap["stages"] == list(STAGES)
+        assert snap["conservation"]["finalized"] == 1
+        assert snap["violations"] == []
+        assert snap["pending"] == 0
+        assert "max_notebooks" in snap["bounds"]
+
+    def test_histogram_exemplar_carries_trace_id(self, clock):
+        registry = Registry()
+        h = Harness(clock)
+        h.ledger = LifecycleLedger(registry=registry)
+        cause = clock.now()
+        clock.advance(2.0)
+        root = h.attempt(cause_ts=cause, body=lambda r: r.add_event(
+            "notebook.ready", {}))
+        hist = registry.get("notebook_stage_duration_seconds")
+        ex = hist.exemplar("queue_wait")
+        (labels, value), = [v for v in ex.values() if v is not None] or [
+            (None, None)]
+        assert labels == {"trace_id": root.trace_id}
+        assert value == pytest.approx(2.0)
+        # the exemplar's trace resolves in the flight recorder -- the
+        # /debug/traces contract
+        assert h.recorder.trace(root.trace_id) is not None
+
+    def test_register_twice_returns_same_family(self):
+        registry = Registry()
+        assert register_lifecycle_metrics(registry) is \
+            register_lifecycle_metrics(registry)
+
+
+class TestEndToEnd:
+    """The production feed path: real Manager + controllers on the
+    FakeClock, the ledger fed from the reconcile loop itself."""
+
+    def _stack(self, clock, cfg=None):
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        mgr = Manager(api, clock=clock)
+        cfg = cfg or CoreConfig()
+        metrics = NotebookMetrics(api, manager=mgr)
+        ledger = LifecycleLedger(registry=metrics.registry)
+        mgr.lifecycle = ledger
+        metrics.attach_lifecycle(ledger)
+        setup_core_controllers(mgr, cfg, metrics, provisioner=cluster)
+        return api, cluster, mgr, metrics, ledger
+
+    def test_cpu_notebook_finalizes_and_conserves(self, clock):
+        api, cluster, mgr, metrics, ledger = self._stack(clock)
+        cluster.add_node("n1", allocatable={"cpu": "64", "memory": "64Gi"})
+        api.create(Notebook.new("nb-e2e", "u1").obj)
+        mgr.settle(max_seconds=60)
+        cons = ledger.conservation()
+        assert cons["finalized"] == 1 and cons["violations"] == 0
+        e = ledger.entry("u1", "nb-e2e", 1)
+        assert_conserved(e)
+        mgr.stop()
+
+    def test_cold_provisioning_attributed_schedule_cold(self, clock):
+        cfg = CoreConfig(enable_slice_scheduler=True)
+        api, cluster, mgr, metrics, ledger = self._stack(clock, cfg)
+        spec = TPUSpec(accelerator="v5e", topology="2x4", slices=1)
+        api.create(Notebook.new("nb-tpu", "u1", tpu=spec).obj)
+        mgr.settle(max_seconds=600)
+        e = ledger.entry("u1", "nb-tpu", 1)
+        assert_conserved(e)
+        # the dominant stage of a cold boot is provisioning, split out
+        # from warm hits exactly as /debug/criticalpath reports it
+        assert e["stages"]["schedule_cold"] > 0.0
+        top = max(e["stages"], key=e["stages"].get)
+        assert top == "schedule_cold", e["stages"]
+        # and the scrape carries the histogram family with samples
+        scrape = metrics.scrape()
+        assert "notebook_stage_duration_seconds_bucket" in scrape
+        mgr.stop()
+
+    def test_spec_update_opens_new_generation_entry(self, clock):
+        api, cluster, mgr, metrics, ledger = self._stack(clock)
+        cluster.add_node("n1", allocatable={"cpu": "64", "memory": "64Gi"})
+        api.create(Notebook.new("nb-gen", "u1").obj)
+        mgr.settle(max_seconds=60)
+        assert ledger.entry("u1", "nb-gen", 1)["finalized"]
+
+        live = api.get("Notebook", "u1", "nb-gen")
+        live.body["spec"]["podSpec"] = {"containers": [
+            {"name": "notebook", "image": "jupyter:next"}]}
+        api.update(live)
+        mgr.settle(max_seconds=60)
+        gen = int(api.get("Notebook", "u1",
+                          "nb-gen").metadata.generation or 1)
+        assert gen > 1
+        e2 = ledger.entry("u1", "nb-gen", gen)
+        assert e2 is not None and e2["finalized"]
+        assert ledger.conservation()["violations"] == 0
+        mgr.stop()

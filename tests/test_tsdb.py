@@ -1,0 +1,167 @@
+"""Unit suite for the bounded downsampling time-series store.
+
+The TSDB is the "a loadtest is a curve, not a point" half of the
+observability work: fed once per NotebookMetrics.scrape(), queried at
+/debug/timeline, and captured wholesale into the ops/diagnose bundle.
+These tests pin the fold-at-append bucket math, every capacity bound,
+the never-raise read side, and the dump/snapshot shapes the smoke
+script and bundle consumers assert against.
+"""
+
+import math
+
+import pytest
+
+from kubeflow_tpu.utils.tsdb import TIERS, TimeSeriesStore
+
+
+class TestFolding:
+    def test_raw_points_preserved_in_order(self):
+        store = TimeSeriesStore()
+        for i in range(5):
+            store.sample(float(i), {"q": float(i * 10)})
+        q = store.query("q", tier="raw")
+        assert q["points"] == [[0.0, 0.0], [1.0, 10.0], [2.0, 20.0],
+                               [3.0, 30.0], [4.0, 40.0]]
+        assert "error" not in q
+
+    def test_tier_bucket_keys_floor_to_width(self):
+        store = TimeSeriesStore()
+        # 3.0 and 9.9 share the [0,10) bucket; 10.0 opens the next one.
+        store.sample(3.0, {"q": 1.0})
+        store.sample(9.9, {"q": 2.0})
+        store.sample(10.0, {"q": 3.0})
+        ten = store.query("q", tier="10s")["points"]
+        assert [b["t"] for b in ten] == [0.0, 10.0]
+        # all three fold into one 60s bucket
+        sixty = store.query("q", tier="60s")["points"]
+        assert [b["t"] for b in sixty] == [0.0]
+        assert sixty[0]["count"] == 3
+
+    def test_bucket_aggregates(self):
+        store = TimeSeriesStore()
+        for v in (4.0, 1.0, 7.0):
+            store.sample(12.0, {"q": v})
+        (b,) = store.query("q", tier="10s")["points"]
+        assert b["count"] == 3
+        assert b["sum"] == 12.0
+        assert b["min"] == 1.0
+        assert b["max"] == 7.0
+        assert b["last"] == 7.0
+        assert b["mean"] == pytest.approx(4.0)
+
+    def test_mean_is_derived_not_stored(self):
+        store = TimeSeriesStore()
+        store.sample(0.0, {"q": 2.0})
+        store.sample(1.0, {"q": 4.0})
+        # dump() returns the stored bucket (no mean); query() derives it
+        raw_bucket = store.dump()["series"]["q"]["10s"][0]
+        assert "mean" not in raw_bucket
+        assert store.query("q", tier="10s")["points"][0]["mean"] == 3.0
+
+    def test_multiple_series_fold_independently(self):
+        store = TimeSeriesStore()
+        store.sample(0.0, {"a": 1.0, "b": 100.0})
+        store.sample(5.0, {"a": 3.0})
+        assert store.series_names() == ["a", "b"]
+        assert store.query("a", tier="10s")["points"][0]["count"] == 2
+        assert store.query("b", tier="10s")["points"][0]["count"] == 1
+
+
+class TestBounds:
+    def test_raw_ring_is_bounded_but_tiers_keep_folding(self):
+        store = TimeSeriesStore(raw_capacity=4)
+        for i in range(10):
+            store.sample(float(i), {"q": float(i)})
+        q = store.query("q", tier="raw")
+        # only the newest raw_capacity points survive ...
+        assert q["points"] == [[6.0, 6.0], [7.0, 7.0], [8.0, 8.0],
+                               [9.0, 9.0]]
+        # ... but every sample still reached the downsampled tiers
+        (b,) = store.query("q", tier="10s")["points"]
+        assert b["count"] == 10 and b["sum"] == 45.0
+        assert store.samples_total == 10
+
+    def test_tier_rings_are_bounded(self):
+        store = TimeSeriesStore(tier10_capacity=3)
+        for i in range(6):  # six distinct 10s buckets
+            store.sample(i * 10.0, {"q": 1.0})
+        ten = store.query("q", tier="10s")["points"]
+        assert [b["t"] for b in ten] == [30.0, 40.0, 50.0]
+
+    def test_max_series_cap_counts_drops(self):
+        store = TimeSeriesStore(max_series=2)
+        store.sample(0.0, {"a": 1.0, "b": 2.0, "c": 3.0, "d": 4.0})
+        assert store.series_names() == ["a", "b"]
+        assert store.dropped_series_total == 2
+        # existing series keep accepting samples under the cap
+        store.sample(1.0, {"a": 5.0, "c": 6.0})
+        assert len(store.query("a", tier="raw")["points"]) == 2
+        assert store.dropped_series_total == 3
+
+    def test_non_finite_and_non_numeric_skipped(self):
+        store = TimeSeriesStore()
+        store.sample(0.0, {"q": float("nan"), "r": float("inf"),
+                           "s": float("-inf"), "t": "not-a-number",
+                           "u": None, "ok": "2.5"})
+        # only the coercible finite value landed; nothing else created
+        # a series or counted as a sample
+        assert store.series_names() == ["ok"]
+        assert store.query("ok", tier="raw")["points"] == [[0.0, 2.5]]
+        assert store.samples_total == 1
+
+
+class TestReadSide:
+    def test_unknown_series_yields_error_dict(self):
+        store = TimeSeriesStore()
+        q = store.query("missing", tier="raw")
+        assert q == {"series": "missing", "tier": "raw", "points": [],
+                     "error": "unknown series"}
+
+    def test_unknown_tier_yields_error_dict(self):
+        store = TimeSeriesStore()
+        store.sample(0.0, {"q": 1.0})
+        q = store.query("q", tier="5m")
+        assert q["points"] == [] and "unknown tier" in q["error"]
+
+    def test_dump_shape(self):
+        store = TimeSeriesStore(raw_capacity=8, tier10_capacity=9,
+                                tier60_capacity=10, max_series=11)
+        store.sample(0.0, {"q": 1.0})
+        d = store.dump()
+        assert d["samples_total"] == 1
+        assert d["dropped_series_total"] == 0
+        assert d["bounds"] == {"raw_capacity": 8, "tier10_capacity": 9,
+                               "tier60_capacity": 10, "max_series": 11}
+        assert set(d["series"]["q"]) == {"raw", "10s", "60s"}
+        assert d["series"]["q"]["raw"] == [[0.0, 1.0]]
+
+    def test_dump_is_a_copy(self):
+        store = TimeSeriesStore()
+        store.sample(0.0, {"q": 1.0})
+        d = store.dump()
+        d["series"]["q"]["10s"][0]["sum"] = math.pi
+        assert store.query("q", tier="10s")["points"][0]["sum"] == 1.0
+
+    def test_snapshot_inventory(self):
+        store = TimeSeriesStore()
+        for i in range(3):
+            store.sample(i * 60.0, {"q": 1.0})
+        snap = store.snapshot()
+        assert snap["tiers"] == ["raw", "10s", "60s"]
+        assert list(TIERS) == snap["tiers"]
+        assert snap["samples_total"] == 3
+        assert snap["series"]["q"] == {"raw_points": 3, "10s_buckets": 3,
+                                       "60s_buckets": 3}
+
+    def test_clear_resets_everything(self):
+        store = TimeSeriesStore(max_series=1)
+        store.sample(0.0, {"a": 1.0, "b": 2.0})
+        assert store.dropped_series_total == 1
+        store.clear()
+        assert store.series_names() == []
+        assert store.samples_total == 0
+        assert store.dropped_series_total == 0
+        # the name space is reusable after clear
+        store.sample(0.0, {"b": 2.0})
+        assert store.series_names() == ["b"]
