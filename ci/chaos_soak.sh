@@ -22,7 +22,13 @@
 # cross-process double-reconciles over the MERGED flight-recorder
 # histories, every zombie write fenced and counted, epoch strictly
 # monotonic, and per-replica diagnose bundles merged offline agreeing
-# with the in-process sweep).  All driven on the
+# with the in-process sweep), plus the failover lane
+# (TestFailoverSoak: seeded primary-gang kills under control-plane
+# partitions against a spec.replication notebook — every round must
+# promote the warm follower with zero kernel-state loss and exactly one
+# epoch bump, fence the demoted zombie's writes, and keep the promotion
+# p99 at least 5x below the snapshot->restore baseline and under the
+# ci/fleet_budget.json "failover" ceiling).  All driven on the
 # FakeClock so wall time stays in seconds regardless of how much backoff
 # the injected faults provoke.
 #
@@ -38,6 +44,7 @@ ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
 SHARD_ROUNDS="${SHARD_SOAK_ROUNDS:-10}"
 HEAL_ROUNDS="${SELFHEAL_SOAK_ROUNDS:-16}"
 MIGRATE_ROUNDS="${MIGRATE_SOAK_ROUNDS:-12}"
+FAILOVER_ROUNDS="${FAILOVER_SOAK_ROUNDS:-50}"
 SEED="${CHAOS_SOAK_SEED:-20260804}"
 # the CI soak runs the manager with a parallel worker pool: the invariants
 # (steady state restored, slice-atomic restarts, fault<->span pairing) must
@@ -54,24 +61,25 @@ if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} shard_rounds=${SHARD_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} shard_rounds=${SHARD_ROUNDS} failover_rounds=${FAILOVER_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
     SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" MIGRATE_SOAK_ROUNDS="$MIGRATE_ROUNDS" \
-    SHARD_SOAK_ROUNDS="$SHARD_ROUNDS" \
+    SHARD_SOAK_ROUNDS="$SHARD_ROUNDS" FAILOVER_SOAK_ROUNDS="$FAILOVER_ROUNDS" \
     WORKQUEUE_WORKERS="$WORKERS" INVARIANTS_STRICT="$STRICT" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
       tests/test_chaos.py::TestSliceRecoverySoak \
       tests/test_chaos.py::TestMigrationRecoverySoak \
       tests/test_chaos.py::TestFleetSLOSoak \
-      tests/test_chaos.py::TestShardKillRejoinSoak -q "$@"; then
+      tests/test_chaos.py::TestShardKillRejoinSoak \
+      tests/test_chaos.py::TestFailoverSoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
   echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
   echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} MIGRATE_SOAK_ROUNDS=${MIGRATE_ROUNDS} \\" >&2
-  echo "    SHARD_SOAK_ROUNDS=${SHARD_ROUNDS} \\" >&2
+  echo "    SHARD_SOAK_ROUNDS=${SHARD_ROUNDS} FAILOVER_SOAK_ROUNDS=${FAILOVER_ROUNDS} \\" >&2
   echo "    WORKQUEUE_WORKERS=${WORKERS} ci/chaos_soak.sh" >&2
   exit 1
 fi
-echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, shard_rounds=${SHARD_ROUNDS}, workers=${WORKERS})"
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, shard_rounds=${SHARD_ROUNDS}, failover_rounds=${FAILOVER_ROUNDS}, workers=${WORKERS})"
 
 # INTERLEAVE_DEEP=1: re-run the schedule-exploring protocol tests
 # (tests/test_interleave.py) with a much larger enumeration budget than

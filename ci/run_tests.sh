@@ -78,8 +78,10 @@ else
 fi
 # seeded chaos soaks at the CI round counts (the in-suite run above
 # already did the default rounds; this prints a reproducible seed line
-# and runs a deeper sweep of both the fault soak and the self-healing
-# recovery soak — all FakeClock-driven, seconds of wall time)
+# and runs a deeper sweep of the fault soak, the self-healing recovery
+# soak, and the replicated-kernel failover lane gated against the
+# ci/fleet_budget.json "failover" promotion-p99 ceiling — all
+# FakeClock-driven, seconds of wall time)
 if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   bash ci/chaos_soak.sh
   # bench trajectory: the newest measured headline MFU must stay within

@@ -79,6 +79,37 @@ class TPUSpec:
         return self.validate()
 
 
+@dataclass(frozen=True)
+class ReplicationSpec:
+    """Optional `spec.replication` block: run `replicas` copies of the
+    kernel gang — one primary plus replicas-1 followers continuously
+    restored from the primary's checkpoint-delta stream
+    (core/sessionstate.py) — so slice failure promotes a caught-up
+    follower (core/selfheal.py) instead of paying a snapshot -> reschedule
+    -> restore cycle.  `anti_affine` keeps replica gangs on disjoint node
+    pools so one pool failure cannot take both copies (core/scheduler.py
+    enforces it at placement time)."""
+
+    replicas: int = 2
+    anti_affine: bool = True
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicationSpec":
+        return cls(
+            replicas=int(d.get("replicas", 2)),
+            anti_affine=bool(d.get("antiAffine", True)),
+        )
+
+    def to_dict(self) -> dict:
+        return {"replicas": self.replicas, "antiAffine": self.anti_affine}
+
+    def validate(self) -> None:
+        if self.replicas < 2:
+            raise InvalidError("spec.replication.replicas must be >= 2")
+        if self.replicas > 8:
+            raise InvalidError("spec.replication.replicas must be <= 8")
+
+
 class Notebook:
     """Typed view over a Notebook KubeObject (any API version)."""
 
@@ -98,10 +129,13 @@ class Notebook:
         version: str = STORAGE_VERSION,
         labels: Optional[dict] = None,
         annotations: Optional[dict] = None,
+        replication: Optional[ReplicationSpec] = None,
     ) -> "Notebook":
         spec: dict = {"template": {"spec": pod_spec or {"containers": [{"name": name}]}}}
         if tpu is not None:
             spec["tpu"] = tpu.to_dict()
+        if replication is not None:
+            spec["replication"] = replication.to_dict()
         return cls(
             KubeObject(
                 api_version=f"{GROUP}/{version}",
@@ -143,6 +177,11 @@ class Notebook:
         return TPUSpec.from_dict(d) if d else None
 
     @property
+    def replication(self) -> Optional["ReplicationSpec"]:
+        d = self.obj.spec.get("replication")
+        return ReplicationSpec.from_dict(d) if d else None
+
+    @property
     def status(self) -> dict:
         return self.obj.status
 
@@ -152,6 +191,12 @@ class Notebook:
             raise InvalidError("spec.template.spec.containers must be non-empty")
         if self.tpu is not None:
             self.tpu.validate()
+        if self.replication is not None:
+            if self.tpu is None:
+                raise InvalidError(
+                    "spec.replication requires spec.tpu (replicated CPU "
+                    "notebooks are not supported)")
+            self.replication.validate()
 
     # -- conversion machinery -------------------------------------------------
     def convert_to(self, version: str) -> "Notebook":
@@ -196,6 +241,7 @@ def notebook_status(
     slice_health: Optional[str] = None,
     slice_recovery: Optional[dict] = None,
     session_state: Optional[dict] = None,
+    replication: Optional[dict] = None,
 ) -> dict:
     """NotebookStatus shape: reference fields (conditions/readyReplicas/
     containerState, api/v1/notebook_types.go:37-45) + TPU extensions.
@@ -210,7 +256,15 @@ def notebook_status(
     migrate verb's write-ahead restore intent: which checkpoint generation
     the recreated slice must restore, stamped BEFORE the restart so a
     manager failover mid-migration resumes the restore instead of
-    forgetting it (core/selfheal.py owns the mutations)."""
+    forgetting it (core/selfheal.py owns the mutations).
+
+    `replication` (status.replication) is the replicated-kernel tier's
+    authority record: the fencing epoch, the current primary replica
+    index, follower catch-up freshness, and — while a promotion is in
+    flight — the write-ahead promotion record.  The epoch is bumped in
+    the same commit that writes the promotion record, so a demoted
+    primary's writes are fenced before the new primary is named
+    (core/selfheal.py owns the mutations)."""
     status = {
         "conditions": conditions,
         "readyReplicas": ready_replicas,
@@ -224,4 +278,6 @@ def notebook_status(
         status["sliceRecovery"] = copy.deepcopy(slice_recovery)
     if session_state:
         status["sessionState"] = copy.deepcopy(session_state)
+    if replication:
+        status["replication"] = copy.deepcopy(replication)
     return status

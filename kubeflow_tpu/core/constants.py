@@ -41,6 +41,14 @@ ANNOTATION_RESTORED_DIGEST = "notebooks.kubeflow.org/restored-digest"
 # _propagated_annotations never copies it onto pods.
 ANNOTATION_PLACEMENT = "notebooks.kubeflow.org/placement"
 
+# replicated-kernel tier (spec.replication, core/selfheal.py promote
+# verb): follower catch-up freshness is stamped onto follower pods by the
+# kubelet-side runtime as it applies the checkpoint-delta stream — the
+# promote verb elects the freshest caught-up follower off these stamps
+ANNOTATION_REPLICA_GENERATION = "notebooks.kubeflow.org/replica-generation"
+ANNOTATION_REPLICA_SEQ = "notebooks.kubeflow.org/replica-seq"
+ANNOTATION_REPLICA_DIGEST = "notebooks.kubeflow.org/replica-digest"
+
 # checkpoint-sidecar contract: env rendered into every TPU worker when
 # CHECKPOINT_STORE_URI is configured (consumed by runtime/checkpoint.py)
 ENV_CHECKPOINT_STORE_URI = "CHECKPOINT_STORE_URI"
@@ -49,11 +57,25 @@ ENV_CHECKPOINT_INTERVAL_S = "CHECKPOINT_INTERVAL_S"
 ENV_CHECKPOINT_RESTORE_URI = "CHECKPOINT_RESTORE_URI"
 ENV_CHECKPOINT_RESTORE_GENERATION = "CHECKPOINT_RESTORE_GENERATION"
 
+# replication contract: role/epoch env rendered into every worker of a
+# replicated notebook.  The epoch is the fencing token — the runtime MUST
+# present it on every session-store write, and the store rejects writes
+# below the fence so a zombie primary can never ack state after demotion.
+ENV_REPLICATION_ROLE = "REPLICATION_ROLE"
+ENV_REPLICATION_EPOCH = "REPLICATION_EPOCH"
+ENV_REPLICA_INDEX = "REPLICA_INDEX"
+ROLE_PRIMARY = "primary"
+ROLE_FOLLOWER = "follower"
+
 # labels
 WORKBENCH_LABEL = "opendatahub.io/workbenches"
 NOTEBOOK_NAME_LABEL = "notebook-name"
 STATEFULSET_LABEL = "statefulset"
 TPU_SLICE_LABEL = "notebooks.kubeflow.org/tpu-slice"
+# replica index of a replicated notebook's gang ("0" = replica 0; which
+# replica is PRIMARY is a status.replication pointer, not a label — the
+# pointer moves on promotion, names and labels stay stable)
+REPLICA_LABEL = "notebooks.kubeflow.org/replica"
 
 # env var injected into the notebook container
 PREFIX_ENV_VAR = "NB_PREFIX"

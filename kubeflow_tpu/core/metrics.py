@@ -168,6 +168,31 @@ class NotebookMetrics:
             "Checkpoint/migrate recoveries by trigger and outcome",
             labels=("trigger", "result"),
         )
+        # replicated-kernel tier (spec.replication + selfheal promote
+        # verb): promotion outcomes (result is the bounded selfheal
+        # PROMOTE_RESULT_* set), the primary-failure -> follower-promoted
+        # latency (sub-second buckets — the tier's reason to exist), and
+        # session-store writes rejected by the replication epoch fence
+        # (a demoted/zombie primary tried to ack state after demotion)
+        self.promotions = self.registry.counter(
+            "notebook_promotions_total",
+            "Primary promotions attempted by the self-healing engine, by "
+            "outcome",
+            labels=("namespace", "result"),
+        )
+        self.promotion_duration_seconds = self.registry.histogram(
+            "notebook_promotion_duration_seconds",
+            "Latency from primary disruption detection to a follower "
+            "promoted (epoch fenced, primary pointer flipped)",
+            labels=("namespace",),
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+        )
+        self.replication_fenced_writes = self.registry.counter(
+            "notebook_replication_fenced_writes_total",
+            "Session-store writes rejected by the replication epoch fence "
+            "(zombie primary writing under lost authority)",
+            labels=("namespace",),
+        )
         # slice scheduler + warm pool (core/scheduler.py): per-reconcile
         # scheduling outcomes (result is the bounded scheduler.SCHEDULE_*
         # set), per-claim warm-pool outcomes (hit | miss | bypass), and the

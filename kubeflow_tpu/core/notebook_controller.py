@@ -70,6 +70,13 @@ class NotebookReconciler:
 
             session = open_store(cfg.checkpoint_store_uri, clock=self.clock)
         self.session = session
+        # every fence rejection (a demoted primary still trying to write)
+        # is a near-miss worth counting — the soak asserts the zombie was
+        # actually stopped, not merely absent
+        if session is not None and hasattr(session, "on_fenced_write"):
+            session.on_fenced_write = (
+                lambda ns, _name: metrics.replication_fenced_writes
+                .labels(ns).inc())
         # slice-atomic self-healing: budgeted recovery of disrupted TPU
         # slices, bookkeeping persisted on the CR (core/selfheal.py)
         self.recovery = RecoveryEngine(api, cfg, metrics, self.recorder,
@@ -112,7 +119,12 @@ class NotebookReconciler:
                 and C.STOP_ANNOTATION not in nb.metadata.annotations:
             from .scheduler import placement_covers
 
-            if not placement_covers(nb, nb.tpu.slices):
+            # replicated notebooks gang-gate on EVERY replica's gangs:
+            # a follower without capacity is a follower that cannot
+            # catch up, so nothing renders until the full set is placed
+            rep = nb.replication
+            total_gangs = nb.tpu.slices * (rep.replicas if rep else 1)
+            if not placement_covers(nb, total_gangs):
                 self._update_status(nb, [], scheduling=True)
                 return Result()
 
@@ -144,12 +156,19 @@ class NotebookReconciler:
         existing_by_name = {s.name: s for s in existing}
 
         def slice_of(sts: KubeObject) -> Optional[str]:
-            return (
+            # generate-name matching key: replicated notebooks repeat each
+            # slice label once per replica, so the replica label joins the
+            # key or follower STS would collide with the primary's
+            labels = (
                 sts.spec.get("template", {})
                 .get("metadata", {})
                 .get("labels", {})
-                .get(C.TPU_SLICE_LABEL)
             )
+            s = labels.get(C.TPU_SLICE_LABEL)
+            if s is None:
+                return None
+            r = labels.get(C.REPLICA_LABEL)
+            return s if r is None else f"{r}/{s}"
 
         existing_by_slice = {slice_of(s): s for s in existing if slice_of(s)}
         live_names: list[str] = []  # ordered: slice 0 first
@@ -379,13 +398,30 @@ class NotebookReconciler:
         num_slices = tpu.slices if tpu else 1
         expected_hosts = (tpu.shape.num_hosts * num_slices) if tpu else 1
 
-        first_sts_name = live_names[0] if live_names else nb.name
-        for live_name in live_names:
+        # replication: readiness/health speak for the PRIMARY replica only
+        # (followers are redundancy, not capacity — a degraded follower
+        # must never flip a healthy primary's notebook to Degraded); all
+        # replicas' pods still land in workerStates for observability.
+        # live_names is gang-major (replica-major from the renderer), so
+        # the primary's gangs sit at [primary*num_slices, (primary+1)*...)
+        rep_spec = nb.replication
+        live_rep = nb.status.get("replication") or {}
+        primary_replica = int(live_rep.get("primary", 0)) \
+            if rep_spec is not None else 0
+        primary_lo = primary_replica * num_slices
+        primary_hi = primary_lo + num_slices
+
+        first_sts_idx = primary_lo if rep_spec is not None else 0
+        first_sts_name = live_names[first_sts_idx] \
+            if first_sts_idx < len(live_names) else (
+                live_names[0] if live_names else nb.name)
+        for idx, live_name in enumerate(live_names):
             if self.cache is not None:
                 sts = self.cache.get("StatefulSet", nb.namespace, live_name)
             else:
                 sts = self.api.try_get("StatefulSet", nb.namespace, live_name)
-            if sts is not None:
+            if sts is not None and (rep_spec is None
+                                    or primary_lo <= idx < primary_hi):
                 ready += int(sts.status.get("readyReplicas", 0) or 0)
             if tpu is not None:
                 for pod in sorted(self._pods_of(nb, live_name), key=lambda p: p.name):
@@ -455,6 +491,14 @@ class NotebookReconciler:
         # the migrate verb's write-ahead restore intent rides along too —
         # losing it on a status rewrite would orphan an in-flight restore
         session_state = copy.deepcopy(nb.status.get("sessionState"))
+        # the replication authority record (epoch, primary pointer, the
+        # write-ahead promotion record) MUST survive every status rewrite:
+        # dropping it would reset the epoch and un-fence a demoted primary.
+        # Seeded here for replicated notebooks so the record exists before
+        # the first promotion ever needs to CAS against it.
+        replication = copy.deepcopy(nb.status.get("replication"))
+        if rep_spec is not None and replication is None:
+            replication = {"epoch": 1, "primary": 0}
 
         slice_health = None
         if tpu is not None:
@@ -485,6 +529,7 @@ class NotebookReconciler:
             slice_health=slice_health,
             slice_recovery=slice_recovery,
             session_state=session_state,
+            replication=replication,
         )
 
         # transitions as span events: the trace timeline shows WHEN a slice
@@ -569,9 +614,22 @@ class NotebookReconciler:
 
         def write() -> None:
             live = self.api.get("Notebook", nb.namespace, nb.name)
-            if live.body.get("status") == status:
+            new_status = status
+            # epoch-regression guard: a promotion (or a follower-freshness
+            # pass) may have advanced status.replication between this
+            # reconcile's read and now — clobbering it with the stale copy
+            # would roll back the epoch and un-fence a demoted primary.
+            # The freshest record (by epoch, ties to the live object, which
+            # is at least as new) always wins.
+            live_rep_now = (live.body.get("status") or {}).get("replication")
+            if replication is not None and live_rep_now is not None and \
+                    live_rep_now.get("epoch", 0) >= \
+                    replication.get("epoch", 0):
+                new_status = dict(status)
+                new_status["replication"] = copy.deepcopy(live_rep_now)
+            if live.body.get("status") == new_status:
                 return
-            live.status = status
+            live.status = new_status
             self.api.update_status(live)
 
         retry_on_conflict(write)

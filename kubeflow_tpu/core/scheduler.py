@@ -285,13 +285,32 @@ class SliceScheduler:
         ) as span:
             if C.STOP_ANNOTATION in nb.metadata.annotations:
                 return self._release(nb, shape, span)
-            return self._place(nb, tpu.slices, shape, span)
+            # a replicated notebook (spec.replication) schedules one gang
+            # per replica x slice — flat gang index g = replica *
+            # num_slices + slice, matching the replica-major live_names
+            # order the notebook controller renders
+            rep = nb.replication
+            return self._place(nb, tpu.slices, shape, span,
+                               replicas=rep.replicas if rep else 1,
+                               anti_affine=bool(rep and rep.anti_affine))
 
     # -- placement -------------------------------------------------------------
     def _place(self, nb: Notebook, num_slices: int, shape: SliceShape,
-               span) -> Result:
+               span, replicas: int = 1,
+               anti_affine: bool = False) -> Result:
+        """Place every gang of the notebook: `num_slices` gangs per
+        replica, `replicas * num_slices` total, flat gang index.  With
+        `anti_affine` (replicated notebooks), replica R's gangs must land
+        on node pools disjoint from every OTHER replica's — one pool
+        failure can then never take the primary and its standby
+        together.  Slices within one replica may share a pool, exactly
+        as before."""
         key = f"{nb.namespace}/{nb.name}"
+        total_gangs = num_slices * max(replicas, 1)
         out: dict = {}
+
+        def replica_of(gang: int) -> int:
+            return gang // num_slices
 
         def attempt() -> None:
             live = self._ensure_pool(shape)
@@ -314,13 +333,29 @@ class SliceScheduler:
                 if e.get("claimedBy") != key:
                     continue
                 idx = e.get("claimedSlice")
-                if isinstance(idx, int) and 0 <= idx < num_slices \
+                if isinstance(idx, int) and 0 <= idx < total_gangs \
                         and idx not in assignments:
                     assignments[idx] = sid
                 else:
                     self._release_entry(slices, sid)  # stale (scale-in)
 
-            for idx in range(num_slices):
+            # pools each replica already occupies (adopted claims count:
+            # the anti-affinity verdict must survive crash recovery)
+            pools_by_replica: dict[int, set[str]] = {}
+            for idx, sid in assignments.items():
+                pool = slices[sid].get("pool", "")
+                if pool:
+                    pools_by_replica.setdefault(
+                        replica_of(idx), set()).add(pool)
+
+            def foreign_pools(gang: int) -> set[str]:
+                if not anti_affine:
+                    return set()
+                r = replica_of(gang)
+                return {p for rr, ps in pools_by_replica.items()
+                        if rr != r for p in ps}
+
+            for idx in range(total_gangs):
                 sid = assignments.get(idx)
                 if sid is not None:
                     e = slices[sid]
@@ -329,12 +364,15 @@ class SliceScheduler:
                     elif e.get("state") == C.WARMSLICE_READY:
                         e["state"] = C.WARMSLICE_CLAIMED
                     continue
-                # warm claim: lowest-id Ready unclaimed pool slice
+                excluded = foreign_pools(idx)
+                # warm claim: lowest-id Ready unclaimed pool slice on a
+                # pool no other replica occupies
                 cand = next(
                     (s for s in sorted(slices)
                      if slices[s].get("state") == C.WARMSLICE_READY
                      and not slices[s].get("claimedBy")
-                     and not slices[s].get("external")),
+                     and not slices[s].get("external")
+                     and slices[s].get("pool", "") not in excluded),
                     None)
                 if cand is not None:
                     slices[cand].update({
@@ -343,13 +381,17 @@ class SliceScheduler:
                         "claimedSlice": idx,
                     })
                     assignments[idx] = cand
+                    pools_by_replica.setdefault(
+                        replica_of(idx), set()).add(
+                            slices[cand].get("pool", ""))
                     st["hits"] += 1
                     claims[CLAIM_HIT] += 1
                     continue
                 # bypass: cost-function placement on pre-existing capacity
-                # outside any warm pool
-                gp = self.policy.place(
-                    shape, self._inventory(shape, st))
+                # outside any warm pool (and outside other replicas' pools)
+                inventory = [n for n in self._inventory(shape, st)
+                             if n.pool not in excluded]
+                gp = self.policy.place(shape, inventory)
                 if gp is not None:
                     st["seq"] += 1
                     sid = f"ws-{st['seq']:04d}"
@@ -362,11 +404,15 @@ class SliceScheduler:
                         "claimedSlice": idx,
                     }
                     assignments[idx] = sid
+                    pools_by_replica.setdefault(
+                        replica_of(idx), set()).add(gp.pool)
                     st["bypass"] += 1
                     claims[CLAIM_BYPASS] += 1
                     continue
                 # cold path: reserve a dedicated slice, provisioned by the
-                # WarmPoolController once readyAt passes
+                # WarmPoolController once readyAt passes (the generated
+                # pool name is unique per reservation, so cold replicas
+                # are anti-affine by construction)
                 st["seq"] += 1
                 sid = f"ws-{st['seq']:04d}"
                 slices[sid] = {
@@ -379,6 +425,8 @@ class SliceScheduler:
                     "claimedSlice": idx,
                 }
                 assignments[idx] = sid
+                pools_by_replica.setdefault(
+                    replica_of(idx), set()).add(slices[sid]["pool"])
                 st["misses"] += 1
                 claims[CLAIM_MISS] += 1
                 waiting = True
@@ -405,7 +453,7 @@ class SliceScheduler:
                 requeue_after=max(self.cfg.warmpool_provision_s, 1.0))
 
         intent = {"v": 1, "slices": {}}
-        for idx in range(num_slices):
+        for idx in range(total_gangs):
             e = out["slices"][out["assignments"][idx]]
             entry = {"pool": e["pool"]}
             if e.get("nodes"):
@@ -431,8 +479,8 @@ class SliceScheduler:
             self._count(SCHEDULE_PLACED)
             self.recorder.event(
                 nb.obj, "Normal", EVENT_SCHEDULED,
-                "Placed %d slice(s) onto pool(s) %s" % (
-                    num_slices,
+                "Placed %d gang(s) onto pool(s) %s" % (
+                    total_gangs,
                     ", ".join(sorted(set(
                         e["pool"] for e in intent["slices"].values())))))
         else:

@@ -38,6 +38,26 @@ the loop, in the shape NotebookOS (arXiv:2503.20591) and ElasticNotebook
   torn down without a secured checkpoint.  Verb precedence:
   cull > migrate > restart.
 
+- For a **replicated** notebook (spec.replication: one primary gang plus
+  follower gangs continuously replaying the checkpoint-delta stream,
+  core/sessionstate.py) the engine prefers a `promote` verb over both:
+  primary-gang failure elects the freshest caught-up follower (follower
+  pods positively stamp their replayed position,
+  ANNOTATION_REPLICA_GENERATION/SEQ) and flips the primary pointer in
+  `status.replication` under epoch fencing — the same pattern as the
+  sharded control plane's map (kube/shard.py): the epoch is bumped in
+  the SAME commit that writes the write-ahead promotion record
+  (phase="promoting"), the session store's write fence is raised to the
+  new epoch, and only then is the new primary named
+  (phase="promoted").  The fence raise is the linearization point: a
+  demoted (zombie) primary's write either landed before it — and the
+  promoted follower replays it during catch-up — or raises
+  StaleWriterError and was never acked.  A crash anywhere in between
+  resumes from the promotion record (re-fence is idempotent, the fence
+  is a monotonic max).  The demoted gang then heals as a follower
+  through the ordinary restart budget; migrate is never used for
+  replicated notebooks (the delta stream IS the migration).
+
 All bookkeeping (per-slice attempt timestamps, last-restart time, backoff
 deadline, disruption stamp, exhaustion flag — and the migrate verb's
 restore intent) is persisted in `status.sliceRecovery` /
@@ -104,6 +124,13 @@ MIGRATE_RESULT_RESTORED = "restored"          # slice Healthy post-restore
 MIGRATE_RESULT_FALLBACK = "fallback-restart"  # stale/absent ckpt -> bare
 MIGRATE_RESULT_SKIPPED = "skipped"            # voluntary without a ckpt
 
+# promote verb (replicated notebooks): the internal verb tag plus the
+# bounded result set labelling notebook_promotions_total{result}
+VERB_PROMOTE = "promote"
+PROMOTE_RESULT_PROMOTED = "promoted"        # follower elected + flipped
+PROMOTE_RESULT_LOST_RACE = "lost-race"      # another promoter committed first
+PROMOTE_RESULT_NO_CANDIDATE = "no-candidate"  # no caught-up follower
+
 # event reasons (kubectl describe notebook)
 EVENT_SLICE_RECOVERY = "SliceRecovery"
 EVENT_RECOVERY_EXHAUSTED = "RecoveryExhausted"
@@ -111,6 +138,7 @@ EVENT_RECOVERY_RESTORED = "RecoveryRestored"
 EVENT_SLICE_MIGRATION = "SliceMigration"
 EVENT_MIGRATION_COMPLETE = "MigrationComplete"
 EVENT_MIGRATION_SKIPPED = "MigrationSkipped"
+EVENT_PRIMARY_PROMOTED = "PrimaryPromoted"
 
 
 class SliceRestartError(Exception):
@@ -193,6 +221,31 @@ def node_drained(pod: KubeObject, api: ApiServer,
     return bool(node is not None and node.spec.get("unschedulable"))
 
 
+def _replica_freshness(pods: list[KubeObject]) -> Optional[tuple[int, int,
+                                                                 str]]:
+    """Catch-up freshness of one replica gang from the positive
+    ANNOTATION_REPLICA_* stamps its runtime writes as it replays the
+    checkpoint-delta stream.  The gang's freshness is its SLOWEST worker's
+    (generation, seq) — a gang is only as caught up as its laggard — and a
+    single unstamped worker makes the whole gang unknown (None): election
+    needs positive evidence, absence never reads as caught up."""
+    worst: Optional[tuple[int, int, str]] = None
+    for pod in pods:
+        ann = pod.metadata.annotations
+        gen_raw = ann.get(C.ANNOTATION_REPLICA_GENERATION)
+        seq_raw = ann.get(C.ANNOTATION_REPLICA_SEQ)
+        if gen_raw is None or seq_raw is None:
+            return None
+        try:
+            cur = (int(gen_raw), int(seq_raw),
+                   ann.get(C.ANNOTATION_REPLICA_DIGEST, ""))
+        except ValueError:
+            return None
+        if worst is None or cur[:2] < worst[:2]:
+            worst = cur
+    return worst
+
+
 class RecoveryEngine:
     """Budgeted slice-atomic recovery, driven from the notebook reconcile.
 
@@ -237,7 +290,9 @@ class RecoveryEngine:
     ) -> float:
         """One recovery pass; returns the requeue-after hint in seconds
         (0.0 = nothing scheduled).  `live_names` is ordered slice 0 first,
-        as the reconciler builds it; `restart_slice` must delete every pod
+        as the reconciler builds it — for a replicated notebook it covers
+        EVERY replica gang in replica-major order (replica 0's slices,
+        then replica 1's, ...); `restart_slice` must delete every pod
         of the named slice's StatefulSet, aggregating errors
         (NotebookReconciler._restart_pods); `stamp_restore(live_name, idx)`
         must sync the live StatefulSet template with the freshly written
@@ -246,6 +301,8 @@ class RecoveryEngine:
         tpu = nb.tpu
         if tpu is None or not self.cfg.enable_self_healing:
             return 0.0
+        rep_spec = nb.replication
+        num_slices = tpu.slices
         reader = self.cache if self.cache is not None else self.api
         live = reader.try_get("Notebook", nb.namespace, nb.name)
         if live is None or live.metadata.deletion_timestamp is not None:
@@ -283,8 +340,11 @@ class RecoveryEngine:
         shape = tpu.shape
         node_cache: dict[str, Optional[KubeObject]] = {}
         detections: list[tuple] = []
+        gang_fresh: dict[int, Optional[tuple[int, int, str]]] = {}
         for idx, live_name in enumerate(live_names):
             pods = sorted(pods_of(live_name), key=lambda p: p.name)
+            if rep_spec is not None:
+                gang_fresh[idx] = _replica_freshness(pods)
             reasons: list[tuple[str, str]] = []
             pending = False
             ready = 0
@@ -326,12 +386,34 @@ class RecoveryEngine:
             detections.append((idx, live_name, reasons, pending, healthy,
                                trigger, stale_session))
 
+        # -- replicated tier: followers record + promotion decision -----------
+        replication = None
+        prev_replication = None
+        promote_entry = None
+        no_candidate = False
+        skip_gangs: set[int] = set()
+        primary_replica = 0
+        if rep_spec is not None:
+            replication = copy.deepcopy(status.get("replication") or {})
+            prev_replication = copy.deepcopy(replication)
+            replication.setdefault("epoch", 1)
+            replication.setdefault("primary", 0)
+            primary_replica = replication["primary"]
+            self._record_followers(rep_spec, num_slices, detections,
+                                   gang_fresh, replication)
+            promote_entry, skip_gangs, no_candidate = \
+                self._promotion_decision(nb, rep_spec, num_slices,
+                                         detections, gang_fresh,
+                                         replication, recovery)
+
         migrating_inflight = any(
             s.get("phase") == "migrating" for s in session_state.values())
         if not recovery and not migrating_inflight and not any(
                 reasons or pending or trigger
                 for _, _, reasons, pending, _, trigger, _ in detections):
-            return 0.0
+            if promote_entry is None and \
+                    (replication is None or replication == prev_replication):
+                return 0.0
 
         # -- pass 2: decisions, under the `recover` phase span ----------------
         now = self.clock.now()
@@ -343,12 +425,32 @@ class RecoveryEngine:
             "recover", {"phase": "recover", "namespace": nb.namespace,
                         "notebook": nb.name}
         ) as span:
+            if promote_entry is not None:
+                # first in the verb queue: the promotion record must land
+                # (and the fence rise) before any gang of this pass dies
+                restarts.append(promote_entry)
+                requeue = _merge_requeue(
+                    requeue, self.cfg.recovery_backoff_base_s)
+            if no_candidate:
+                # primary disrupted but no caught-up follower to elect —
+                # fall through to the ordinary restart verbs below
+                self.metrics.promotions.labels(
+                    nb.namespace, PROMOTE_RESULT_NO_CANDIDATE).inc()
+                span.add_event("promote.no_candidate", {
+                    "primary": primary_replica})
             for idx, live_name, reasons, pending, healthy, trigger, \
                     stale_session in detections:
+                if idx in skip_gangs:
+                    # the gang being demoted this pass: promotion replaces
+                    # its restart; it heals as a follower from next pass
+                    continue
                 requeue = _merge_requeue(requeue, self._slice_pass(
                     nb, idx, live_name, reasons, pending, healthy, trigger,
                     stale_session, recovery, session_state, restarts,
-                    events, notes, span, now))
+                    events, notes, span, now,
+                    allow_migrate=rep_spec is None,
+                    observe_recovery=rep_spec is None or
+                    idx // num_slices == primary_replica))
 
             # per-slice passes mutate their state dicts in place; drop
             # entries that emptied out so the persisted bookkeeping stays
@@ -367,13 +469,17 @@ class RecoveryEngine:
             # inside) so it dominates every restart on the CFG — enforced
             # by ci/analyzers/write_ahead.py.
             self._write_bookkeeping(nb, recovery, exhausted, session_state,
+                                    replication=replication,
                                     skip_if_unchanged=(prev_recovery,
-                                                       prev_session))
+                                                       prev_session,
+                                                       prev_replication))
             for etype, reason, message in events:
                 self.recorder.event(nb.obj, etype, reason, message)
 
             for entry in restarts:
-                if entry["verb"] == REASON_MIGRATE:
+                if entry["verb"] == VERB_PROMOTE:
+                    self._execute_promote(nb, entry)
+                elif entry["verb"] == REASON_MIGRATE:
                     self._execute_migrate(nb, entry, stamp_restore,
                                           restart_slice)
                 else:
@@ -386,6 +492,246 @@ class RecoveryEngine:
             if ann_trigger and not notes["deferred"]:
                 self._clear_migrate_annotation(nb)
         return requeue
+
+    # -- replicated tier ------------------------------------------------------
+    def _record_followers(self, rep_spec, num_slices, detections,
+                          gang_fresh, replication) -> None:
+        """Mirror follower readiness + catch-up freshness into
+        status.replication.followers — the chaos soak's assertable record
+        and the operator's view of how hot each standby is."""
+        p = replication["primary"]
+        followers: dict = {}
+        for r in range(rep_spec.replicas):
+            if r == p:
+                continue
+            rec: dict = {"ready": True, "slices": {}}
+            for s in range(num_slices):
+                g = r * num_slices + s
+                if g >= len(detections) or not detections[g][4]:
+                    rec["ready"] = False
+                if g < len(detections):
+                    fresh = gang_fresh.get(g)
+                    if fresh is not None:
+                        rec["slices"][str(s)] = {
+                            "generation": fresh[0], "seq": fresh[1],
+                            "digest": fresh[2]}
+            followers[str(r)] = rec
+        replication["followers"] = followers
+
+    def _promotion_decision(self, nb, rep_spec, num_slices, detections,
+                            gang_fresh, replication,
+                            recovery) -> tuple[Optional[dict], set[int],
+                                               bool]:
+        """Decide the promote verb for this pass.  Returns
+        (promote_entry | None, gang indexes whose restart the promotion
+        replaces this pass, no-candidate flag).  An in-flight promotion
+        record (phase=="promoting" — a crash between the record commit
+        and the flip) resumes ahead of any fresh election."""
+        p = replication["primary"]
+        promo = replication.get("promotion") or {}
+        if promo.get("phase") == "promoting":
+            started = promo.get("startedAt")
+            entry = {
+                "verb": VERB_PROMOTE, "resume": True,
+                "epoch": promo["epoch"], "from": promo["from"],
+                "to": promo["to"], "reason": promo.get("reason", ""),
+                "disrupted_at": parse_iso(started) if started else None,
+            }
+            skip = set(range(promo["from"] * num_slices,
+                             (promo["from"] + 1) * num_slices))
+            return entry, skip, False
+        primary_gangs = range(p * num_slices, (p + 1) * num_slices)
+        primary_reasons = [
+            det[2] for det in detections
+            if det[0] in primary_gangs and det[2]]
+        if not primary_reasons:
+            return None, set(), False
+        if self.session is None:
+            # no delta stream to verify catch-up against: promotion would
+            # be a blind guess, so the ordinary verbs take over
+            return None, set(), True
+        best: Optional[tuple[tuple, int]] = None
+        for r in range(rep_spec.replicas):
+            if r == p:
+                continue
+            score = self._candidate_score(nb, r, num_slices, detections,
+                                          gang_fresh)
+            if score is None:
+                continue
+            if best is None or score > best[0]:
+                best = (score, r)
+        if best is None:
+            return None, set(), True
+        # duration anchor: the earliest persisted disruption stamp among
+        # the primary's gangs (a backoff/fault-delayed pass keeps charging
+        # the same incident), else this very detection
+        disrupted_at = None
+        for g in primary_gangs:
+            st = recovery.get(str(g)) or {}
+            if st.get("disruptedAt"):
+                t = parse_iso(st["disruptedAt"])
+                disrupted_at = t if disrupted_at is None \
+                    else min(disrupted_at, t)
+        entry = {
+            "verb": VERB_PROMOTE, "resume": False,
+            "epoch": replication["epoch"] + 1,
+            "from": p, "to": best[1],
+            "reason": primary_reasons[0][0][1],
+            "disrupted_at": disrupted_at if disrupted_at is not None
+            else self.clock.now(),
+        }
+        return entry, set(primary_gangs), False
+
+    def _candidate_score(self, nb, r, num_slices, detections,
+                         gang_fresh) -> Optional[tuple]:
+        """Election score of follower replica r: the per-slice
+        (generation, seq) freshness tuple, or None when any gang is
+        unhealthy, unstamped, or trailing the chain head by more than
+        REPLICATION_MAX_LAG (promotion needs positive evidence the state
+        is there — a missing stamp never reads as caught up)."""
+        score = []
+        for s in range(num_slices):
+            g = r * num_slices + s
+            if g >= len(detections) or not detections[g][4]:
+                return None
+            fresh = gang_fresh.get(g)
+            if fresh is None:
+                return None
+            head = self.session.chain_head(nb.namespace, nb.name, s)
+            if head is None:
+                return None
+            gen, seq, _digest = fresh
+            head_gen, head_seq, _head_digest = head
+            lag = (1 + head_seq) if gen != head_gen \
+                else max(head_seq - seq, 0)
+            if lag > self.cfg.replication_max_lag:
+                return None
+            score.append((gen, seq))
+        return tuple(score)
+
+    def _execute_promote(self, nb, entry) -> None:
+        """The promote verb, under its own `replication.promote` phase
+        span.  Protocol order is the guarantee:
+
+        1. commit the write-ahead promotion record, bumping the epoch in
+           the SAME status write (CAS on the old epoch — a racing
+           promoter loses cleanly);
+        2. raise the session store's write fence to the new epoch — the
+           linearization point after which the demoted primary cannot ack
+           a write;
+        3. commit the flip: name the new primary, phase="promoted".
+
+        A crash between any two steps resumes via the promotion record
+        (entry["resume"]): step 2 is a monotonic max and step 3 checks
+        the record before flipping, so resume is idempotent."""
+        with _TRACER.start_span("replication.promote", {
+            "phase": "promote", "namespace": nb.namespace,
+            "notebook": nb.name, "epoch": entry["epoch"],
+            "from": entry["from"], "to": entry["to"],
+        }) as span:
+            if not entry.get("resume"):
+                if not self._commit_promotion_record(nb, entry):
+                    span.add_event("promote.lost_race", {
+                        "epoch": entry["epoch"]})
+                    self.metrics.promotions.labels(
+                        nb.namespace, PROMOTE_RESULT_LOST_RACE).inc()
+                    return
+            if self.session is not None:
+                self.session.fence(nb.namespace, nb.name, entry["epoch"])
+                span.add_event("promote.fenced", {
+                    "epoch": entry["epoch"]})
+            if not self._commit_promotion_flip(nb, entry):
+                self.metrics.promotions.labels(
+                    nb.namespace, PROMOTE_RESULT_LOST_RACE).inc()
+                return
+            duration = 0.0
+            if entry.get("disrupted_at") is not None:
+                duration = max(
+                    self.clock.now() - entry["disrupted_at"], 0.0)
+            tid = span.trace_id
+            exemplar = {"trace_id": tid} if tid else None
+            self.metrics.disruption_recovery_seconds.labels(
+                nb.namespace).observe(duration, exemplar=exemplar)
+            self.metrics.promotion_duration_seconds.labels(
+                nb.namespace).observe(duration, exemplar=exemplar)
+            self.metrics.promotions.labels(
+                nb.namespace, PROMOTE_RESULT_PROMOTED).inc()
+            span.add_event("promote.complete", {
+                "epoch": entry["epoch"], "to": entry["to"],
+                "seconds": duration})
+            self.recorder.event(
+                nb.obj, "Normal", EVENT_PRIMARY_PROMOTED,
+                "promoted replica %d to primary (epoch %d) after %s on "
+                "replica %d; demoted gang rejoins as follower" % (
+                    entry["to"], entry["epoch"],
+                    entry["reason"] or "disruption", entry["from"]))
+
+    def _commit_promotion_record(self, nb, entry) -> bool:
+        """Write-ahead half of the promotion: epoch bump + promotion
+        record in ONE status commit, CAS-guarded on the epoch/primary the
+        election read — exactly one promoter per epoch can win."""
+        committed = {"ok": False}
+
+        def write() -> None:
+            committed["ok"] = False
+            try:
+                live = self.api.get("Notebook", nb.namespace, nb.name)
+            except NotFoundError:
+                return
+            st = live.body.setdefault("status", {})
+            rep = copy.deepcopy(st.get("replication") or {})
+            if rep.get("epoch", 1) != entry["epoch"] - 1 or \
+                    rep.get("primary", 0) != entry["from"]:
+                return  # another promoter moved the authority first
+            rep["epoch"] = entry["epoch"]
+            rep["promotion"] = {
+                "epoch": entry["epoch"],
+                "from": entry["from"],
+                "to": entry["to"],
+                "phase": "promoting",
+                "reason": entry["reason"],
+                "startedAt": self.clock.now_iso(),
+            }
+            st["replication"] = rep
+            self.api.update_status(live)
+            committed["ok"] = True
+
+        retry_on_conflict(write)
+        return committed["ok"]
+
+    def _commit_promotion_flip(self, nb, entry) -> bool:
+        """Completion half: name the new primary and close the record.
+        Verifies the committed record is still OURS (epoch + target) —
+        the re-read-the-authority-before-acting discipline of
+        kube/leader.py FencingToken.verify()."""
+        done = {"ok": False}
+
+        def write() -> None:
+            done["ok"] = False
+            try:
+                live = self.api.get("Notebook", nb.namespace, nb.name)
+            except NotFoundError:
+                return
+            st = live.body.setdefault("status", {})
+            rep = copy.deepcopy(st.get("replication") or {})
+            promo = rep.get("promotion") or {}
+            if rep.get("epoch") != entry["epoch"] or \
+                    promo.get("to") != entry["to"]:
+                return  # superseded by a later promotion
+            if promo.get("phase") == "promoted" and \
+                    rep.get("primary") == entry["to"]:
+                done["ok"] = True  # resume found it already complete
+                return
+            rep["primary"] = entry["to"]
+            promo["phase"] = "promoted"
+            promo["completedAt"] = self.clock.now_iso()
+            rep["promotion"] = promo
+            st["replication"] = rep
+            self.api.update_status(live)
+            done["ok"] = True
+
+        retry_on_conflict(write)
+        return done["ok"]
 
     # -- verb execution -------------------------------------------------------
     def _execute_restart(self, nb, entry, span, stamp_restore,
@@ -461,7 +807,16 @@ class RecoveryEngine:
     # -- per-slice decision ---------------------------------------------------
     def _slice_pass(self, nb, idx, live_name, reasons, pending, healthy,
                     trigger, stale_session, recovery, session_state,
-                    restarts, events, notes, span, now) -> float:
+                    restarts, events, notes, span, now, *,
+                    allow_migrate: bool = True,
+                    observe_recovery: bool = True) -> float:
+        # `allow_migrate=False` (replicated notebooks) forces the bare
+        # restart verb: the checkpoint-delta stream IS the migration, a
+        # demoted/failed follower gang just restarts and catches up.
+        # `observe_recovery=False` keeps follower-gang repair latency out
+        # of notebook_disruption_recovery_seconds — for a replicated
+        # notebook only primary recoveries (and promotions) are
+        # user-visible disruptions.
         key = str(idx)
         state = recovery.get(key, {})
         session = session_state.get(key, {})
@@ -499,7 +854,8 @@ class RecoveryEngine:
                 self._migration_restored(nb, idx, session, events, span)
                 session_state[key] = session
             if healthy and state:
-                self._slice_recovered(nb, idx, state, events, span, now)
+                self._slice_recovered(nb, idx, state, events, span, now,
+                                      observe_recovery=observe_recovery)
                 if state:
                     recovery[key] = state
                 else:
@@ -568,9 +924,10 @@ class RecoveryEngine:
             return 0.0
 
         # verb decision: migrate when a usable checkpoint can be secured
+        use_session = self.session is not None and allow_migrate
         snap = None
         ckpt_age = 0.0
-        if self.session is not None:
+        if use_session:
             snap, ckpt_age = self._secure_checkpoint(nb, idx, span, now)
         if snap is None and voluntary:
             # a healthy session is never torn down without its state in
@@ -609,10 +966,10 @@ class RecoveryEngine:
             "attempt": len(attempts), "delay": delay,
             "verb": REASON_MIGRATE if snap is not None else "restart",
             "trigger": (trigger if voluntary else MIGRATE_TRIGGER_FAILURE)
-            if self.session is not None else None,
+            if use_session else None,
             "snap": snap, "ckpt_age_s": ckpt_age,
             "restamp": restamp,
-            "fallback": snap is None and self.session is not None,
+            "fallback": snap is None and use_session,
             "reason_detail": ("voluntary %s" % trigger) if voluntary
             else "%s is %s" % (pod_name or "workers", state["reason"]),
         }
@@ -684,13 +1041,16 @@ class RecoveryEngine:
             "slice %d restored session checkpoint generation %s after "
             "migration" % (idx, session.get("restoreGeneration"))))
 
-    def _slice_recovered(self, nb, idx, state, events, span, now) -> None:
+    def _slice_recovered(self, nb, idx, state, events, span, now, *,
+                         observe_recovery: bool = True) -> None:
         """Disruption over: observe the detection→Healthy latency once and
         drop the transient fields.  Attempt stamps stay and age out by the
         sliding window (the flap guard) — except after exhaustion, where a
         Healthy slice means an operator fixed it and earns a fresh
-        budget."""
-        if state.get("disruptedAt"):
+        budget.  `observe_recovery=False` (follower gangs of a replicated
+        notebook) heals the bookkeeping without charging the user-facing
+        disruption histogram."""
+        if observe_recovery and state.get("disruptedAt"):
             duration = max(now - parse_iso(state["disruptedAt"]), 0.0)
             tid = span.trace_id
             self.metrics.disruption_recovery_seconds.labels(
@@ -720,20 +1080,25 @@ class RecoveryEngine:
     def _write_bookkeeping(self, nb: Notebook, recovery: dict,
                            exhausted: Optional[list[str]] = None,
                            session_state: Optional[dict] = None,
+                           replication: Optional[dict] = None,
                            skip_if_unchanged: Optional[tuple] = None) -> None:
-        """Persist status.sliceRecovery + status.sessionState (and the
+        """Persist status.sliceRecovery + status.sessionState (+ the
+        follower-freshness half of status.replication, and the
         RecoveryExhausted condition) with conflict retry.  Runs BEFORE any
         pod delete of the same pass, so the attempt charge and the restore
         intent are crash-safe.  `session_state` None leaves
         status.sessionState untouched (the Stopped-cleanup path drops only
         the recovery budget — the pre-cull checkpoint record must
-        survive).  `skip_if_unchanged=(prev_recovery, prev_session)` makes
-        an unchanged write a no-op — the check lives HERE, not at the call
-        site, so the caller's call dominates its pod deletes on the CFG
-        (ci/analyzers/write_ahead.py)."""
+        survive); `replication` None likewise.
+        `skip_if_unchanged=(prev_recovery, prev_session[,
+        prev_replication])` makes an unchanged write a no-op — the check
+        lives HERE, not at the call site, so the caller's call dominates
+        its pod deletes on the CFG (ci/analyzers/write_ahead.py)."""
         if skip_if_unchanged is not None and \
                 recovery == skip_if_unchanged[0] and \
-                session_state == skip_if_unchanged[1]:
+                session_state == skip_if_unchanged[1] and \
+                (len(skip_if_unchanged) < 3 or
+                 replication == skip_if_unchanged[2]):
             return
         exhausted = exhausted or []
 
@@ -752,6 +1117,16 @@ class RecoveryEngine:
                     st["sessionState"] = copy.deepcopy(session_state)
                 else:
                     st.pop("sessionState", None)
+            if replication is not None:
+                # epoch-regression guard: a promoter (this manager or a
+                # peer) may have bumped the authority between our read
+                # and this write — never let the freshness mirror roll
+                # back the epoch/primary/promotion record it rode in on
+                live_rep = st.get("replication") or {}
+                if live_rep.get("epoch", 0) <= replication.get("epoch", 1):
+                    merged = copy.deepcopy(live_rep)
+                    merged.update(copy.deepcopy(replication))
+                    st["replication"] = merged
             conds = list(st.get("conditions") or [])
             existing = next(
                 (c for c in conds
@@ -818,6 +1193,9 @@ __all__ = [
     "MIGRATE_TRIGGER_FAILURE",
     "MIGRATE_TRIGGER_NODE_DRAIN",
     "PENDING",
+    "PROMOTE_RESULT_LOST_RACE",
+    "PROMOTE_RESULT_NO_CANDIDATE",
+    "PROMOTE_RESULT_PROMOTED",
     "REASON_CRASH_LOOP",
     "REASON_MIGRATE",
     "REASON_NODE_GONE",
@@ -825,6 +1203,7 @@ __all__ = [
     "REASON_POD_FAILED",
     "RecoveryEngine",
     "SliceRestartError",
+    "VERB_PROMOTE",
     "classify_worker",
     "node_drained",
 ]

@@ -25,6 +25,26 @@ leaves a snapshot that restores garbage.  `request_final_snapshot` is
 the control plane's "flush now if you still can" RPC; the registered
 handler (the in-pod sidecar in production, FakeCluster in tests) returns
 the fresh SnapshotInfo or None when the slice is unreachable.
+
+**Replicated-kernel tier** (spec.replication): on top of base snapshots
+the store keeps per-slice **delta chains** — an ordered append-only
+stream of incremental state writes anchored to a base generation.  The
+primary kernel appends deltas between full snapshots; follower kernels
+replay them through a `FollowerReplica` cursor so catch-up costs one
+delta, not one restore.  Every delta records the digest of the
+*materialized* state after applying it, so `compact()` can fold a chain
+into a fresh base generation only after verifying the replayed bytes
+match the chain head (a digest mismatch leaves the chain untouched).
+Out-of-order appends are rejected (`DeltaChainError`) — the chain is a
+log, not a set.
+
+Writes carry an optional **writer epoch** checked against a per-notebook
+fence (`fence()`): once the promote verb (core/selfheal.py) raises the
+fence, a demoted primary's writes raise `StaleWriterError` instead of
+landing — the store-side half of the "zombie primary can never ack
+writes" guarantee (the CR-side half is the write-ahead promotion record
+in status.replication).  The fence is runtime state; the durable
+authority is the CR epoch, and promotion re-fences on resume.
 """
 
 from __future__ import annotations
@@ -45,10 +65,23 @@ TRIGGER_PERIODIC = "periodic"
 TRIGGER_PRE_STOP = "pre-stop"
 TRIGGER_FINAL = "final"
 TRIGGER_CULL = "cull"
+TRIGGER_COMPACT = "compact"
 
 DEFAULT_MAX_TO_KEEP = 5
 
 FinalSnapshotHandler = Callable[[str, str, int], Optional["SnapshotInfo"]]
+
+
+class DeltaChainError(Exception):
+    """A delta append/replay violated the chain contract: missing base,
+    out-of-order sequence, or a replay digest that does not match the
+    recorded chain head (the write/compaction is refused, never applied
+    half-way)."""
+
+
+class StaleWriterError(Exception):
+    """A write carried an epoch below the notebook's fence — the writer
+    was demoted and must not ack state (core/selfheal.py promote verb)."""
 
 
 @dataclass(frozen=True)
@@ -68,6 +101,26 @@ class SnapshotInfo:
     size: int
 
 
+@dataclass(frozen=True)
+class DeltaInfo:
+    """Metadata of one incremental state delta.  `digest` fingerprints the
+    MATERIALIZED state after applying this delta (base payload + every
+    delta through `seq`) — the replay-correctness anchor compaction and
+    follower catch-up verify against; `delta_digest` fingerprints the
+    delta bytes themselves."""
+
+    namespace: str
+    notebook: str
+    slice_id: int
+    base_generation: int
+    seq: int
+    saved_at: float
+    digest: str
+    delta_digest: str
+    uri: str
+    size: int
+
+
 def payload_digest(payload: bytes) -> str:
     return hashlib.sha256(payload).hexdigest()[:16]
 
@@ -82,6 +135,15 @@ class SessionStateStore:
         self.max_to_keep = max_to_keep
         self._lock = threading.RLock()
         self._final_handler: Optional[FinalSnapshotHandler] = None
+        # per-notebook write fence (replicated tier): writes carrying an
+        # epoch below the fence are rejected.  Runtime state by design —
+        # the durable epoch lives on the CR (status.replication) and the
+        # promote verb re-fences on crash/failover resume.
+        self._fences: dict[tuple[str, str], int] = {}
+        self.fenced_rejections: dict[tuple[str, str], int] = {}
+        # optional observer (ns, nb) -> None, wired by the controller to
+        # count rejections into notebook_replication_fenced_writes_total
+        self.on_fenced_write: Optional[Callable[[str, str], None]] = None
 
     # -- identity --------------------------------------------------------------
     @property
@@ -93,10 +155,55 @@ class SessionStateStore:
         return (f"{self.uri}/{namespace}/{notebook}/slice-{slice_id}/"
                 f"gen-{generation}")
 
+    def delta_uri(self, namespace: str, notebook: str, slice_id: int,
+                  base_generation: int, seq: int) -> str:
+        return (f"{self.uri}/{namespace}/{notebook}/slice-{slice_id}/"
+                f"delta-{base_generation}-{seq}")
+
+    # -- the write fence (replicated tier) -------------------------------------
+    def fence(self, namespace: str, notebook: str, epoch: int) -> int:
+        """Raise the notebook's write fence to `epoch` (monotonic max —
+        re-fencing with an old epoch is a no-op, so promotion resume is
+        idempotent).  Returns the fence now in force."""
+        with self._lock:
+            key = (namespace, notebook)
+            cur = self._fences.get(key, 0)
+            if epoch > cur:
+                self._fences[key] = epoch
+                cur = epoch
+            return cur
+
+    def fence_epoch(self, namespace: str, notebook: str) -> int:
+        with self._lock:
+            return self._fences.get((namespace, notebook), 0)
+
+    def _check_fence(self, namespace: str, notebook: str,
+                     writer_epoch: Optional[int]) -> None:
+        """Caller holds the lock.  `writer_epoch=None` (non-replicated
+        writers) always passes; a fenced write is counted and raised."""
+        if writer_epoch is None:
+            return
+        if writer_epoch < self._fences.get((namespace, notebook), 0):
+            key = (namespace, notebook)
+            self.fenced_rejections[key] = \
+                self.fenced_rejections.get(key, 0) + 1
+            cb = self.on_fenced_write
+            if cb is not None:
+                try:
+                    cb(namespace, notebook)
+                except Exception:  # noqa: BLE001 — observer must not
+                    pass           # turn a correct rejection into a crash
+            raise StaleWriterError(
+                f"write to {namespace}/{notebook} with epoch "
+                f"{writer_epoch} below fence "
+                f"{self._fences.get(key, 0)}: writer was demoted")
+
     # -- writes ----------------------------------------------------------------
     def put(self, namespace: str, notebook: str, slice_id: int,
-            payload: bytes, trigger: str = TRIGGER_PERIODIC) -> SnapshotInfo:
+            payload: bytes, trigger: str = TRIGGER_PERIODIC,
+            writer_epoch: Optional[int] = None) -> SnapshotInfo:
         with self._lock:
+            self._check_fence(namespace, notebook, writer_epoch)
             latest = self.latest(namespace, notebook, slice_id)
             generation = (latest.generation + 1) if latest else 1
             info = SnapshotInfo(
@@ -113,7 +220,75 @@ class SessionStateStore:
             )
             self._store(info, payload)
             self._prune(namespace, notebook, slice_id)
+            kept = {s.generation
+                    for s in self.snapshots(namespace, notebook, slice_id)}
+            self._prune_deltas(namespace, notebook, slice_id, kept)
             return info
+
+    def append_delta(self, namespace: str, notebook: str, slice_id: int,
+                     delta: bytes, expected_seq: Optional[int] = None,
+                     writer_epoch: Optional[int] = None) -> DeltaInfo:
+        """Append one incremental state delta to the chain anchored at the
+        latest base snapshot.  The chain is strictly ordered: `expected_seq`
+        (when given) must name the next slot, or the append is rejected —
+        a primary that raced a compaction or replayed a duplicate cannot
+        corrupt the log."""
+        with self._lock:
+            self._check_fence(namespace, notebook, writer_epoch)
+            base = self.latest(namespace, notebook, slice_id)
+            if base is None:
+                raise DeltaChainError(
+                    f"no base snapshot for {namespace}/{notebook}/"
+                    f"slice-{slice_id}: delta chains anchor to a base")
+            chain = self.deltas(namespace, notebook, slice_id)
+            next_seq = (chain[-1].seq + 1) if chain else 1
+            if expected_seq is not None and expected_seq != next_seq:
+                raise DeltaChainError(
+                    f"out-of-order delta for {namespace}/{notebook}/"
+                    f"slice-{slice_id}: expected_seq={expected_seq}, "
+                    f"chain head wants {next_seq}")
+            head = self.materialize(namespace, notebook, slice_id)
+            state = (head or b"") + delta
+            info = DeltaInfo(
+                namespace=namespace,
+                notebook=notebook,
+                slice_id=slice_id,
+                base_generation=base.generation,
+                seq=next_seq,
+                saved_at=self.clock.now(),
+                digest=payload_digest(state),
+                delta_digest=payload_digest(delta),
+                uri=self.delta_uri(namespace, notebook, slice_id,
+                                   base.generation, next_seq),
+                size=len(delta),
+            )
+            self._store_delta(info, delta)
+            return info
+
+    def compact(self, namespace: str, notebook: str, slice_id: int,
+                trigger: str = TRIGGER_COMPACT,
+                writer_epoch: Optional[int] = None) -> Optional[SnapshotInfo]:
+        """Fold the current delta chain into a fresh base generation —
+        digest-verified: the replayed bytes must hash to the chain head's
+        recorded digest or the compaction is refused and the chain stays
+        untouched.  An empty chain is a no-op (returns the current base)."""
+        with self._lock:
+            self._check_fence(namespace, notebook, writer_epoch)
+            base = self.latest(namespace, notebook, slice_id)
+            if base is None:
+                return None
+            chain = self.deltas(namespace, notebook, slice_id)
+            if not chain:
+                return base
+            state = self.materialize(namespace, notebook, slice_id)
+            if state is None or payload_digest(state) != chain[-1].digest:
+                raise DeltaChainError(
+                    f"compaction digest mismatch for {namespace}/"
+                    f"{notebook}/slice-{slice_id}: replayed "
+                    f"{payload_digest(state or b'')} != recorded "
+                    f"{chain[-1].digest}; chain left untouched")
+            return self.put(namespace, notebook, slice_id, state,
+                            trigger=trigger, writer_epoch=writer_epoch)
 
     # -- reads -----------------------------------------------------------------
     def snapshots(self, namespace: str, notebook: str,
@@ -133,6 +308,66 @@ class SessionStateStore:
     def payload(self, namespace: str, notebook: str, slice_id: int,
                 generation: Optional[int] = None) -> Optional[bytes]:
         raise NotImplementedError
+
+    def deltas(self, namespace: str, notebook: str, slice_id: int,
+               base_generation: Optional[int] = None) -> list[DeltaInfo]:
+        """The ordered delta chain anchored at `base_generation` (default:
+        the latest base snapshot's chain; empty when no base exists)."""
+        with self._lock:
+            if base_generation is None:
+                base = self.latest(namespace, notebook, slice_id)
+                if base is None:
+                    return []
+                base_generation = base.generation
+            chain = [d for d, _ in
+                     self._delta_entries(namespace, notebook, slice_id)
+                     if d.base_generation == base_generation]
+            return sorted(chain, key=lambda d: d.seq)
+
+    def delta_payload(self, namespace: str, notebook: str, slice_id: int,
+                      base_generation: int, seq: int) -> Optional[bytes]:
+        with self._lock:
+            return next(
+                (p for d, p in
+                 self._delta_entries(namespace, notebook, slice_id)
+                 if d.base_generation == base_generation and d.seq == seq),
+                None)
+
+    def materialize(self, namespace: str, notebook: str, slice_id: int,
+                    upto_seq: Optional[int] = None) -> Optional[bytes]:
+        """Replay the latest base payload plus its delta chain (through
+        `upto_seq` when given) into the current session state."""
+        with self._lock:
+            base = self.latest(namespace, notebook, slice_id)
+            if base is None:
+                return None
+            state = self.payload(namespace, notebook, slice_id,
+                                 generation=base.generation)
+            if state is None:
+                return None
+            for d in self.deltas(namespace, notebook, slice_id):
+                if upto_seq is not None and d.seq > upto_seq:
+                    break
+                chunk = self.delta_payload(namespace, notebook, slice_id,
+                                           d.base_generation, d.seq)
+                if chunk is None:
+                    break
+                state = state + chunk
+            return state
+
+    def chain_head(self, namespace: str, notebook: str,
+                   slice_id: int) -> Optional[tuple[int, int, str]]:
+        """(base_generation, head_seq, head_digest) of the current chain —
+        the freshness mark follower catch-up and the promote verb compare
+        against; None when no base snapshot exists."""
+        with self._lock:
+            base = self.latest(namespace, notebook, slice_id)
+            if base is None:
+                return None
+            chain = self.deltas(namespace, notebook, slice_id)
+            if not chain:
+                return (base.generation, 0, base.digest)
+            return (base.generation, chain[-1].seq, chain[-1].digest)
 
     # -- the control-plane "flush now" hook ------------------------------------
     def set_final_snapshot_handler(
@@ -162,6 +397,19 @@ class SessionStateStore:
     def _prune(self, namespace: str, notebook: str, slice_id: int) -> None:
         raise NotImplementedError
 
+    def _store_delta(self, info: DeltaInfo, delta: bytes) -> None:
+        raise NotImplementedError
+
+    def _delta_entries(self, namespace: str, notebook: str,
+                       slice_id: int) -> list[tuple[DeltaInfo, bytes]]:
+        raise NotImplementedError
+
+    def _prune_deltas(self, namespace: str, notebook: str, slice_id: int,
+                      keep_bases: set[int]) -> None:
+        """Drop delta chains whose base generation was pruned (a chain
+        without its base can never be replayed)."""
+        raise NotImplementedError
+
 
 class InMemorySessionStore(SessionStateStore):
     """Dict-backed store for unit tests and single-process drills."""
@@ -171,6 +419,8 @@ class InMemorySessionStore(SessionStateStore):
         super().__init__(clock=clock, max_to_keep=max_to_keep)
         self._data: dict[tuple[str, str, int],
                          list[tuple[SnapshotInfo, bytes]]] = {}
+        self._delta_data: dict[tuple[str, str, int],
+                               list[tuple[DeltaInfo, bytes]]] = {}
 
     @property
     def uri(self) -> str:
@@ -202,6 +452,25 @@ class InMemorySessionStore(SessionStateStore):
         entries = self._data.get(key, [])
         if len(entries) > self.max_to_keep:
             self._data[key] = entries[-self.max_to_keep:]
+
+    def _store_delta(self, info: DeltaInfo, delta: bytes) -> None:
+        key = (info.namespace, info.notebook, info.slice_id)
+        self._delta_data.setdefault(key, []).append((info, bytes(delta)))
+
+    def _delta_entries(self, namespace: str, notebook: str,
+                       slice_id: int) -> list[tuple[DeltaInfo, bytes]]:
+        with self._lock:
+            return list(self._delta_data.get((namespace, notebook,
+                                              slice_id), []))
+
+    def _prune_deltas(self, namespace: str, notebook: str, slice_id: int,
+                      keep_bases: set[int]) -> None:
+        key = (namespace, notebook, slice_id)
+        entries = self._delta_data.get(key)
+        if entries:
+            self._delta_data[key] = [
+                (d, p) for d, p in entries
+                if d.base_generation in keep_bases]
 
 
 class DirSessionStore(SessionStateStore):
@@ -314,6 +583,172 @@ class DirSessionStore(SessionStateStore):
             (d / f"gen-{stale.generation}.json").unlink(missing_ok=True)
             (d / f"gen-{stale.generation}.bin").unlink(missing_ok=True)
 
+    # delta chains live beside the base snapshots as
+    # `delta-<base>-<seq>.bin/.json` — a name shape the base-snapshot
+    # globs (`gen-*`) never match, so snapshot orphan GC cannot eat a
+    # committed delta.  Same commit discipline as _store: payload first,
+    # metadata commit marker atomically renamed LAST.
+    def _store_delta(self, info: DeltaInfo, delta: bytes) -> None:
+        d = self._slice_dir(info.namespace, info.notebook, info.slice_id)
+        d.mkdir(parents=True, exist_ok=True)
+        stem = f"delta-{info.base_generation}-{info.seq}"
+        _atomic_write(d / f"{stem}.bin", delta)
+        meta = {
+            "namespace": info.namespace,
+            "notebook": info.notebook,
+            "slice_id": info.slice_id,
+            "base_generation": info.base_generation,
+            "seq": info.seq,
+            "saved_at": info.saved_at,
+            "digest": info.digest,
+            "delta_digest": info.delta_digest,
+            "uri": info.uri,
+            "size": info.size,
+        }
+        _atomic_write(d / f"{stem}.json", json.dumps(meta).encode())
+
+    def _delta_entries(self, namespace: str, notebook: str,
+                       slice_id: int) -> list[tuple[DeltaInfo, bytes]]:
+        d = self._slice_dir(namespace, notebook, slice_id)
+        if not d.is_dir():
+            return []
+        with self._lock:
+            out = []
+            committed: set[tuple[int, int]] = set()
+            for meta_path in sorted(d.glob("delta-*.json")):
+                try:
+                    info = DeltaInfo(**json.loads(meta_path.read_text()))
+                except (OSError, ValueError, TypeError):
+                    # torn/corrupt commit marker: GC both halves
+                    meta_path.unlink(missing_ok=True)
+                    meta_path.with_suffix(".bin").unlink(missing_ok=True)
+                    continue
+                try:
+                    payload = meta_path.with_suffix(".bin").read_bytes()
+                except OSError:
+                    meta_path.unlink(missing_ok=True)
+                    continue
+                committed.add((info.base_generation, info.seq))
+                out.append((info, payload))
+            for bin_path in d.glob("delta-*.bin"):
+                parts = bin_path.stem.split("-")
+                try:
+                    key = (int(parts[1]), int(parts[2]))
+                except (IndexError, ValueError):
+                    bin_path.unlink(missing_ok=True)
+                    continue
+                if key not in committed:
+                    bin_path.unlink(missing_ok=True)
+            return sorted(out,
+                          key=lambda e: (e[0].base_generation, e[0].seq))
+
+    def _prune_deltas(self, namespace: str, notebook: str, slice_id: int,
+                      keep_bases: set[int]) -> None:
+        d = self._slice_dir(namespace, notebook, slice_id)
+        if not d.is_dir():
+            return
+        for meta_path in d.glob("delta-*.json"):
+            try:
+                base = int(meta_path.stem.split("-")[1])
+            except (IndexError, ValueError):
+                continue
+            if base not in keep_bases:
+                meta_path.unlink(missing_ok=True)
+                meta_path.with_suffix(".bin").unlink(missing_ok=True)
+
+
+class FollowerReplica:
+    """Follower catch-up cursor over one slice's base + delta stream.
+
+    The cursor tracks (base_generation, seq) and replays forward on each
+    `catch_up()` call: when the store's latest base generation moved (a
+    fresh snapshot or a compaction folded the chain), the follower
+    reloads that base in full — catch-up works from ANY base — then
+    applies the missing deltas in order, verifying each recorded
+    materialized-state digest as it goes.  A gap in the chain (a delta
+    pruned from under the cursor) stops the replay at the last verified
+    state rather than applying out of order.
+
+    In production this loop runs in the follower pod's runtime sidecar;
+    in tests FakeCluster drives one cursor per follower replica and
+    stamps the freshness onto the follower pods
+    (ANNOTATION_REPLICA_GENERATION/SEQ/DIGEST) for the promote verb."""
+
+    def __init__(self, store: SessionStateStore, namespace: str,
+                 notebook: str, slice_id: int = 0) -> None:
+        self.store = store
+        self.namespace = namespace
+        self.notebook = notebook
+        self.slice_id = slice_id
+        self.base_generation = 0
+        self.seq = 0
+        self.state: Optional[bytes] = None
+        self.applied_total = 0
+
+    def catch_up(self) -> int:
+        """Apply everything new; returns the number of replay steps taken
+        (base reloads count as one step)."""
+        applied = 0
+        with self.store._lock:
+            latest = self.store.latest(self.namespace, self.notebook,
+                                       self.slice_id)
+            if latest is None:
+                return 0
+            if latest.generation != self.base_generation:
+                payload = self.store.payload(
+                    self.namespace, self.notebook, self.slice_id,
+                    generation=latest.generation)
+                if payload is None:
+                    return 0
+                self.state = payload
+                self.base_generation = latest.generation
+                self.seq = 0
+                applied += 1
+            for d in self.store.deltas(self.namespace, self.notebook,
+                                       self.slice_id,
+                                       base_generation=self.base_generation):
+                if d.seq <= self.seq:
+                    continue
+                if d.seq != self.seq + 1:
+                    break  # chain gap: stop at the last verified state
+                chunk = self.store.delta_payload(
+                    self.namespace, self.notebook, self.slice_id,
+                    d.base_generation, d.seq)
+                if chunk is None:
+                    break
+                state = (self.state or b"") + chunk
+                if payload_digest(state) != d.digest:
+                    raise DeltaChainError(
+                        f"follower replay digest mismatch at "
+                        f"{self.namespace}/{self.notebook}/slice-"
+                        f"{self.slice_id} delta {d.base_generation}-"
+                        f"{d.seq}")
+                self.state = state
+                self.seq = d.seq
+                applied += 1
+        self.applied_total += applied
+        return applied
+
+    @property
+    def digest(self) -> str:
+        return payload_digest(self.state) if self.state is not None else ""
+
+    def lag(self) -> int:
+        """Replay steps between this cursor and the chain head (0 = fully
+        caught up; a stale base counts the full chain behind the new
+        base)."""
+        head = self.store.chain_head(self.namespace, self.notebook,
+                                     self.slice_id)
+        if head is None:
+            return 0
+        head_gen, head_seq, _ = head
+        if head_gen != self.base_generation:
+            return 1 + head_seq
+        return max(head_seq - self.seq, 0)
+
+    def caught_up(self, max_lag: int = 0) -> bool:
+        return self.lag() <= max_lag
+
 
 def _atomic_write(final: Path, data: bytes) -> None:
     """tmp file in the target dir -> write -> fsync -> atomic rename ->
@@ -344,10 +779,15 @@ def open_store(uri: str, clock: Optional[Clock] = None,
 
 
 __all__ = [
+    "DeltaChainError",
+    "DeltaInfo",
     "DirSessionStore",
+    "FollowerReplica",
     "InMemorySessionStore",
     "SessionStateStore",
     "SnapshotInfo",
+    "StaleWriterError",
+    "TRIGGER_COMPACT",
     "TRIGGER_CULL",
     "TRIGGER_FINAL",
     "TRIGGER_PERIODIC",

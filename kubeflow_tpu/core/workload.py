@@ -72,7 +72,7 @@ def _base_pod_template(nb: Notebook, cfg: CoreConfig, sts_name: str) -> dict:
 
 
 def _render_checkpoint_contract(
-    nb: Notebook, cfg: CoreConfig, template: dict, slice_id: int
+    nb: Notebook, cfg: CoreConfig, template: dict, gang: int
 ) -> None:
     """Checkpoint-sidecar contract on a TPU worker template (rendered only
     when CHECKPOINT_STORE_URI is configured):
@@ -80,9 +80,10 @@ def _render_checkpoint_contract(
     - env the in-pod runtime reads (runtime/checkpoint.py): the store URI
       and the periodic snapshot interval;
     - restore stamping: when `status.sessionState` carries a restore
-      intent for this slice (the migrate verb's write-ahead record), the
-      recreated pods get CHECKPOINT_RESTORE_URI/_GENERATION so the
-      runtime reloads the session instead of starting cold;
+      intent for this gang (the migrate verb's write-ahead record;
+      replicated notebooks key it by flat gang index), the recreated
+      pods get CHECKPOINT_RESTORE_URI/_GENERATION so the runtime
+      reloads the session instead of starting cold;
     - a pre-stop exec hook (one last snapshot before any pod delete) and
       the downward-API podinfo projection of the checkpoint-requested
       annotation — the file transport CullSignalWatcher polls, so
@@ -95,7 +96,7 @@ def _render_checkpoint_contract(
         {"name": C.ENV_CHECKPOINT_INTERVAL_S,
          "value": f"{cfg.checkpoint_interval_s:g}"},
     ]
-    session = (nb.status.get("sessionState") or {}).get(str(slice_id)) or {}
+    session = (nb.status.get("sessionState") or {}).get(str(gang)) or {}
     if session.get("restoreGeneration") is not None:
         injected += [
             {"name": C.ENV_CHECKPOINT_RESTORE_URI,
@@ -159,53 +160,87 @@ def generate_statefulsets(nb: Notebook, cfg: CoreConfig) -> list[KubeObject]:
         return [sts]
 
     shape = tpu.validate()
-    # slice-scheduler placement intent (core/scheduler.py): slice id ->
+    # slice-scheduler placement intent (core/scheduler.py): gang index ->
     # node-pool assignment, rendered as a nodeSelector so the whole gang
     # co-locates on the pool the scheduler chose
     from .scheduler import placement_of
 
     placement = placement_of(nb.metadata.annotations)
+    rep = nb.replication
+    replicas = rep.replicas if rep is not None else 1
+    live_rep = nb.status.get("replication") or {}
+    primary = int(live_rep.get("primary", 0))
+    epoch = int(live_rep.get("epoch", 1))
     out = []
-    for slice_id in range(tpu.slices):
-        name = tpuenv.statefulset_name(nb.name, slice_id, tpu.slices)
-        # the slice suffix counts against the 52-char guard too
-        use_generate_name = len(name) > C.MAX_STATEFULSET_NAME_LENGTH
-        template = _base_pod_template(nb, cfg, name)
-        template["metadata"]["labels"][C.TPU_SLICE_LABEL] = str(slice_id)
-        pod_spec = template["spec"]
-        selector = pod_spec.setdefault("nodeSelector", {})
-        selector[C.GKE_TPU_ACCELERATOR_LABEL] = shape.accelerator.gke_label
-        selector[C.GKE_TPU_TOPOLOGY_LABEL] = shape.topology
-        assigned_pool = (placement.get(str(slice_id)) or {}).get("pool")
-        if assigned_pool:
-            selector[C.GKE_NODEPOOL_LABEL] = assigned_pool
-        main = pod_spec["containers"][0]
-        resources = main.setdefault("resources", {})
-        for kind in ("requests", "limits"):
-            resources.setdefault(kind, {})[C.TPU_RESOURCE] = str(shape.chips_per_host)
-        main["env"] = tpuenv.merge_env(
-            main["env"], tpuenv.tpu_env_vars(nb.name, shape, slice_id, tpu.slices)
-        )
-        if cfg.checkpoint_store_uri:
-            _render_checkpoint_contract(nb, cfg, template, slice_id)
-        sts = KubeObject(
-            api_version="apps/v1",
-            kind="StatefulSet",
-            metadata=_sts_meta(nb, name, use_generate_name),
-            body={
-                "spec": {
-                    # slice-atomic: all hosts or none — partial slices can
-                    # never run a collective, so 0 is the only other state
-                    "replicas": 0 if stopped else shape.num_hosts,
-                    "serviceName": tpuenv.headless_service_name(nb.name),
-                    "podManagementPolicy": "Parallel",
-                    "selector": {"matchLabels": {C.STATEFULSET_LABEL: name}},
-                    "template": template,
-                }
-            },
-        )
-        sts.metadata.labels[C.NOTEBOOK_NAME_LABEL] = nb.name
-        out.append(sts)
+    # replica-major gang order: replica 0's slices first, so gang index
+    # g = replica * slices + slice_id lines up with the scheduler's
+    # placement keys, the recovery engine's detection indexes, and the
+    # sessionState bookkeeping (all keyed by flat gang index)
+    for replica in range(replicas):
+        for slice_id in range(tpu.slices):
+            gang = replica * tpu.slices + slice_id
+            name = tpuenv.statefulset_name(
+                nb.name, slice_id, tpu.slices, replica)
+            # the slice/replica suffix counts against the 52-char guard too
+            use_generate_name = len(name) > C.MAX_STATEFULSET_NAME_LENGTH
+            template = _base_pod_template(nb, cfg, name)
+            template["metadata"]["labels"][C.TPU_SLICE_LABEL] = str(slice_id)
+            if rep is not None:
+                template["metadata"]["labels"][C.REPLICA_LABEL] = str(replica)
+            pod_spec = template["spec"]
+            selector = pod_spec.setdefault("nodeSelector", {})
+            selector[C.GKE_TPU_ACCELERATOR_LABEL] = \
+                shape.accelerator.gke_label
+            selector[C.GKE_TPU_TOPOLOGY_LABEL] = shape.topology
+            assigned_pool = (placement.get(str(gang)) or {}).get("pool")
+            if assigned_pool:
+                selector[C.GKE_NODEPOOL_LABEL] = assigned_pool
+            main = pod_spec["containers"][0]
+            resources = main.setdefault("resources", {})
+            for kind in ("requests", "limits"):
+                resources.setdefault(kind, {})[C.TPU_RESOURCE] = \
+                    str(shape.chips_per_host)
+            main["env"] = tpuenv.merge_env(
+                main["env"],
+                tpuenv.tpu_env_vars(nb.name, shape, slice_id, tpu.slices,
+                                    replica))
+            if rep is not None:
+                # boot-time hints only: the authoritative role is the
+                # status.replication pointer + the store's write fence.
+                # A promotion flip re-renders these, but running pods
+                # keep their boot env — a demoted primary that trusts
+                # its stale env hits StaleWriterError at the store.
+                main["env"] = tpuenv.merge_env(main["env"], [
+                    {"name": C.ENV_REPLICA_INDEX, "value": str(replica)},
+                    {"name": C.ENV_REPLICATION_ROLE,
+                     "value": C.ROLE_PRIMARY if replica == primary
+                     else C.ROLE_FOLLOWER},
+                    {"name": C.ENV_REPLICATION_EPOCH, "value": str(epoch)},
+                ])
+            if cfg.checkpoint_store_uri:
+                _render_checkpoint_contract(nb, cfg, template, gang)
+            sts = KubeObject(
+                api_version="apps/v1",
+                kind="StatefulSet",
+                metadata=_sts_meta(nb, name, use_generate_name),
+                body={
+                    "spec": {
+                        # slice-atomic: all hosts or none — partial slices
+                        # can never run a collective, so 0 is the only
+                        # other state
+                        "replicas": 0 if stopped else shape.num_hosts,
+                        "serviceName": tpuenv.headless_service_name(nb.name),
+                        "podManagementPolicy": "Parallel",
+                        "selector": {
+                            "matchLabels": {C.STATEFULSET_LABEL: name}},
+                        "template": template,
+                    }
+                },
+            )
+            sts.metadata.labels[C.NOTEBOOK_NAME_LABEL] = nb.name
+            if rep is not None:
+                sts.metadata.labels[C.REPLICA_LABEL] = str(replica)
+            out.append(sts)
     return out
 
 
@@ -213,13 +248,20 @@ def generate_service(nb: Notebook) -> KubeObject:
     """ClusterIP Service 80 -> notebook port, name http-notebook (Istio-
     compatible port naming), selecting the (first) statefulset's pods
     (notebook_controller.go:525-552).  For TPU notebooks this fronts worker
-    0, where the JupyterLab server runs."""
+    0, where the JupyterLab server runs.  Replicated notebooks front the
+    CURRENT primary's worker 0: a promotion flips status.replication.primary
+    and the very next reconcile repoints this selector — user traffic
+    follows the failover with no pod restarts in between."""
     containers = nb.pod_spec.get("containers") or []
     port = C.DEFAULT_CONTAINER_PORT
     if containers and containers[0].get("ports"):
         port = int(containers[0]["ports"][0].get("containerPort", port))
     tpu = nb.tpu
-    sts0 = tpuenv.statefulset_name(nb.name, 0, tpu.slices if tpu else 1)
+    primary = 0
+    if nb.replication is not None:
+        primary = int((nb.status.get("replication") or {}).get("primary", 0))
+    sts0 = tpuenv.statefulset_name(
+        nb.name, 0, tpu.slices if tpu else 1, primary)
     return KubeObject(
         api_version="v1",
         kind="Service",

@@ -30,6 +30,11 @@ _TELEMETRY_ANNOTATION = "notebooks.kubeflow.org/telemetry"
 _RESTORED_GENERATION_ANNOTATION = \
     "notebooks.kubeflow.org/restored-generation"
 _RESTORED_DIGEST_ANNOTATION = "notebooks.kubeflow.org/restored-digest"
+_REPLICA_LABEL = "notebooks.kubeflow.org/replica"
+_REPLICA_GENERATION_ANNOTATION = \
+    "notebooks.kubeflow.org/replica-generation"
+_REPLICA_SEQ_ANNOTATION = "notebooks.kubeflow.org/replica-seq"
+_REPLICA_DIGEST_ANNOTATION = "notebooks.kubeflow.org/replica-digest"
 _GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 _GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 _GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
@@ -65,6 +70,13 @@ class FakeCluster:
         self.auto_ready = auto_ready
         self._pod_ip_counter = 0
         self._failed_pods: set[tuple[str, str]] = set()
+        # checkpoint-restore latency model: with restore_hold on, a pod
+        # recreated with CHECKPOINT_RESTORE_* env stays Pending
+        # ("RestoringCheckpoint") until release_restores() — tests advance
+        # the fake clock across the hold so snapshot->restore pays its
+        # real-world reload time while promotion (no pod recreate) does not
+        self.restore_hold = False
+        self._held_restores: set[tuple[str, str]] = set()
         # (namespace, sts_name) -> failure reason: pods (re)created for a
         # poisoned StatefulSet come up Failed (see poison_statefulset)
         self._poisoned: dict[tuple[str, str], str] = {}
@@ -424,6 +436,80 @@ class FakeCluster:
                     trigger=trigger))
         return infos
 
+    def stream_session_delta(self, namespace: str, notebook: str,
+                             delta: bytes,
+                             writer_epoch: Optional[int] = None) -> list:
+        """Simulate the primary kernel appending one increment of live
+        session state: every slice's delta chain grows by `delta` (lazily
+        seeding a base snapshot from the current payload when the chain
+        has no anchor yet) and the simulated in-memory payload advances.
+        `writer_epoch` carries the primary's fencing token — a demoted
+        primary calling this after promotion raised the fence gets
+        StaleWriterError from the store and the payload does NOT advance
+        (the zombie-write near-miss the failover soak counts)."""
+        assert self._session_store is not None, "attach_session_store first"
+        store = self._session_store
+        payload = self.session_payload(namespace, notebook)
+        infos = []
+        with self.api.fault_exempt():
+            for slice_id in sorted(self._slice_ids(namespace, notebook)):
+                if store.latest(namespace, notebook, slice_id) is None:
+                    store.put(namespace, notebook, slice_id, payload,
+                              writer_epoch=writer_epoch)
+                infos.append(store.append_delta(
+                    namespace, notebook, slice_id, bytes(delta),
+                    writer_epoch=writer_epoch))
+        self._session_payload[(namespace, notebook)] = \
+            payload + bytes(delta)
+        return infos
+
+    def sync_followers(self, namespace: str, notebook: str,
+                       lag: int = 0) -> int:
+        """Play the follower runtimes' catch-up loops: every replica-
+        labeled worker pod of `notebook` replays its slice's delta chain
+        (through head minus `lag` steps) and stamps the replica-freshness
+        annotations the election in core/selfheal.py reads as positive
+        evidence.  Returns the number of pods stamped."""
+        assert self._session_store is not None, "attach_session_store first"
+        from ..core.sessionstate import payload_digest
+
+        store = self._session_store
+        stamped = 0
+        with self.api.fault_exempt():
+            for pod in self.api.list("Pod", namespace=namespace):
+                labels = pod.metadata.labels
+                if labels.get(_NOTEBOOK_NAME_LABEL) != notebook:
+                    continue
+                if _REPLICA_LABEL not in labels:
+                    continue
+                try:
+                    slice_id = int(labels.get(_TPU_SLICE_LABEL, "0"))
+                except ValueError:
+                    continue
+                head = store.chain_head(namespace, notebook, slice_id)
+                if head is None:
+                    continue
+                gen, head_seq, head_digest = head
+                seq = max(head_seq - max(lag, 0), 0)
+                if seq == head_seq:
+                    digest = head_digest
+                else:
+                    state = store.materialize(
+                        namespace, notebook, slice_id, upto_seq=seq)
+                    digest = payload_digest(state or b"")
+                live = self.api.get("Pod", namespace, pod.name).deepcopy()
+                ann = live.metadata.annotations
+                if ann.get(_REPLICA_GENERATION_ANNOTATION) == str(gen) \
+                        and ann.get(_REPLICA_SEQ_ANNOTATION) == str(seq) \
+                        and ann.get(_REPLICA_DIGEST_ANNOTATION) == digest:
+                    continue
+                ann[_REPLICA_GENERATION_ANNOTATION] = str(gen)
+                ann[_REPLICA_SEQ_ANNOTATION] = str(seq)
+                ann[_REPLICA_DIGEST_ANNOTATION] = digest
+                self.api.update(live)
+                stamped += 1
+        return stamped
+
     def _slice_ids(self, namespace: str, notebook: str) -> set[int]:
         out = set()
         for pod in self.api.list("Pod", namespace=namespace):
@@ -570,6 +656,7 @@ class FakeCluster:
                 self._unaccount_pod(ev.obj)
                 self._unindex_pod(ev.obj)
                 self._failed_pods.discard((ev.obj.namespace, ev.obj.name))
+                self._held_restores.discard((ev.obj.namespace, ev.obj.name))
                 owner = ev.obj.metadata.controller_owner()
                 if owner is not None and owner.kind == "StatefulSet":
                     self._reconcile_sts(ev.obj.namespace, owner.name)
@@ -662,7 +749,40 @@ class FakeCluster:
         if poison is not None:
             self._fail_pod(namespace, name, poison)
         elif self.auto_ready:
-            self._mark_running(pod)
+            if self.restore_hold and \
+                    _RESTORED_GENERATION_ANNOTATION in pod.metadata.annotations:
+                self._hold_restore(pod)
+            else:
+                self._mark_running(pod)
+
+    def _hold_restore(self, pod: KubeObject) -> None:
+        """Park a restore-stamped pod in Pending while the modeled
+        checkpoint reload runs; release_restores() flips it Ready."""
+        self._held_restores.add((pod.namespace, pod.name))
+        pod.status = {
+            "phase": "Pending",
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {
+                    "type": "Ready",
+                    "status": "False",
+                    "reason": "RestoringCheckpoint",
+                    "message": "reloading session snapshot into the runtime",
+                },
+            ],
+        }
+        self.api.update_status(pod)
+
+    def release_restores(self) -> int:
+        """Complete every in-flight checkpoint reload: flip held pods to
+        Running/Ready.  Call after advancing the fake clock by the restore
+        time the drill wants snapshot->restore recoveries to pay."""
+        with self._mutex:
+            held = sorted(self._held_restores)
+            self._held_restores.clear()
+        for ns, name in held:
+            self.mark_running(ns, name)
+        return len(held)
 
     def _mark_running(self, pod: KubeObject) -> None:
         self._pod_ip_counter += 1
@@ -785,7 +905,11 @@ class FakeCluster:
             if poison is not None:
                 self._fail_pod(pod.namespace, pod.name, poison)
             elif self.auto_ready:
-                self._mark_running(pod)
+                if self.restore_hold and _RESTORED_GENERATION_ANNOTATION \
+                        in pod.metadata.annotations:
+                    self._hold_restore(pod)
+                else:
+                    self._mark_running(pod)
             self._sync_sts_status_for_pod(pod)
 
     def _sync_sts_status_for_pod(self, pod: KubeObject) -> None:

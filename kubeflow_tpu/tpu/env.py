@@ -31,29 +31,38 @@ def headless_service_name(notebook_name: str) -> str:
 
 
 def worker_hostname(
-    notebook_name: str, slice_id: int, num_slices: int, ordinal: int
+    notebook_name: str, slice_id: int, num_slices: int, ordinal: int,
+    replica: int = 0,
 ) -> str:
     """Short DNS name of one worker through the headless Service.
 
     Resolvable cluster-wide as {pod}.{svc}.{ns}.svc via the pod's
-    subdomain; we emit the svc-qualified short form GKE uses.
+    subdomain; we emit the svc-qualified short form GKE uses.  All
+    replica gangs share the notebook's one headless Service — follower
+    pods carry the same notebook-name label, so their names resolve
+    through the same subdomain.
     """
-    sts = statefulset_name(notebook_name, slice_id, num_slices)
+    sts = statefulset_name(notebook_name, slice_id, num_slices, replica)
     return f"{sts}-{ordinal}.{headless_service_name(notebook_name)}"
 
 
-def statefulset_name(notebook_name: str, slice_id: int, num_slices: int) -> str:
+def statefulset_name(notebook_name: str, slice_id: int, num_slices: int,
+                     replica: int = 0) -> str:
     """Slice 0 of a single-slice notebook keeps the bare CR name so the
     CPU-path naming contract (STS == notebook name, reference
-    notebook_controller.go:433-447) holds; multi-slice appends -slice-N."""
-    if num_slices <= 1:
-        return notebook_name
-    return f"{notebook_name}-slice-{slice_id}"
+    notebook_controller.go:433-447) holds; multi-slice appends -slice-N.
+    Replica 0 (the boot-time primary) keeps the unreplicated names —
+    turning replication on never renames a running workload; follower
+    gangs append -rN."""
+    base = notebook_name if num_slices <= 1 \
+        else f"{notebook_name}-slice-{slice_id}"
+    return base if replica <= 0 else f"{base}-r{replica}"
 
 
-def worker_hostnames(notebook_name: str, shape: SliceShape, slice_id: int, num_slices: int) -> list[str]:
+def worker_hostnames(notebook_name: str, shape: SliceShape, slice_id: int,
+                     num_slices: int, replica: int = 0) -> list[str]:
     return [
-        worker_hostname(notebook_name, slice_id, num_slices, i)
+        worker_hostname(notebook_name, slice_id, num_slices, i, replica)
         for i in range(shape.num_hosts)
     ]
 
@@ -63,6 +72,7 @@ def tpu_env_vars(
     shape: SliceShape,
     slice_id: int,
     num_slices: int,
+    replica: int = 0,
 ) -> list[dict]:
     """corev1.EnvVar list (dict form) for every worker container in a slice.
 
@@ -70,9 +80,14 @@ def tpu_env_vars(
     ordinals — the same property the reference exploits for NB_PREFIX being
     identical across the (single) replica.
     """
-    hostnames = ",".join(worker_hostnames(notebook_name, shape, slice_id, num_slices))
+    # each replica gang is its own coordination domain: followers run a
+    # full jax.distributed world of their own, continuously restoring the
+    # primary's delta stream — so every address below stays intra-replica
+    hostnames = ",".join(
+        worker_hostnames(notebook_name, shape, slice_id, num_slices, replica))
     coordinator = (
-        f"{worker_hostname(notebook_name, 0, num_slices, 0)}:{JAX_COORDINATOR_PORT}"
+        f"{worker_hostname(notebook_name, 0, num_slices, 0, replica)}"
+        f":{JAX_COORDINATOR_PORT}"
     )
     env: list[dict] = [
         {
@@ -92,7 +107,8 @@ def tpu_env_vars(
         {"name": "COORDINATOR_ADDRESS", "value": coordinator},
     ]
     if num_slices > 1:
-        megascale_coord = worker_hostname(notebook_name, 0, num_slices, 0)
+        megascale_coord = worker_hostname(
+            notebook_name, 0, num_slices, 0, replica)
         env += [
             {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value": megascale_coord},
             {"name": "MEGASCALE_NUM_SLICES", "value": str(num_slices)},

@@ -126,6 +126,15 @@ class CoreConfig:
     checkpoint_interval_s: float = 300.0      # CHECKPOINT_INTERVAL_S
     checkpoint_max_age_s: float = 600.0       # CHECKPOINT_MAX_AGE_S
     checkpoint_signal_root: str = ""          # CHECKPOINT_SIGNAL_ROOT
+    # replicated-kernel tier (spec.replication + core/selfheal.py promote
+    # verb): a follower counts as caught up — and is eligible for
+    # promotion — when it has applied the latest base snapshot and trails
+    # the delta chain head by at most replication_max_lag deltas.
+    # slo_promotion_p99_s bounds the promote verb's latency objective
+    # (<= 0 disables it); promotions also land in the shared
+    # notebook_disruption_recovery_seconds stream.
+    replication_max_lag: int = 2              # REPLICATION_MAX_LAG
+    slo_promotion_p99_s: float = 1.0          # SLO_PROMOTION_P99_S
     # topology-aware slice scheduler + warm-pool autoscaler
     # (core/scheduler.py).  When enabled, TPU workload StatefulSets are
     # gang-gated on an all-or-nothing placement intent, and a warm pool of
@@ -251,6 +260,9 @@ class CoreConfig:
             checkpoint_max_age_s=_float(
                 env, "CHECKPOINT_MAX_AGE_S", 600.0),
             checkpoint_signal_root=env.get("CHECKPOINT_SIGNAL_ROOT", ""),
+            replication_max_lag=max(0, _int(
+                env, "REPLICATION_MAX_LAG", 2)),
+            slo_promotion_p99_s=_float(env, "SLO_PROMOTION_P99_S", 1.0),
             enable_slice_scheduler=_bool(
                 env, "ENABLE_SLICE_SCHEDULER", False),
             warmpool_size=max(0, _int(env, "WARMPOOL_SIZE", 0)),

@@ -67,6 +67,7 @@ STAGE_RETRY_BACKOFF = "retry_backoff"
 STAGE_RECOVERY_WAIT = "recovery_wait"
 STAGE_RECOVER = "recover"
 STAGE_MIGRATE = "migrate"
+STAGE_PROMOTE = "promote"
 STAGE_OTHER = "reconcile_other"
 
 _SCHEDULE_WAIT = "_schedule_wait"  # placeholder, resolved warm/cold
@@ -75,7 +76,8 @@ STAGES = (
     STAGE_QUEUE_WAIT, STAGE_HANDOFF_WAIT, STAGE_SCHEDULE_WARM,
     STAGE_SCHEDULE_COLD, STAGE_RENDER, STAGE_APPLY, STAGE_STATUS,
     STAGE_POD_SCHEDULE, STAGE_POD_START, STAGE_RETRY_BACKOFF,
-    STAGE_RECOVERY_WAIT, STAGE_RECOVER, STAGE_MIGRATE, STAGE_OTHER,
+    STAGE_RECOVERY_WAIT, STAGE_RECOVER, STAGE_MIGRATE, STAGE_PROMOTE,
+    STAGE_OTHER,
 )
 
 # phase attribute (controllers' child spans) -> ledger stage
@@ -86,6 +88,7 @@ _PHASE_STAGES = {
     "schedule": _SCHEDULE_WAIT,
     "recover": STAGE_RECOVER,
     "migrate": STAGE_MIGRATE,
+    "promote": STAGE_PROMOTE,
 }
 
 # Ready-time spans minutes at fleet scale, far past reconcile-time's
@@ -366,13 +369,13 @@ class LifecycleLedger:
             st[1] += dur
 
     def _record_excursions(self, entry: _Entry, attempt: _Attempt) -> None:
-        """Post-ready recover/migrate work: attributed to its stage
+        """Post-ready recover/migrate/promote work: attributed to its stage
         histogram but outside the conserved event->ready window.  Called
         under the lock."""
         exemplar = ({"trace_id": attempt.trace_id}
                     if attempt.trace_id else None)
         for (s, e, stage) in attempt.segments:
-            if stage not in (STAGE_RECOVER, STAGE_MIGRATE):
+            if stage not in (STAGE_RECOVER, STAGE_MIGRATE, STAGE_PROMOTE):
                 continue
             dur = max(e - s, 0.0)
             self.excursions_total += 1

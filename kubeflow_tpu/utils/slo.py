@@ -170,6 +170,17 @@ def default_objectives(cfg) -> tuple[Objective, ...]:
             metric="notebook_disruption_recovery_seconds",
             threshold_s=cfg.slo_recovery_p99_s,
             description="p99 disruption detection -> slice Healthy"))
+    # replicated-kernel objective (core/selfheal.py promote verb): the
+    # tier's promise is sub-second failover, so the default ceiling is
+    # 1s — an election that has to wait out follower catch-up or a
+    # contended promotion record burns this budget
+    if cfg.slo_promotion_p99_s > 0:
+        out.append(Objective(
+            name="promotion_duration", kind=KIND_LATENCY,
+            metric="notebook_promotion_duration_seconds",
+            threshold_s=cfg.slo_promotion_p99_s,
+            description="p99 primary disruption -> follower promoted "
+                        "(replicated notebooks)"))
     if cfg.enable_slice_scheduler and cfg.slo_warmpool_hit_rate > 0:
         out.append(Objective(
             name="warmpool_hit_rate", kind=KIND_RATIO,

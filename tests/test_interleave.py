@@ -29,12 +29,15 @@ from collections import Counter
 
 import pytest
 
-from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.api.types import Notebook, ReplicationSpec, TPUSpec
 from kubeflow_tpu.core import constants as C
 from kubeflow_tpu.core.metrics import NotebookMetrics
 from kubeflow_tpu.core.scheduler import SliceScheduler, pool_object_name
 from kubeflow_tpu.core.selfheal import RecoveryEngine
-from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+from kubeflow_tpu.core.sessionstate import (
+    InMemorySessionStore,
+    StaleWriterError,
+)
 from kubeflow_tpu.kube import (
     ApiServer,
     KubeObject,
@@ -311,6 +314,138 @@ def test_write_ahead_restore_under_all_schedules():
     _explore(migrate_scenario)
 
 
+# -- protocol E: epoch-fenced primary promotion --------------------------------
+def _promote_scenario(engine_cls):
+    """Two recovery engines (the manager and its failover twin) race the
+    promote verb for a replicated notebook whose primary gang died, while
+    a zombie primary (gated to fire only after a promotion completed)
+    keeps appending deltas with the OLD epoch.  Every schedule must keep
+    the fenced-election contract: the write-ahead promotion record is
+    persisted before the store fence ever rises (asserted at the fence
+    call itself), the membership change is exactly one epoch bump with a
+    completed promotion record, and every zombie write is rejected with
+    StaleWriterError — no kernel-state write can land after demotion."""
+    api = ApiServer()
+    clock = FakeClock()
+    cfg = CoreConfig()
+    metrics = NotebookMetrics(api)
+
+    class _WriteAheadCheckedStore(InMemorySessionStore):
+        def fence(self, namespace, notebook, epoch):
+            status = api.get("Notebook", namespace, notebook) \
+                .body.get("status") or {}
+            promo = (status.get("replication") or {}).get("promotion") or {}
+            assert promo.get("epoch") == epoch and \
+                promo.get("phase") in ("promoting", "promoted"), (
+                    "fence raised to %d before the promotion record was "
+                    "persisted (promotion=%r)" % (epoch, promo))
+            return super().fence(namespace, notebook, epoch)
+
+    store = _WriteAheadCheckedStore(clock=clock)
+    store.put("u1", "rep", 0, b"base", writer_epoch=1)
+    store.append_delta("u1", "rep", 0, b"+d1", writer_epoch=1)
+    store.append_delta("u1", "rep", 0, b"+d2", writer_epoch=1)
+    head_gen, head_seq, head_digest = store.chain_head("u1", "rep", 0)
+
+    nb = Notebook.new("rep", "u1", tpu=SPEC,
+                      replication=ReplicationSpec(replicas=2))
+    created = api.create(nb.obj)
+    created.status = {"replication": {"epoch": 1, "primary": 0}}
+    api.update_status(created)
+
+    follower_pods = [
+        KubeObject(
+            api_version="v1", kind="Pod",
+            metadata=ObjectMeta(
+                name="rep-r1-%d" % i, namespace="u1",
+                annotations={
+                    C.ANNOTATION_REPLICA_GENERATION: str(head_gen),
+                    C.ANNOTATION_REPLICA_SEQ: str(head_seq),
+                    C.ANNOTATION_REPLICA_DIGEST: head_digest,
+                }),
+            body={"spec": {}, "status": {
+                "phase": "Running",
+                "conditions": [{"type": "Ready", "status": "True"}],
+            }})
+        for i in range(SPEC.shape.num_hosts)
+    ]
+    gang_pods = {"rep": [_failed_pod("rep-0")], "rep-r1": follower_pods}
+
+    promoted_seen = [False]
+    restarts: list[tuple[str, str]] = []
+    zombie_attempts: list[str] = []
+    zombie_successes: list[str] = []
+
+    engines = {}
+    for mgr_name in ("mgr-a", "mgr-b"):
+        engines[mgr_name] = engine_cls(
+            api, cfg, metrics, EventRecorder(api, mgr_name),
+            clock=clock, session=store)
+
+    def recover(mgr_name):
+        def run():
+            engines[mgr_name].maybe_recover(
+                Notebook(api.get("Notebook", "u1", "rep")),
+                ["rep", "rep-r1"],
+                lambda live_name: gang_pods.get(live_name, []),
+                lambda live_name: restarts.append((mgr_name, live_name)),
+                stamp_restore=lambda live_name, idx: None)
+            status = api.get("Notebook", "u1", "rep") \
+                .body.get("status") or {}
+            promo = (status.get("replication") or {}).get("promotion") or {}
+            if promo.get("phase") == "promoted":
+                promoted_seen[0] = True
+        return run
+
+    def zombie():
+        # fires only after a promotion completed (plain-flag gate: the
+        # await_cond predicate runs on the scheduler thread and may not
+        # touch the store or apiserver) — by then the fence MUST hold
+        await_cond("promoted", lambda: promoted_seen[0])
+        zombie_attempts.append("d3")
+        try:
+            store.append_delta("u1", "rep", 0, b"+zombie", writer_epoch=1)
+            zombie_successes.append("d3")
+        except StaleWriterError:
+            pass
+
+    def check():
+        status = api.get("Notebook", "u1", "rep").body.get("status") or {}
+        rep = status.get("replication") or {}
+        # exactly one committed epoch bump, promotion record terminal
+        assert rep.get("epoch") == 2, (
+            "epoch must bump exactly once: %r" % rep)
+        assert rep.get("primary") == 1, rep
+        promo = rep.get("promotion") or {}
+        assert promo.get("phase") == "promoted", promo
+        assert promo.get("from") == 0 and promo.get("to") == 1, promo
+        assert store.fence_epoch("u1", "rep") == 2
+        # the zombie primary got fenced, never through
+        assert zombie_attempts and not zombie_successes, (
+            "zombie write landed after demotion: %r" % zombie_successes)
+        assert store.fenced_rejections.get(("u1", "rep"), 0) >= 1
+        # at least one engine promoted; a racer resuming the in-flight
+        # record may legitimately complete it too (idempotent flip)
+        promoted = metrics.promotions.value("u1", "promoted")
+        lost = metrics.promotions.value("u1", "lost-race")
+        assert promoted >= 1, (promoted, lost)
+        assert promoted + lost <= 2, (promoted, lost)
+        # the chain head the election keyed on was never corrupted
+        assert store.chain_head("u1", "rep", 0) == \
+            (head_gen, head_seq, head_digest)
+
+    return [("mgr-a", recover("mgr-a")), ("mgr-b", recover("mgr-b")),
+            ("zombie", zombie)], check
+
+
+def promote_scenario():
+    return _promote_scenario(RecoveryEngine)
+
+
+def test_promotion_fencing_under_all_schedules():
+    _explore(promote_scenario)
+
+
 # -- protocol D: sharded control-plane handoff ---------------------------------
 def shard_handoff_scenario(shard_mod=None):
     """Replica A owns the whole keyspace; replica B joins after A's lease
@@ -461,8 +596,10 @@ def _load_mutant(module: str, mutations, name: str):
 # budget charge and restore intent no longer persist before pod deletes.
 MUTANT_A = [(
     """            self._write_bookkeeping(nb, recovery, exhausted, session_state,
+                                    replication=replication,
                                     skip_if_unchanged=(prev_recovery,
-                                                       prev_session))""",
+                                                       prev_session,
+                                                       prev_replication))""",
     "            pass  # MUTANT A: write-ahead bookkeeping dropped",
 )]
 
@@ -526,6 +663,30 @@ def test_mutant_dropped_write_ahead_is_caught():
     assert fail.directives == {}, fail.narrative
     assert "restore intent was persisted" in fail.message \
         or "attempt charge" in fail.message, fail.message
+
+
+# Mutant P: delete the fence raise between the write-ahead promotion
+# record and the primary flip — the linearization point of the election is
+# gone, so a demoted zombie primary can keep acking session writes with
+# its stale epoch after the new primary took over.
+MUTANT_PROMOTE = [(
+    """            if self.session is not None:
+                self.session.fence(nb.namespace, nb.name, entry["epoch"])
+                span.add_event("promote.fenced", {
+                    "epoch": entry["epoch"]})""",
+    "            pass  # MUTANT P: promotion no longer fences the store",
+)]
+
+
+def test_mutant_unfenced_promotion_is_caught():
+    mod = _load_mutant("kubeflow_tpu.core.selfheal", MUTANT_PROMOTE,
+                       "kubeflow_tpu.core._selfheal_mutant_promote")
+
+    fail = _explore_mutant(lambda: _promote_scenario(mod.RecoveryEngine))
+    # pinned shrunk schedule: even the sequential zero-preemption schedule
+    # lets the zombie's stale-epoch delta land once the fence is gone
+    assert fail.preemptions == 0, fail.narrative
+    assert fail.directives == {}, fail.narrative
 
 
 # Mutant C: adopt from the join PREVIEW instead of the commit — the map

@@ -11,7 +11,7 @@ import json
 import random
 import unittest
 
-from kubeflow_tpu.api.types import Notebook, TPUSpec
+from kubeflow_tpu.api.types import Notebook, ReplicationSpec, TPUSpec
 from kubeflow_tpu.core import constants as C
 from kubeflow_tpu.core.metrics import NotebookMetrics
 from kubeflow_tpu.core.notebook_controller import setup_core_controllers
@@ -260,6 +260,79 @@ class TestGangGate(unittest.TestCase):
 
 
 # -- warm pool: claim, failover, reclamation, autoscaler -----------------------
+class TestReplicaAntiAffinity(unittest.TestCase):
+    """Replicated notebooks (spec.replication): replica gangs must land on
+    node pools disjoint from every other replica's, so one pool failure
+    can never take the primary and its standby together."""
+
+    def _rep_nb(self, anti_affine=True):
+        return Notebook.new(
+            "rep", "default", tpu=SPEC,
+            replication=ReplicationSpec(replicas=2,
+                                        anti_affine=anti_affine))
+
+    def _gang_pools(self, api):
+        nb = api.get("Notebook", "default", "rep")
+        placement = placement_of(nb.metadata.annotations)
+        return {gang: entry.get("pool") for gang, entry in placement.items()}
+
+    def test_replica_gangs_placed_on_disjoint_pools(self):
+        api, cluster, clock, mgr, _ = make_env()
+        for pool in ("pool-a", "pool-b"):
+            cluster.add_tpu_slice_nodes(
+                "tpu-v5-lite-podslice", "4x4", V5E_4X4.num_hosts, 4,
+                name_prefix=pool, pool=pool)
+        api.create(self._rep_nb().obj)
+        mgr.run_until_idle()
+        pools = self._gang_pools(api)
+        self.assertEqual(set(pools), {"0", "1"})
+        self.assertEqual(set(pools.values()), {"pool-a", "pool-b"})
+        # the bound pods agree with the intent, gang-atomically
+        nb = api.get("Notebook", "default", "rep")
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Healthy")
+        for sts, want in (("rep", pools["0"]), ("rep-r1", pools["1"])):
+            gang = {f"{sts}-{i}" for i in range(V5E_4X4.num_hosts)}
+            node_pools = {
+                api.get("Node", "", p.spec["nodeName"])
+                .metadata.labels.get(C.GKE_NODEPOOL_LABEL)
+                for p in api.list("Pod", namespace="default")
+                if p.name in gang and p.spec.get("nodeName")
+            }
+            self.assertEqual(node_pools, {want}, sts)
+
+    def test_standby_refuses_to_share_the_primary_pool(self):
+        """One pool with room for BOTH gangs: the standby must go cold
+        (provision a fresh pool) rather than co-locate with the primary —
+        capacity is not a reason to give up the failure domain."""
+        api, cluster, clock, mgr, _ = make_env(
+            cfg=scheduler_env(provision_s=60.0))
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    2 * V5E_4X4.num_hosts, 4)
+        api.create(self._rep_nb().obj)
+        mgr.run_until_idle()
+        nb = api.get("Notebook", "default", "rep")
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Scheduling")
+        self.assertFalse(placement_covers(Notebook(nb), 2))
+        # the cold reservation lands after the provision delay
+        mgr.advance(60.0)
+        mgr.run_until_idle()
+        pools = self._gang_pools(api)
+        self.assertEqual(set(pools), {"0", "1"})
+        self.assertNotEqual(pools["0"], pools["1"])
+        nb = api.get("Notebook", "default", "rep")
+        self.assertEqual(nb.body["status"]["sliceHealth"], "Healthy")
+
+    def test_anti_affinity_off_allows_shared_pool(self):
+        api, cluster, clock, mgr, _ = make_env()
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    2 * V5E_4X4.num_hosts, 4)
+        api.create(self._rep_nb(anti_affine=False).obj)
+        mgr.run_until_idle()
+        pools = self._gang_pools(api)
+        self.assertEqual(set(pools), {"0", "1"})
+        self.assertEqual(pools["0"], pools["1"])
+
+
 class TestWarmPool(unittest.TestCase):
     def _prewarmed(self, warm_size=2):
         cfg = scheduler_env(warm_size=warm_size, shapes="v5e:4x4")

@@ -13,11 +13,14 @@ import pytest
 from kubeflow_tpu.api.types import (
     CONDITION_RECOVERY_EXHAUSTED,
     Notebook,
+    ReplicationSpec,
     TPUSpec,
 )
+from kubeflow_tpu.core import constants as C
 from kubeflow_tpu.core.metrics import NotebookMetrics
 from kubeflow_tpu.core.notebook_controller import setup_core_controllers
 from kubeflow_tpu.core.selfheal import (
+    EVENT_PRIMARY_PROMOTED,
     MIGRATE_RESULT_FALLBACK,
     MIGRATE_RESULT_MIGRATED,
     MIGRATE_RESULT_RESTORED,
@@ -26,6 +29,9 @@ from kubeflow_tpu.core.selfheal import (
     MIGRATE_TRIGGER_FAILURE,
     MIGRATE_TRIGGER_NODE_DRAIN,
     PENDING,
+    PROMOTE_RESULT_LOST_RACE,
+    PROMOTE_RESULT_NO_CANDIDATE,
+    PROMOTE_RESULT_PROMOTED,
     REASON_CRASH_LOOP,
     REASON_MIGRATE,
     REASON_NODE_GONE,
@@ -33,7 +39,7 @@ from kubeflow_tpu.core.selfheal import (
     REASON_POD_FAILED,
     classify_worker,
 )
-from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+from kubeflow_tpu.core.sessionstate import InMemorySessionStore, StaleWriterError
 from kubeflow_tpu.kube import (
     ApiServer,
     FakeCluster,
@@ -594,6 +600,162 @@ class TestMigrateVerb:
         # terminal: no further churn of either verb
         mgr.advance(10000)
         assert pod_delete_groups(api, "heal") == cfg.recovery_max_attempts
+
+
+# -- the promote verb (replicated-kernel tier) ---------------------------------
+def make_replicated_env(cfg=None):
+    """make_migrate_env with two slice pools (2 gangs x 4 hosts) and a
+    replicated notebook: one primary gang plus one follower gang kept warm
+    from the checkpoint-delta stream."""
+    api, cluster, mgr, clock, metrics, store = make_migrate_env(
+        cfg, tpu_nodes=2 * HOSTS)
+    nb = Notebook.new("rep", "u1", tpu=TPUSpec("v5e", "4x4"),
+                      replication=ReplicationSpec(replicas=2))
+    api.create(nb.obj)
+    mgr.run_until_idle()
+    return api, cluster, mgr, clock, metrics, store
+
+
+def replication_record(api, ns="u1", name="rep"):
+    status = api.get("Notebook", ns, name).body.get("status") or {}
+    return status.get("replication") or {}
+
+
+def warm_follower(cluster, store, deltas=2, lag=0):
+    """Prime the delta chain and stamp the follower gang's catch-up
+    freshness onto its pods, `lag` deltas behind the head."""
+    cluster.set_session_payload("u1", "rep", b"kernel-A")
+    cluster.snapshot_sessions("u1", "rep")
+    for i in range(deltas):
+        cluster.stream_session_delta("u1", "rep", b"+cell%d" % i,
+                                     writer_epoch=1)
+    return cluster.sync_followers("u1", "rep", lag=lag)
+
+
+class TestPromoteVerb:
+    def test_primary_failure_promotes_caught_up_follower(self):
+        api, cluster, mgr, clock, metrics, store = make_replicated_env()
+        status = api.get("Notebook", "u1", "rep").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        rep = replication_record(api)
+        assert (rep["epoch"], rep["primary"]) == (1, 0)
+        # the service fronts the primary gang's worker 0
+        svc = api.get("Service", "u1", "rep")
+        assert svc.spec["selector"][C.STATEFULSET_LABEL] == "rep"
+
+        # every replica-labeled pod gets a freshness stamp (both gangs)
+        assert warm_follower(cluster, store) == 2 * HOSTS
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        head_gen, head_seq, head_digest = store.chain_head("u1", "rep", 0)
+        rep = replication_record(api)
+        follower = rep["followers"]["1"]
+        assert follower["ready"] is True
+        assert follower["slices"]["0"] == {
+            "generation": head_gen, "seq": head_seq, "digest": head_digest}
+
+        cluster.fail_pod("u1", "rep-0")
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        rep = replication_record(api)
+        assert (rep["epoch"], rep["primary"]) == (2, 1)
+        promo = rep["promotion"]
+        assert promo["phase"] == "promoted"
+        assert (promo["from"], promo["to"]) == (0, 1)
+        assert promo["reason"] == REASON_POD_FAILED
+        assert store.fence_epoch("u1", "rep") == 2
+        assert metrics.promotions.value("u1", PROMOTE_RESULT_PROMOTED) == 1
+        assert metrics.promotions.value("u1", PROMOTE_RESULT_LOST_RACE) == 0
+        assert metrics.promotion_duration_seconds.count_value("u1") == 1
+        assert EVENT_PRIMARY_PROMOTED in event_reasons(api)
+        # promotion replaced the primary restart: the follower gang was
+        # NEVER churned (its warm state is the whole point)
+        assert not [r for r in api.audit_log(verb="delete", kind="Pod")
+                    if r.name.startswith("rep-r1-")]
+        # the demoted zombie cannot ack a session write with its old epoch
+        with pytest.raises(StaleWriterError):
+            store.append_delta("u1", "rep", 0, b"+zombie", writer_epoch=1)
+        assert metrics.replication_fenced_writes.value("u1") == 1
+        # the demoted gang heals and rejoins as a follower; the next
+        # reconcile repoints the service selector — user traffic follows
+        # the flip with no pod restarts behind the service
+        for _ in range(4):
+            mgr.advance(30)
+        svc = api.get("Service", "u1", "rep")
+        assert svc.spec["selector"][C.STATEFULSET_LABEL] == "rep-r1"
+        status = api.get("Notebook", "u1", "rep").body["status"]
+        assert status["sliceHealth"] == "Healthy"
+        rep = replication_record(api)
+        assert (rep["epoch"], rep["primary"]) == (2, 1)
+        assert "0" in rep["followers"]
+        # the new primary's writes land at the new epoch
+        store.append_delta("u1", "rep", 0, b"+post", writer_epoch=2)
+
+    def test_lagging_follower_is_not_electable(self):
+        """Election needs positive catch-up evidence: a follower trailing
+        the chain head beyond REPLICATION_MAX_LAG is skipped and the
+        ordinary slice-atomic restart heals the primary in place."""
+        api, cluster, mgr, clock, metrics, store = make_replicated_env()
+        cfg_lag = CoreConfig().replication_max_lag
+        warm_follower(cluster, store, deltas=cfg_lag + 2, lag=cfg_lag + 1)
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        cluster.fail_pod("u1", "rep-0")
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        for _ in range(4):
+            mgr.advance(30)
+        rep = replication_record(api)
+        assert (rep["epoch"], rep["primary"]) == (1, 0)
+        assert "promotion" not in rep
+        assert metrics.promotions.value(
+            "u1", PROMOTE_RESULT_NO_CANDIDATE) >= 1
+        assert metrics.promotions.value("u1", PROMOTE_RESULT_PROMOTED) == 0
+        assert metrics.slice_restarts.value("u1", REASON_POD_FAILED) == 1
+        assert api.get("Notebook", "u1", "rep") \
+            .body["status"]["sliceHealth"] == "Healthy"
+        # the primary was never demoted: epoch-1 writes still pass
+        store.append_delta("u1", "rep", 0, b"+still-primary", writer_epoch=1)
+
+    def test_promotion_commits_through_control_plane_partition(self):
+        """Promotion under an apiserver brown-out: injected 503s on the
+        Notebook status commits delay the flip but can never split it —
+        the write-ahead record resumes the promotion, the epoch bumps
+        exactly once, and the zombie stays fenced throughout."""
+        api, cluster, mgr, clock, metrics, store = make_replicated_env()
+        warm_follower(cluster, store)
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        plan = FaultPlan([FaultRule(verbs=("update",), kinds=("Notebook",),
+                                    error="unavailable", max_matches=3,
+                                    name="status-brownout")], clock=clock)
+        api.install_fault_plan(plan)
+        with api.fault_exempt():
+            cluster.fail_pod("u1", "rep-0")
+        mgr.run_until_idle()
+        for _ in range(6):
+            mgr.advance(30)
+        api.clear_fault_plan()
+        assert plan.exhausted()
+        for _ in range(4):
+            mgr.advance(30)
+        rep = replication_record(api)
+        assert (rep["epoch"], rep["primary"]) == (2, 1)
+        assert rep["promotion"]["phase"] == "promoted"
+        assert store.fence_epoch("u1", "rep") == 2
+        # retried commits never double-bump: one promotion, one epoch
+        assert metrics.promotions.value("u1", PROMOTE_RESULT_PROMOTED) >= 1
+        with pytest.raises(StaleWriterError):
+            store.append_delta("u1", "rep", 0, b"+zombie", writer_epoch=1)
+        assert api.get("Notebook", "u1", "rep") \
+            .body["status"]["sliceHealth"] == "Healthy"
+
+    def test_promotion_metric_families_registered(self):
+        _, _, _, _, metrics = make_env()
+        fams = dict(metrics.families())
+        assert fams["notebook_promotions_total"] == "counter"
+        assert fams["notebook_promotion_duration_seconds"] == "histogram"
+        assert fams["notebook_replication_fenced_writes_total"] == "counter"
 
 
 class TestConfigParsing:

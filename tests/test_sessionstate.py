@@ -10,8 +10,11 @@ import pytest
 from kubeflow_tpu.api.types import Notebook, TPUSpec
 from kubeflow_tpu.core import constants as C
 from kubeflow_tpu.core.sessionstate import (
+    DeltaChainError,
     DirSessionStore,
+    FollowerReplica,
     InMemorySessionStore,
+    StaleWriterError,
     open_store,
     payload_digest,
 )
@@ -75,6 +78,121 @@ class TestStoreSemantics:
         store.set_final_snapshot_handler(
             lambda *a: (_ for _ in ()).throw(RuntimeError("pod gone")))
         assert store.request_final_snapshot("u1", "nb", 0) is None
+
+
+class TestDeltaChain:
+    """Checkpoint-delta stream invariants (the replicated-kernel tier's
+    substrate): strict chain ordering, digest-preserving compaction, and
+    follower catch-up from any base — for both store backends."""
+
+    def test_delta_requires_base_and_strict_order(self, store):
+        with pytest.raises(DeltaChainError, match="no base snapshot"):
+            store.append_delta("u1", "nb", 0, b"+orphan")
+        store.put("u1", "nb", 0, b"base")
+        store.append_delta("u1", "nb", 0, b"+d1", expected_seq=1)
+        # a duplicate replay and a future slot are both out of order
+        with pytest.raises(DeltaChainError, match="out-of-order"):
+            store.append_delta("u1", "nb", 0, b"+dup", expected_seq=1)
+        with pytest.raises(DeltaChainError, match="out-of-order"):
+            store.append_delta("u1", "nb", 0, b"+skip", expected_seq=3)
+        # rejected appends leave the chain untouched
+        assert [d.seq for d in store.deltas("u1", "nb", 0)] == [1]
+        assert store.materialize("u1", "nb", 0) == b"base+d1"
+
+    def test_chain_head_tracks_base_then_deltas(self, store):
+        assert store.chain_head("u1", "nb", 0) is None
+        base = store.put("u1", "nb", 0, b"base")
+        assert store.chain_head("u1", "nb", 0) == (1, 0, base.digest)
+        d2 = [store.append_delta("u1", "nb", 0, b"+d%d" % i)
+              for i in (1, 2)][-1]
+        assert store.chain_head("u1", "nb", 0) == (1, 2, d2.digest)
+        assert d2.digest == payload_digest(b"base+d1+d2")
+
+    def test_compaction_preserves_digest_and_resets_chain(self, store):
+        store.put("u1", "nb", 0, b"base")
+        for i in range(3):
+            store.append_delta("u1", "nb", 0, b"+d%d" % i)
+        head_digest = store.chain_head("u1", "nb", 0)[2]
+        folded = store.compact("u1", "nb", 0)
+        # the folded base IS the old chain head, bit for bit
+        assert folded.generation == 2
+        assert folded.digest == head_digest
+        assert store.payload("u1", "nb", 0) == b"base+d0+d1+d2"
+        assert store.chain_head("u1", "nb", 0) == (2, 0, head_digest)
+        assert store.deltas("u1", "nb", 0) == []
+        # the chain restarts at seq 1 on the new base
+        nxt = store.append_delta("u1", "nb", 0, b"+d3", expected_seq=1)
+        assert (nxt.base_generation, nxt.seq) == (2, 1)
+
+    def test_compact_without_chain_is_noop(self, store):
+        assert store.compact("u1", "nb", 0) is None  # no base at all
+        base = store.put("u1", "nb", 0, b"base")
+        assert store.compact("u1", "nb", 0) == base  # empty chain
+
+    def test_follower_catches_up_from_any_base(self, store):
+        store.put("u1", "nb", 0, b"base")
+        store.append_delta("u1", "nb", 0, b"+d1")
+        follower = FollowerReplica(store, "u1", "nb", 0)
+        assert follower.catch_up() == 2  # base reload + one delta
+        assert follower.caught_up() and follower.lag() == 0
+        # the primary moves on: another delta, then a compaction, then more
+        store.append_delta("u1", "nb", 0, b"+d2")
+        assert follower.lag() == 1 and not follower.caught_up()
+        store.compact("u1", "nb", 0)
+        store.append_delta("u1", "nb", 0, b"+d3")
+        assert follower.lag() == 2  # stale base counts the full new chain
+        assert follower.catch_up() == 2  # new-base reload + d3
+        assert follower.state == b"base+d1+d2+d3"
+        assert follower.digest == store.chain_head("u1", "nb", 0)[2]
+        # a cold follower joining late needs only the compacted base
+        late = FollowerReplica(store, "u1", "nb", 0)
+        late.catch_up()
+        assert late.state == follower.state
+        assert late.caught_up()
+
+    def test_follower_stops_at_chain_gap_and_verifies_digests(self, store):
+        store.put("u1", "nb", 0, b"base")
+        store.append_delta("u1", "nb", 0, b"+d1")
+        store.append_delta("u1", "nb", 0, b"+d2")
+        real = store.delta_payload
+        # a delta pruned from under a lagging cursor stops the replay at
+        # the last verified state instead of applying out of order
+        lagging = FollowerReplica(store, "u1", "nb", 0)
+        store.delta_payload = lambda *a, **k: None
+        try:
+            lagging.catch_up()
+        finally:
+            store.delta_payload = real
+        assert (lagging.state, lagging.seq) == (b"base", 0)
+        assert lagging.catch_up() == 2  # chain visible again: replay resumes
+        assert lagging.state == b"base+d1+d2"
+        # corrupted delta bytes never reach the follower's state
+        corrupt = FollowerReplica(store, "u1", "nb", 0)
+        store.delta_payload = lambda *a, **k: b"garbage"
+        try:
+            with pytest.raises(DeltaChainError, match="digest mismatch"):
+                corrupt.catch_up()
+        finally:
+            store.delta_payload = real
+        assert corrupt.state == b"base"  # stopped at the verified base
+
+    def test_write_fence_rejects_demoted_epoch(self, store):
+        store.put("u1", "nb", 0, b"base", writer_epoch=1)
+        store.append_delta("u1", "nb", 0, b"+d1", writer_epoch=1)
+        assert store.fence("u1", "nb", 2) == 2
+        assert store.fence("u1", "nb", 1) == 2  # monotonic max
+        for op in (
+            lambda: store.put("u1", "nb", 0, b"x", writer_epoch=1),
+            lambda: store.append_delta("u1", "nb", 0, b"+z", writer_epoch=1),
+            lambda: store.compact("u1", "nb", 0, writer_epoch=1),
+        ):
+            with pytest.raises(StaleWriterError):
+                op()
+        # unfenced (non-replicated) writers and the new epoch still pass
+        store.append_delta("u1", "nb", 0, b"+d2", writer_epoch=2)
+        store.append_delta("u1", "nb", 0, b"+d3")
+        assert store.materialize("u1", "nb", 0) == b"base+d1+d2+d3"
+        assert store.fenced_rejections[("u1", "nb")] == 3
 
 
 class TestDirStoreTornWrites:

@@ -182,12 +182,16 @@ class TestBurnRateMath:
         cfg = CoreConfig()
         names = {o.name for o in default_objectives(cfg)}
         assert names == {"time_to_ready", "event_to_reconcile",
-                         "reconcile_errors", "recovery_duration"}
+                         "reconcile_errors", "recovery_duration",
+                         "promotion_duration"}
         cfg = CoreConfig(enable_slice_scheduler=True)
         assert "warmpool_hit_rate" in \
             {o.name for o in default_objectives(cfg)}
         cfg = CoreConfig(slo_reconcile_error_rate=0.0)
         assert "reconcile_errors" not in \
+            {o.name for o in default_objectives(cfg)}
+        cfg = CoreConfig(slo_promotion_p99_s=0.0)
+        assert "promotion_duration" not in \
             {o.name for o in default_objectives(cfg)}
 
 
