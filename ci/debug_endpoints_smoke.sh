@@ -19,6 +19,9 @@
 #     with the demo notebook finalized and its conservation check clean,
 #   - /debug/timeline serves the in-process TSDB inventory, a per-series
 #     query, and the full ?dump=1 capture,
+#   - /debug/tenants serves the tenant metering ledger's usage table with
+#     the demo namespace attributed and its chip-second conservation
+#     check clean,
 #   - `python -m kubeflow_tpu.ops.diagnose` captures a bundle over the
 #     same surface from which the slowest attempt resolves offline.
 # Wired into ci/run_tests.sh (controlplane lane).
@@ -189,6 +192,32 @@ _, _, body = get("/debug/timeline?dump=1")
 dump = json.loads(body)
 assert dump["series"][name]["raw"], dump.get("bounds")
 
+# tenant metering: the demo namespace's control-plane work is attributed
+# to it, the fairness detector has evaluated (nothing flagged on a
+# healthy one-tenant demo), and chip-second conservation holds
+_, _, body = get("/debug/tenants")
+tn = json.loads(body)
+assert tn["enabled"] is True, tn
+assert "default" in tn["tenants"], sorted(tn["tenants"])
+assert tn["tenants"]["default"]["dispatches"] > 0, tn["tenants"]["default"]
+assert tn["conservation"]["violations"] == 0, tn["conservation"]
+assert tn["fairness"]["evaluations"] > 0, tn["fairness"]
+assert tn["fairness"]["flagged"] == [], tn["fairness"]
+assert set(tn["buckets"]) == {"ready", "scheduling", "recovering",
+                              "idle"}, tn["buckets"]
+
+# the tenant families surface on /metrics, and /debug/fleet embeds the
+# same snapshot under its "tenants" key
+_, _, body = get("/metrics")
+assert "# TYPE notebook_tenant_queue_seconds_total counter" in body, \
+    "tenant metering families missing from scrape"
+assert "# TYPE metrics_labelsets_dropped_total counter" in body, \
+    "cardinality-guard counter missing from scrape"
+_, _, body = get("/debug/fleet")
+fleet = json.loads(body)
+assert fleet["tenants"]["conservation"]["violations"] == 0, \
+    fleet.get("tenants")
+
 # continuous profiler: enabled for this boot, samples flowing, overhead
 # gauge under the 5% always-on budget
 _, _, body = get("/debug/profile")
@@ -201,7 +230,8 @@ assert status == 200 and ctype.startswith("text/plain")
 
 print("debug smoke: OK (/debug/reconciles, /debug/traces, "
       "/debug/workqueue, /debug/alerts, /debug/fleet, /debug/profile, "
-      "/debug/criticalpath, /debug/timeline, OpenMetrics negotiation)")
+      "/debug/criticalpath, /debug/tenants, /debug/timeline, "
+      "OpenMetrics negotiation)")
 EOF
 
 # one-shot diagnostics bundle over the same loopback surface: the CLI
@@ -234,6 +264,11 @@ tl = bundle["timeline"]
 assert tl["samples_total"] > 0 and tl["series"], tl.get("bounds")
 for name, tiers in tl["series"].items():
     assert set(tiers) == {"raw", "10s", "60s"}, (name, tiers.keys())
+# tenant metering rides the bundle: per-tenant usage + the fairness
+# verdict reconstruct offline
+tn = bundle["tenants"]
+assert tn["enabled"] is True and "default" in tn["tenants"], tn
+assert tn["conservation"]["violations"] == 0, tn["conservation"]
 print("diagnose smoke: OK (bundle resolves its slowest attempt offline, "
-      "worker telemetry + critical path + timeline included)")
+      "worker telemetry + critical path + tenants + timeline included)")
 EOF

@@ -209,6 +209,9 @@ _BARE_LOCK_EXEMPT = {
     "kubeflow_tpu/utils/tsdb.py":
         "time-series ring lock, append/query telemetry only — same "
         "rationale as tracing.py",
+    "kubeflow_tpu/utils/metering.py":
+        "tenant-metering ledger leaf lock (census fold + read-side "
+        "snapshots), telemetry only — same rationale as tracing.py",
 }
 
 _LOCK_CTORS = ("threading.Lock", "threading.RLock")

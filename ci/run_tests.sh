@@ -120,6 +120,15 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   # (ci/fleet_budget.json "sharded"), zero cross-process overlapping
   # reconciles, and a zero-data-plane-write steady state; the per-point
   # attribution records land in the --out artifact
+  # tenant fairness smoke: 4 namespaces of placed TPU notebooks, tenant 1
+  # floods spec churn — the metering ledger must attribute the flood to
+  # the exact namespace, fire exactly one deduped NoisyNeighbor Warning,
+  # clear it after the flood, keep chip-second conservation at zero
+  # violations, and hold the victim tenants' p99 event->reconcile under
+  # the ci/fleet_budget.json "tenants" ceiling
+  echo "== loadtest tenant fairness smoke =="
+  python loadtest/convergence.py --tenants 4 --per-tenant 3 --noisy 1 \
+    --check-budget ci/fleet_budget.json
   echo "== loadtest sharded fleet sweep (3 shards) =="
   python loadtest/convergence.py --sweep 200,600 --shards 3 \
     --check-budget ci/fleet_budget.json \

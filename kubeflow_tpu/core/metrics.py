@@ -7,10 +7,12 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..api.types import CONDITION_RECOVERY_EXHAUSTED
+from ..api.types import CONDITION_RECOVERY_EXHAUSTED, TPUSpec
 from ..kube import ApiServer, parse_quantity
 from ..utils.lifecycle import register_lifecycle_metrics
-from ..utils.metrics import Registry
+from ..utils.metering import (BUCKET_IDLE, BUCKET_READY, BUCKET_RECOVERING,
+                              BUCKET_SCHEDULING, register_metering_metrics)
+from ..utils.metrics import Registry, register_cardinality_metrics
 from ..utils.profiler import register_profiler_metrics
 from ..utils.slo import register_slo_metrics
 from . import constants as C
@@ -49,6 +51,48 @@ def fleet_state(nb) -> str:
         return "degraded"
     # CPU notebook (or no status yet)
     return "ready" if status.get("readyReplicas") else "pending"
+
+
+# (accelerator, topology, slices) -> total chips; topology resolution is
+# pure, so the cache never invalidates
+_CHIP_CACHE: dict[tuple[str, str, int], float] = {}
+
+
+def placement_chips(nb) -> float:
+    """Total TPU chips a placed notebook's gang occupies (0.0 for CPU
+    notebooks or an unresolvable shape — it still meters wall time)."""
+    tpu = nb.spec.get("tpu") or {}
+    if not tpu.get("accelerator"):
+        return 0.0
+    key = (str(tpu.get("accelerator", "")), str(tpu.get("topology", "")),
+           int(tpu.get("slices", 1) or 1))
+    chips = _CHIP_CACHE.get(key)
+    if chips is None:
+        try:
+            shape = TPUSpec.from_dict(tpu).validate()
+            chips = float(shape.chips * max(key[2], 1))
+        except Exception:  # noqa: BLE001 — an invalid spec must not
+            chips = 0.0    # break the metering census
+        _CHIP_CACHE[key] = chips
+    return chips
+
+
+def metering_bucket(nb) -> str:
+    """The chip-second bucket a placed notebook is currently accruing
+    into: stop-annotated (culled or user-stopped) counts as idle — chips
+    held past the cull decision; otherwise sliceHealth partitions placed
+    time into ready / scheduling / recovering."""
+    if C.STOP_ANNOTATION in (nb.metadata.annotations or {}):
+        return BUCKET_IDLE
+    health = (nb.body.get("status") or {}).get("sliceHealth")
+    if health == "Healthy":
+        return BUCKET_READY
+    if health in ("Unhealthy", "Degraded"):
+        return BUCKET_RECOVERING
+    if health in ("Stopping", "Stopped"):
+        return BUCKET_IDLE
+    # Scheduling, or placed before the first health write
+    return BUCKET_SCHEDULING
 
 
 def histogram_quantile(hist, q: float) -> float:
@@ -281,6 +325,15 @@ class NotebookMetrics:
         # WorkerTelemetryAggregator is attached; the aggregator
         # re-registers identically and feeds the same objects
         register_dataplane_metrics(self.registry)
+        # tenant metering families (utils/metering.py): registered here
+        # for inventory stability; an attached TenantMeteringLedger
+        # re-registers identically and feeds the same counters
+        register_metering_metrics(self.registry)
+        # cardinality-guard visibility (utils/metrics.py): ONE exported
+        # family fed at scrape time from every scraped registry's
+        # labelsets_dropped() — per-registry auto-registration would emit
+        # duplicate TYPE lines in the combined exposition
+        self.labelsets_dropped = register_cardinality_metrics(self.registry)
         # active-active sharding families (kube/shard.py): registered
         # unconditionally for inventory stability; fed from an attached
         # ShardedFleet's per-replica snapshots at every scrape
@@ -323,6 +376,11 @@ class NotebookMetrics:
         # grows the per-namespace stage-latency rollup and the TSDB feed
         # samples its stage p99s
         self.lifecycle = None
+        # TenantMeteringLedger attached via attach_metering(): every
+        # scrape() feeds it the placement census + apiserver tenant verb
+        # counts and runs the noisy-neighbor evaluation; fleet_snapshot
+        # grows a `tenants` section
+        self.metering = None
         # TimeSeriesStore attached via attach_tsdb(): every scrape()
         # appends one sample per selected series (the /debug/timeline and
         # diagnostics-bundle history)
@@ -365,6 +423,14 @@ class NotebookMetrics:
         grows the per-namespace stage-latency rollup and the TSDB feed
         samples the ledger's stage p99s each scrape."""
         self.lifecycle = ledger
+
+    def attach_metering(self, ledger) -> None:
+        """Attach a TenantMeteringLedger (utils/metering.py); every
+        scrape() accrues chip-seconds off the placement census, folds the
+        apiserver tenant verb counts, and evaluates the noisy-neighbor
+        detector (before the SLO engine, whose tenant_fairness objective
+        reads the verdict counter this feeds)."""
+        self.metering = ledger
 
     def attach_tsdb(self, store, clock=None) -> None:
         """Attach a TimeSeriesStore (utils/tsdb.py); every scrape()
@@ -454,6 +520,19 @@ class NotebookMetrics:
             out[cls._SEP.join(("shape", shape, state))] = 1.0
         return out
 
+    @classmethod
+    def _metering_census(cls, nb) -> dict:
+        """Per-Notebook contribution to the tenant metering census: placed
+        notebooks (placement annotation written by the scheduler) appear
+        under (namespace, name, bucket) with their chip count; release
+        removes the key, and the ledger closes the interval.  Incremental
+        via add_aggregate, so placement/release and sliceHealth
+        transitions maintain it on the watch stream."""
+        if C.ANNOTATION_PLACEMENT not in (nb.metadata.annotations or {}):
+            return {}
+        key = cls._SEP.join((nb.namespace, nb.name, metering_bucket(nb)))
+        return {key: placement_chips(nb)}
+
     def _ensure_census(self, cache) -> bool:
         if self._census_ready is not None:
             return self._census_ready
@@ -463,6 +542,8 @@ class NotebookMetrics:
                                 self._warmpool_census)
             cache.add_aggregate("Notebook", "fleet-census",
                                 self._fleet_census)
+            cache.add_aggregate("Notebook", "tenant-metering",
+                                self._metering_census)
             self._census_ready = True
         except Exception:  # noqa: BLE001 — a backend that cannot list a
             # kind (real cluster without the CRD) falls back to scans
@@ -510,6 +591,13 @@ class NotebookMetrics:
             # data-plane rollup first: the SLO engine's straggler/MFU
             # objectives read the verdict counters this evaluation feeds
             self.dataplane.evaluate()
+        if self.metering is not None:
+            # metering round before the SLO engine: the tenant_fairness
+            # objective reads the verdict counter this evaluation feeds
+            self._feed_metering()
+        # cardinality-guard visibility: fold per-family drop counts from
+        # every scraped registry into the one exported counter
+        self._feed_labelsets_dropped()
         if self.slo is not None:
             # burn rates / budget gauges / alert lifecycle advance at
             # scrape resolution, exactly like a Prometheus-side burn rule
@@ -518,6 +606,46 @@ class NotebookMetrics:
             # last, so the sample reads this scrape's fresh evaluations
             self._feed_tsdb()
         return self.render(openmetrics=openmetrics)
+
+    def _feed_metering(self) -> None:
+        """One metering round: decode the placement census (cache
+        aggregate, list-scan fallback), snapshot the apiserver's tenant
+        verb counts, and run the ledger's accrual + noisy-neighbor
+        evaluation."""
+        census: dict[tuple[str, str], tuple[str, float]] = {}
+        cache = getattr(self.manager, "cache", None)
+        if cache is not None and self._ensure_census(cache):
+            sums = cache.aggregate("Notebook", "tenant-metering").items()
+        else:
+            sums_d: dict[str, float] = {}
+            for nb in self.api.list("Notebook"):
+                for key, v in self._metering_census(nb).items():
+                    sums_d[key] = v
+            sums = sums_d.items()
+        for key, chips in sums:
+            parts = key.split(self._SEP)
+            census[(parts[0], parts[1])] = (parts[2], chips)
+        verbs = getattr(self.api, "tenant_verb_counts", None)
+        self.metering.evaluate(
+            census=census,
+            verb_counts=verbs() if verbs is not None else None)
+
+    def _feed_labelsets_dropped(self) -> None:
+        """Advance metrics_labelsets_dropped_total to the summed per-family
+        drop counts of every registry this exposition scrapes."""
+        regs = [self.registry]
+        mgr_registry = getattr(self.manager, "metrics_registry", None)
+        if mgr_registry is not None:
+            regs.append(mgr_registry)
+        merged: dict[str, float] = {}
+        for reg in regs:
+            dropped = getattr(reg, "labelsets_dropped", None)
+            if dropped is None:
+                continue
+            for family, n in dropped().items():
+                merged[family] = merged.get(family, 0.0) + n
+        for family, total in sorted(merged.items()):
+            self._feed_counter(self.labelsets_dropped, family, total)
 
     def _feed_tsdb(self) -> None:
         """One TSDB sample per scrape: the handful of series whose curves
@@ -554,6 +682,13 @@ class NotebookMetrics:
             cons = self.lifecycle.conservation()
             values["criticalpath_finalized"] = float(cons["finalized"])
             values["criticalpath_violations"] = float(cons["violations"])
+        if self.metering is not None:
+            # top-K tenant chip-second curves + the conservation gate's
+            # violation count over time (/debug/timeline)
+            for tenant, chips in self.metering.tenant_chip_series().items():
+                values["tenant_chip_seconds.%s" % tenant] = chips
+            mcons = self.metering.conservation()
+            values["metering_violations"] = float(mcons["violations"])
         self.tsdb.sample(clock.now(), values)
 
     def _scrape_shards(self) -> None:
@@ -629,6 +764,12 @@ class NotebookMetrics:
                 "ranking": self.lifecycle.ranking(),
                 "conservation": self.lifecycle.conservation(),
             }
+        if self.metering is not None:
+            # the tenant accounting view: per-tenant usage, top-K
+            # consumers, fairness verdicts, and the chip-second
+            # conservation gate — /debug/fleet alone reconstructs a
+            # noisy-neighbor incident
+            out["tenants"] = self.metering.snapshot()
         return out
 
     def _scrape_census_from_cache(self, cache) -> None:

@@ -363,6 +363,9 @@ class Manager:
         self.profiler = None
         self.lifecycle = None
         self.tsdb = None
+        # tenant metering ledger (utils/metering.py): receives per-tenant
+        # workqueue dispatch attribution and the completed-attempt stream
+        self.metering = None
         # replica identity for lifecycle attribution: a sharded fleet sets
         # this to the shard id so a manager change between consecutive
         # attempts of one notebook reads as handoff/adoption wait
@@ -426,6 +429,9 @@ class Manager:
         # per-key cause stamps: (clock time, monotonic wall time) of the
         # event that put the key in the queue
         self._cause_stamps: dict[tuple[str, Request], tuple[float, float]] = {}
+        # per-key tenant stamps (owning namespace at enqueue), feeding the
+        # metering ledger's per-tenant dispatch attribution at _pop
+        self._tenant_stamps: dict[tuple[str, Request], str] = {}
         # cause clock-time carried from _pop to the attempt's root span
         # (per-key serialization guarantees no concurrent writer per key)
         self._attempt_cause: dict[tuple[str, Request], float] = {}
@@ -514,7 +520,8 @@ class Manager:
             self._retries = {k: v for k, v in self._retries.items()
                              if k[0] != name}
             for d in (self._enqueued_at, self._trace_ids, self._attempt_seq,
-                      self._cause_stamps, self._attempt_cause):
+                      self._cause_stamps, self._attempt_cause,
+                      self._tenant_stamps):
                 for k in [k for k in d if k[0] == name]:
                     del d[k]
         for k in dropped:
@@ -580,6 +587,10 @@ class Manager:
                 # first cause wins while the key stays dirty: the reaction
                 # latency is measured from the event the fleet REACTED to
                 self._cause_stamps.setdefault(key, cause)
+            # tenant stamp rides next to the cause stamp: the owning
+            # namespace at enqueue time, attributing this key's queue wait
+            # and reaction latency to its tenant at dispatch (_pop)
+            self._tenant_stamps.setdefault(key, req.namespace)
 
     def enqueue(self, reg_name: str, req: Request) -> None:
         """Manual enqueue (tests, resync ticks)."""
@@ -636,27 +647,40 @@ class Manager:
             self._inflight_started[key] = self.clock.now()
             enqueued_at = self._enqueued_at.pop(key, None)
             cause = self._cause_stamps.pop(key, None)
+            tenant = self._tenant_stamps.pop(key, key[1].namespace)
             tid = self._trace_ids.get(key, "")
             if cause is not None:
                 # ride the cause clock-time to _process_item so the
                 # lifecycle ledger can anchor the notebook's event->ready
                 # window at the event the fleet reacted to
                 self._attempt_cause[key] = cause[0]
+        e2r_s = 0.0
         if cause is not None:
             # event -> reconcile-start: the injected-clock delta feeds the
             # deterministic histogram; the wall-clock delta feeds the exact
             # percentile samples the loadtest reports
-            self.event_to_reconcile.labels(key[0]).observe(
-                max(self.clock.now() - cause[0], 0.0))
+            e2r_s = max(self.clock.now() - cause[0], 0.0)
+            self.event_to_reconcile.labels(key[0]).observe(e2r_s)
             self._event_latency.append(
                 max(time.monotonic() - cause[1], 0.0))
+        queue_s = 0.0
         if enqueued_at is not None:
             # a retry's queue wait belongs to its live retry chain: exemplar
             # the observation with that trace so a fat queue-duration bucket
             # links straight to the backoff timeline that caused it
+            queue_s = max(self.clock.now() - enqueued_at, 0.0)
             self.queue_duration.labels(key[0]).observe(
-                max(self.clock.now() - enqueued_at, 0.0),
+                queue_s,
                 exemplar={"trace_id": tid} if tid else None)
+        if self.metering is not None and \
+                (cause is not None or enqueued_at is not None):
+            try:
+                # same clock-domain values the histograms above observed,
+                # attributed to the owning tenant
+                self.metering.observe_dispatch(tenant, queue_s, e2r_s)
+            except Exception:  # noqa: BLE001 — observability must never
+                # take the dispatch path down with it
+                logger.exception("metering rejected a dispatch")
         return key
 
     def _done(self, key: tuple[str, Request]) -> None:
@@ -850,6 +874,11 @@ class Manager:
                         # partition behind /debug/criticalpath
                         self.lifecycle.observe_attempt(
                             rec, root_span, self.manager_id)
+                    if rec is not None and self.metering is not None:
+                        # attempt stream -> metering ledger: latches the
+                        # per-tenant exemplar trace a fired fairness
+                        # alert resolves at /debug/traces
+                        self.metering.observe_attempt(rec)
                 except Exception:  # noqa: BLE001 — observability must
                     # never take the reconcile loop down with it
                     logger.exception("flight recorder rejected a span")

@@ -221,6 +221,10 @@ class ApiServer:
         # included (the audit log keeps write detail; these stay O(verbs x
         # kinds) so a load test can budget total API traffic cheaply)
         self._verb_counts: dict[tuple[str, str], int] = {}
+        # per-(verb, kind, namespace) counters — the tenant-attribution
+        # feed (utils/metering.py delta-reads these); cluster-scoped
+        # calls land under namespace ""
+        self._tenant_verb_counts: dict[tuple[str, str, str], int] = {}
         # apply fast path: (kind, ns, name) -> field_manager ->
         # (manifest digest, resulting rv); see apply()
         self._apply_lock = invariants.tracked(
@@ -277,6 +281,9 @@ class ApiServer:
             with self._audit_lock:
                 key = (verb, kind)
                 self._verb_counts[key] = self._verb_counts.get(key, 0) + 1
+                tkey = (verb, kind, namespace)
+                self._tenant_verb_counts[tkey] = \
+                    self._tenant_verb_counts.get(tkey, 0) + 1
         try:
             directives = None
             if depth == 0 and self._fault_plan is not None:
@@ -331,6 +338,18 @@ class ApiServer:
     def clear_verb_counts(self) -> None:
         with self._audit_lock:
             self._verb_counts.clear()
+
+    def tenant_verb_counts(self) -> dict[tuple[str, str, str], int]:
+        """Cumulative (verb, kind, namespace) -> count — verb_counts()
+        partitioned by the owning tenant (cluster-scoped calls under
+        namespace "").  The metering ledger delta-reads this snapshot to
+        attribute apiserver traffic per tenant."""
+        with self._audit_lock:
+            return dict(self._tenant_verb_counts)
+
+    def clear_tenant_verb_counts(self) -> None:
+        with self._audit_lock:
+            self._tenant_verb_counts.clear()
 
     # -- watch / admission registration --------------------------------------
     @property

@@ -98,6 +98,10 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
     - /debug/timeline — the in-process TSDB: ?series=<name>&tier=raw|10s|
       60s returns one downsampled series; ?dump=1 the full multi-tier
       capture (what ops/diagnose bundles); without either the inventory;
+    - /debug/tenants — the tenant metering ledger: per-tenant chip-second
+      buckets and control-plane attribution, top-K consumers, fairness
+      verdicts (noisy-neighbor flags), and the chip-second conservation
+      gate;
     - /state    — in-memory store dump (includes Secret data; additionally
       gated on --expose-state)."""
 
@@ -241,6 +245,14 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
                 "error": "no lifecycle ledger attached to this manager"}
             self._respond(200, json.dumps(body, default=str),
                           "application/json")
+        elif path == "/debug/tenants":
+            metering = getattr(mgr, "metering", None)
+            body = metering.snapshot() if metering is not None else {
+                "enabled": False,
+                "error": "no tenant metering ledger attached to this "
+                         "manager"}
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
         elif path == "/debug/timeline":
             store = getattr(mgr, "tsdb", None)
             if store is None:
@@ -360,6 +372,23 @@ def build_manager(
         max_series=core_cfg.tsdb_max_series)
     mgr.tsdb = tsdb
     metrics.attach_tsdb(tsdb, clock=mgr.clock)
+    # tenant metering ledger: chip-second accrual + control-plane
+    # attribution + noisy-neighbor detection, fed by the manager's
+    # dispatch/attempt hooks and each metrics scrape; serves at
+    # /debug/tenants and rides in /debug/fleet + the diagnose bundle
+    from .utils.metering import TenantMeteringLedger
+
+    metering = TenantMeteringLedger(
+        mgr.clock, registry=metrics.registry,
+        recorder=EventRecorder(api, "tenant-metering"),
+        max_tenants=core_cfg.metering_max_tenants,
+        max_notebooks=core_cfg.metering_max_notebooks,
+        tolerance=core_cfg.metering_tolerance,
+        fairshare_factor=core_cfg.tenant_fairshare_factor,
+        top_k=core_cfg.tenant_top_k,
+        slo_engine=engine)
+    mgr.metering = metering
+    metrics.attach_metering(metering)
     if core_cfg.enable_continuous_profiler:
         # always-on (controller, phase) CPU attribution; self-overhead is
         # exported so "can it stay on" is a gauge (/debug/profile)
@@ -438,6 +467,20 @@ def build_sharded_fleet(
     # clock=None falls back to the first replica manager's clock at feed
     # time (setup_core_controllers attaches it to `metrics`)
     metrics.attach_tsdb(tsdb, clock=clock)
+    # ONE metering ledger across every replica (same sharing rationale as
+    # the lifecycle ledger): tenant attribution survives shard handoffs
+    from .kube import EventRecorder
+    from .utils.metering import TenantMeteringLedger
+
+    metering = TenantMeteringLedger(
+        clock, registry=metrics.registry,
+        recorder=EventRecorder(api, "tenant-metering"),
+        max_tenants=core_cfg.metering_max_tenants,
+        max_notebooks=core_cfg.metering_max_notebooks,
+        tolerance=core_cfg.metering_tolerance,
+        fairshare_factor=core_cfg.tenant_fairshare_factor,
+        top_k=core_cfg.tenant_top_k)
+    metrics.attach_metering(metering)
 
     def controllers(replica):
         # replica.manager.api is the FencedApi: every controller write is
@@ -445,6 +488,11 @@ def build_sharded_fleet(
         replica.manager.lifecycle = ledger
         replica.manager.manager_id = replica.shard_id
         replica.manager.tsdb = tsdb
+        replica.manager.metering = metering
+        if metering.clock is None:
+            # clock=None build: the first replica's manager clock drives
+            # the accrual timestamps (same fallback as the TSDB feed)
+            metering.clock = replica.manager.clock
         setup_core_controllers(replica.manager, core_cfg, metrics,
                                provisioner=cluster)
         setup_culling(replica.manager, core_cfg, metrics=metrics)

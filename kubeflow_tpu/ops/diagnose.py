@@ -10,7 +10,8 @@ Two collection modes:
 
   - **HTTP** (the CLI default): walk the manager's loopback debug
     surface (`/metrics`, `/debug/{fleet,alerts,reconciles,workqueue,
-    profile,criticalpath,timeline}`), then resolve the span trees of
+    profile,criticalpath,tenants,timeline}`), then resolve the span trees
+    of
     every retained slowest/
     errored attempt via `/debug/traces/<id>` — so the bundle can
     reconstruct, offline, exactly the attempts an operator gets paged
@@ -55,7 +56,8 @@ CONFIG_PREFIXES = (
     "INVARIANTS_", "K8S_", "IDLENESS_", "CLUSTER_DOMAIN", "USE_ISTIO",
     "ISTIO_", "ADD_FSGROUP", "DEV", "SET_PIPELINE_", "GATEWAY_",
     "NOTEBOOK_GATEWAY_", "MLFLOW_", "INJECT_", "TPU_", "KUBE_",
-    "DATAPLANE_", "TELEMETRY_", "LIFECYCLE_", "TSDB_",
+    "DATAPLANE_", "TELEMETRY_", "LIFECYCLE_", "TSDB_", "METERING_",
+    "TENANT_", "METRICS_",
 )
 _SECRET_RE = re.compile(r"TOKEN|SECRET|PASSWORD|PASSWD|CREDENTIAL|APIKEY"
                         r"|API_KEY|PRIVATE|CERT", re.IGNORECASE)
@@ -96,6 +98,7 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
     profiler = getattr(manager, "profiler", None)
     aggregator = getattr(manager, "telemetry_aggregator", None)
     ledger = getattr(manager, "lifecycle", None)
+    metering = getattr(manager, "metering", None)
     tsdb = getattr(manager, "tsdb", None)
     reconciles = manager.flight_recorder.snapshot()
     traces = {}
@@ -122,6 +125,10 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
                       else None),
         "criticalpath": (ledger.snapshot() if ledger is not None
                          else None),
+        # per-tenant usage + the noisy-neighbor verdict: who used the
+        # chips/control plane and who was flagged, offline
+        "tenants": (metering.snapshot() if metering is not None
+                    else None),
         # full multi-tier dump, not just the inventory: the bundle is
         # what reconstructs a loadtest's p99-vs-time curve offline
         "timeline": tsdb.dump() if tsdb is not None else None,
@@ -180,6 +187,7 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
         "telemetry": (fleet.get("dataplane")
                       if isinstance(fleet, dict) else None),
         "criticalpath": get_json("/debug/criticalpath"),
+        "tenants": get_json("/debug/tenants"),
         "timeline": get_json("/debug/timeline?dump=1"),
         "config": redacted_config(),
     }

@@ -222,6 +222,23 @@ class CoreConfig:
     tsdb_tier10_capacity: int = 1024            # TSDB_TIER10_CAPACITY
     tsdb_tier60_capacity: int = 1024            # TSDB_TIER60_CAPACITY
     tsdb_max_series: int = 256                  # TSDB_MAX_SERIES
+    # tenant metering ledger (utils/metering.py): per-namespace
+    # chip-second accounting + control-plane attribution behind
+    # /debug/tenants.  metering_max_tenants bounds the tenant table
+    # (overflow folds into the reserved "other" tenant),
+    # metering_max_notebooks the live placement-meter LRU, and
+    # metering_tolerance the conservation gate.  A tenant whose rolling
+    # control-plane share exceeds tenant_fairshare_factor x fair share
+    # while another tenant's event->reconcile p99 is degraded is flagged
+    # noisy; tenant_top_k sizes the /debug/tenants + TSDB top-consumer
+    # views.  slo_tenant_fairness > 0 enables the tenant_fairness SLO
+    # objective at that allowed noisy-verdict ratio.
+    metering_max_tenants: int = 64              # METERING_MAX_TENANTS
+    metering_max_notebooks: int = 4096          # METERING_MAX_NOTEBOOKS
+    metering_tolerance: float = 0.05            # METERING_TOLERANCE
+    tenant_fairshare_factor: float = 3.0        # TENANT_FAIRSHARE_FACTOR
+    tenant_top_k: int = 8                       # TENANT_TOP_K
+    slo_tenant_fairness: float = 0.01           # SLO_TENANT_FAIRNESS
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "CoreConfig":
@@ -319,6 +336,15 @@ class CoreConfig:
             tsdb_tier60_capacity=max(1, _int(
                 env, "TSDB_TIER60_CAPACITY", 1024)),
             tsdb_max_series=max(1, _int(env, "TSDB_MAX_SERIES", 256)),
+            metering_max_tenants=max(1, _int(
+                env, "METERING_MAX_TENANTS", 64)),
+            metering_max_notebooks=max(1, _int(
+                env, "METERING_MAX_NOTEBOOKS", 4096)),
+            metering_tolerance=_float(env, "METERING_TOLERANCE", 0.05),
+            tenant_fairshare_factor=_float(
+                env, "TENANT_FAIRSHARE_FACTOR", 3.0),
+            tenant_top_k=max(1, _int(env, "TENANT_TOP_K", 8)),
+            slo_tenant_fairness=_float(env, "SLO_TENANT_FAIRNESS", 0.01),
         )
 
 

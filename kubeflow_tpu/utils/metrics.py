@@ -20,17 +20,42 @@ bucket AND a trace id an operator can open in /debug/traces.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Optional
 
+# Per-family label-set cap (cardinality guard): past this many distinct
+# label sets, new ones fold into a reserved "other" series instead of
+# growing the registry — a per-namespace family can never explode a
+# scrape.  Families opt out with max_label_sets=0; the env knob is read
+# once per Registry so tests can override it.
+DEFAULT_MAX_LABEL_SETS = 1024
+
+# The reserved label value every overflowing label set folds into.
+OVERFLOW_LABEL = "other"
+
 
 class _Metric:
-    def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...],
+                 max_label_sets: int = 0):
         self.name = name
         self.help = help_
         self.label_names = label_names
+        self.max_label_sets = max_label_sets
+        self.labelsets_dropped = 0
         self._values: dict[tuple[str, ...], float] = {}
         self._lock = threading.Lock()
+
+    def _admit(self, known, key: tuple[str, ...]) -> tuple[str, ...]:
+        """Resolve a label-set key against the cardinality cap: known keys
+        and keys under the cap pass through; the rest fold into the
+        reserved ``("other", ...)`` series and count a drop.  Called under
+        ``self._lock`` with the metric's key store."""
+        if not self.label_names or self.max_label_sets <= 0 \
+                or key in known or len(known) < self.max_label_sets:
+            return key
+        self.labelsets_dropped += 1
+        return (OVERFLOW_LABEL,) * len(self.label_names)
 
     def labels(self, *values: str) -> "_Child":
         if len(values) != len(self.label_names):
@@ -41,10 +66,11 @@ class _Metric:
 
     def _set(self, key: tuple[str, ...], v: float) -> None:
         with self._lock:
-            self._values[key] = v
+            self._values[self._admit(self._values, key)] = v
 
     def _add(self, key: tuple[str, ...], v: float) -> None:
         with self._lock:
+            key = self._admit(self._values, key)
             self._values[key] = self._values.get(key, 0.0) + v
 
     def _observe(self, key: tuple[str, ...], v: float,
@@ -133,8 +159,10 @@ class Histogram(_Metric):
     controller_runtime_reconcile_time_seconds."""
 
     def __init__(self, name: str, help_: str, label_names: tuple[str, ...],
-                 buckets: Optional[tuple[float, ...]] = None):
-        super().__init__(name, help_, label_names)
+                 buckets: Optional[tuple[float, ...]] = None,
+                 max_label_sets: int = 0):
+        super().__init__(name, help_, label_names,
+                         max_label_sets=max_label_sets)
         bounds = tuple(sorted(set(buckets if buckets is not None
                                   else DEFAULT_BUCKETS)))
         if not bounds:
@@ -158,6 +186,7 @@ class Histogram(_Metric):
     def _observe(self, key: tuple[str, ...], v: float,
                  exemplar: Optional[dict] = None) -> None:
         with self._lock:
+            key = self._admit(self._counts, key)
             counts = self._counts.setdefault(
                 key, [0] * (len(self.buckets) + 1))
             idx = len(self.buckets)
@@ -256,7 +285,16 @@ class Histogram(_Metric):
 
 
 class Registry:
-    def __init__(self) -> None:
+    def __init__(self, max_label_sets: Optional[int] = None) -> None:
+        # METRICS_MAX_LABEL_SETS: per-family cap inherited by every metric
+        # registered without an explicit max_label_sets (0 disables)
+        if max_label_sets is None:
+            try:
+                max_label_sets = int(os.environ.get(
+                    "METRICS_MAX_LABEL_SETS", DEFAULT_MAX_LABEL_SETS))
+            except ValueError:
+                max_label_sets = DEFAULT_MAX_LABEL_SETS
+        self.max_label_sets = max(0, max_label_sets)
         self._metrics: list[_Metric] = []
         self._by_name: dict[str, _Metric] = {}
         self._lock = threading.Lock()
@@ -283,26 +321,37 @@ class Registry:
             self._by_name[metric.name] = metric
             return metric
 
+    def _cap(self, max_label_sets: Optional[int]) -> int:
+        return (self.max_label_sets if max_label_sets is None
+                else max(0, max_label_sets))
+
     def counter(
-        self, name: str, help_: str = "", labels: tuple[str, ...] = ()
+        self, name: str, help_: str = "", labels: tuple[str, ...] = (),
+        max_label_sets: Optional[int] = None,
     ) -> Counter:
-        m = self._register(Counter(name, help_, tuple(labels)))
+        m = self._register(Counter(name, help_, tuple(labels),
+                                   max_label_sets=self._cap(max_label_sets)))
         assert isinstance(m, Counter)
         return m
 
     def gauge(
-        self, name: str, help_: str = "", labels: tuple[str, ...] = ()
+        self, name: str, help_: str = "", labels: tuple[str, ...] = (),
+        max_label_sets: Optional[int] = None,
     ) -> Gauge:
-        m = self._register(Gauge(name, help_, tuple(labels)))
+        m = self._register(Gauge(name, help_, tuple(labels),
+                                 max_label_sets=self._cap(max_label_sets)))
         assert isinstance(m, Gauge)
         return m
 
     def histogram(
         self, name: str, help_: str = "", labels: tuple[str, ...] = (),
         buckets: Optional[tuple[float, ...]] = None,
+        max_label_sets: Optional[int] = None,
     ) -> Histogram:
         m = self._register(Histogram(name, help_, tuple(labels),
-                                     buckets=buckets))
+                                     buckets=buckets,
+                                     max_label_sets=self._cap(
+                                         max_label_sets)))
         assert isinstance(m, Histogram)
         return m
 
@@ -315,6 +364,16 @@ class Registry:
         inventory ci/metrics_drift_check.sh diffs against its golden list."""
         with self._lock:
             return [(m.name, m.kind()) for m in self._metrics]
+
+    def labelsets_dropped(self) -> dict[str, int]:
+        """Family -> cumulative label sets folded into the reserved
+        'other' series.  A plain dict (not an auto-registered family) so
+        a combined scrape over several registries exports ONE
+        metrics_labelsets_dropped_total counter fed from all of them."""
+        with self._lock:
+            metrics = list(self._metrics)
+        return {m.name: m.labelsets_dropped for m in metrics
+                if m.labelsets_dropped > 0}
 
     def render(self, openmetrics: bool = False) -> str:
         """Text exposition.  Default: Prometheus text format 0.0.4.  With
@@ -335,3 +394,15 @@ class Registry:
             lines.append(f"# TYPE {family} {m.kind()}")
             lines.extend(m.sample_lines(openmetrics=openmetrics))
         return "\n".join(lines) + "\n"
+
+
+def register_cardinality_metrics(registry: Registry) -> Counter:
+    """The guard's visibility counter: label sets folded into 'other' by
+    the per-family cap, by family.  Registered by NotebookMetrics (and fed
+    there from every scraped registry's labelsets_dropped()); bounded by
+    the number of families, so it needs no cap of its own."""
+    return registry.counter(
+        "metrics_labelsets_dropped_total",
+        "Label sets folded into the reserved 'other' series by the "
+        "per-family cardinality cap (METRICS_MAX_LABEL_SETS)",
+        labels=("family",), max_label_sets=0)

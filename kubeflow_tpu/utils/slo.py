@@ -221,6 +221,19 @@ def default_objectives(cfg) -> tuple[Objective, ...]:
             label="result", bad_values=("straggler",),
             description="per-notebook straggler evaluations finding the "
                         "slice stepping together"))
+    # tenant-fairness objective (utils/metering.py verdict counter): each
+    # metering evaluation votes ok/noisy; a noisy-neighbor episode burns
+    # this budget and fires an alert whose exemplar is the latched trace
+    # of the flooding tenant (TenantMeteringLedger.evaluate latches it
+    # via latch_exemplar).
+    if getattr(cfg, "slo_tenant_fairness", 0.0) > 0:
+        out.append(Objective(
+            name="tenant_fairness", kind=KIND_RATIO,
+            metric="notebook_tenant_fairness_checks_total",
+            target_ratio=1.0 - cfg.slo_tenant_fairness,
+            label="result", bad_values=("noisy",),
+            description="metering rounds finding no tenant over its fair "
+                        "control-plane share while others degrade"))
     return tuple(out)
 
 
@@ -261,6 +274,10 @@ class SLOEngine:
         self._last_error_trace = ""
         self._slowest_trace = ""
         self._slowest_duration = -1.0
+        # objective-name -> trace id latched by an external detector
+        # (e.g. the tenant metering ledger when it flags a noisy
+        # neighbor); checked before the generic flavor latches
+        self._latched_exemplars: dict[str, str] = {}
         reg = self.registries[0] if self.registries else Registry()
         self.burn_gauge, self.remaining_gauge, self.firing_gauge = \
             register_slo_metrics(reg)
@@ -279,6 +296,18 @@ class SLOEngine:
                 if rec.duration_s >= self._slowest_duration:
                     self._slowest_duration = rec.duration_s
                     self._slowest_trace = rec.trace_id
+
+    def latch_exemplar(self, objective: str, trace) -> None:
+        """Pin the exemplar trace a firing alert of `objective` should
+        carry.  Detectors that know the concrete culprit (the metering
+        ledger's noisy tenant) feed this; `trace` is a trace id string or
+        a dict with a "trace_id" key."""
+        trace_id = (trace.get("trace_id", "") if isinstance(trace, dict)
+                    else str(trace or ""))
+        if not trace_id:
+            return
+        with self._lock:
+            self._latched_exemplars[objective] = trace_id
 
     # -- metric resolution ----------------------------------------------------
     def _metric(self, name: str):
@@ -337,6 +366,9 @@ class SLOEngine:
         return anchor
 
     def _exemplar_for(self, obj: Objective) -> str:
+        latched = self._latched_exemplars.get(obj.name, "")
+        if latched:
+            return latched
         if obj.kind == KIND_RATIO and obj.bad_values == ("error",):
             return self._last_error_trace
         if obj.kind == KIND_LATENCY:

@@ -52,6 +52,14 @@ slice utilization for both; `--check-warm-budget FILE` gates the
 comparison (warm p50 strictly below cold, minimum hit rate) for CI.
 Gang atomicity (never a partially placed slice; every slice co-located
 on one node pool) is asserted at every wave's convergence.
+
+Tenants mode (`--tenants N --noisy T`) runs N namespaces of placed TPU
+notebooks and floods spec churn from tenant T while the others tick over
+fairly: the metering ledger (utils/metering.py) must attribute the flood
+to the exact namespace, fire exactly one deduped NoisyNeighbor Warning,
+clear the flag after the flood stops, and keep chip-second conservation
+at zero violations; `--check-budget` gates the victim tenants' p99
+event->reconcile against the `tenants` section of the budget JSON.
 """
 
 from __future__ import annotations
@@ -75,6 +83,10 @@ from kubeflow_tpu.utils.clock import FakeClock  # noqa: E402
 from kubeflow_tpu.utils.config import CoreConfig  # noqa: E402
 from kubeflow_tpu.utils.flightrecorder import FlightRecorder  # noqa: E402
 from kubeflow_tpu.utils.lifecycle import LifecycleLedger  # noqa: E402
+from kubeflow_tpu.utils.metering import (  # noqa: E402
+    REASON_NOISY,
+    TenantMeteringLedger,
+)
 from kubeflow_tpu.utils.slo import (  # noqa: E402
     SLOEngine,
     default_objectives,
@@ -187,6 +199,14 @@ def _run_fleet(count: int, workers: int, tpu: str,
     tsdb = TimeSeriesStore()
     mgr.tsdb = tsdb
     metrics.attach_tsdb(tsdb, clock=clock)
+    # tenant metering ledger: one-tenant fleet here, but the dispatch
+    # attribution + conservation contract is gated at 10k scale exactly
+    # like the lifecycle ledger's (the --tenants mode covers multi-tenant)
+    metering = TenantMeteringLedger(clock, registry=metrics.registry,
+                                    max_notebooks=max(4096, count),
+                                    keep_conservation=max(4096, count))
+    mgr.metering = metering
+    metrics.attach_metering(metering)
 
     spec = None
     if tpu:
@@ -293,6 +313,22 @@ def _run_fleet(count: int, workers: int, tpu: str,
             f"{cons['violations']}/{cons['checked']} notebooks "
             f"(tolerance {cons['tolerance']:.0%}, first: {first})")
 
+    # tenant metering gate: the bucketed chip-second partition must
+    # conserve (zero violations), and the workqueue attribution must have
+    # actually landed on the owning namespace — a silent attribution miss
+    # would leave the tenant table empty while everything else passes
+    mcons = metering.conservation()
+    if mcons["violations"]:
+        raise AssertionError(
+            f"tenant metering broke conservation for "
+            f"{mcons['violations']}/{mcons['checked']} placement intervals "
+            f"(first: {metering.violations()[:3]})")
+    mtable = metering.tenant_table()
+    if mtable.get(NAMESPACE, {}).get("dispatches", 0) <= 0:
+        raise AssertionError(
+            "tenant metering attributed no workqueue dispatches to the "
+            f"{NAMESPACE!r} namespace")
+
     # event->reconcile-start reaction latency (wall clock; the FakeClock
     # collapses the deterministic histogram to ~0 in this harness): exact
     # percentiles over every event-caused reconcile of the run
@@ -331,6 +367,15 @@ def _run_fleet(count: int, workers: int, tpu: str,
         "criticalpath": {
             "ranking": ledger.ranking(),
             "conservation": cons,
+        },
+        # tenant metering verdict (utils/metering): the chip-second
+        # partition's conservation summary + attribution totals
+        "tenants": {
+            "conservation": mcons,
+            "attributed_dispatches":
+                mtable.get(NAMESPACE, {}).get("dispatches", 0),
+            "attributed_apiserver":
+                mtable.get(NAMESPACE, {}).get("apiserver_total", 0),
         },
         # TSDB inventory: the per-batch p99-vs-time history a diagnose
         # bundle captures in full (/debug/timeline?dump=1)
@@ -779,6 +824,266 @@ def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
     return result
 
 
+_TOUCH_ANNOTATION = "loadtest.kubeflow.org/touch"
+
+
+def run_tenants(tenants: int, per_tenant: int, noisy: int, tpu: str,
+                baseline_rounds: int = 18, flood_rounds: int = 6,
+                flood_factor: int = 50, victim_delay_s: float = 2.5,
+                recovery_rounds: int = 18,
+                provision_s: float = 60.0) -> dict:
+    """Adversarial multi-tenant run: `tenants` namespaces of `per_tenant`
+    placed TPU notebooks each, tenant index `noisy` floods the control
+    plane with spec churn while every other tenant's events queue behind
+    the backlog.  Asserts the metering ledger's verdict end to end: the
+    flood is attributed to the EXACT flooding namespace, exactly one
+    deduped Warning event fires naming it, the flag clears once the flood
+    stops, and chip-second conservation holds for every tenant
+    throughout."""
+    clock = FakeClock()
+    tracing.set_clock(clock)  # span times share the harness clock
+    try:
+        return _run_tenants(tenants, per_tenant, noisy, tpu,
+                            baseline_rounds, flood_rounds, flood_factor,
+                            victim_delay_s, recovery_rounds, provision_s,
+                            clock)
+    finally:
+        tracing.set_clock(None)
+
+
+def _run_tenants(tenants: int, per_tenant: int, noisy: int, tpu: str,
+                 baseline_rounds: int, flood_rounds: int, flood_factor: int,
+                 victim_delay_s: float, recovery_rounds: int,
+                 provision_s: float, clock: FakeClock) -> dict:
+    from kubeflow_tpu.kube import EventRecorder, retry_on_conflict
+
+    if tenants < 2:
+        raise ValueError("--tenants needs at least 2 namespaces "
+                         "(fair share is undefined for one tenant)")
+    accel, topology = tpu.split(":")
+    spec = TPUSpec(accel, topology)
+    shape = spec.validate()
+    total = tenants * per_tenant
+    env = {
+        "ENABLE_SLICE_SCHEDULER": "true",
+        "WARMPOOL_SIZE": str(total),
+        "WARMPOOL_SHAPES": f"{accel}:{topology}",
+        "WARMPOOL_PROVISION_S": f"{provision_s:g}",
+    }
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    mgr = Manager(api, clock=clock,
+                  flight_recorder=FlightRecorder(
+                      capacity=max(4096, total * 8),
+                      max_objects=max(2048, total * 4)))
+    cfg = CoreConfig.from_env(env)
+    metrics = NotebookMetrics(api, manager=mgr)
+    setup_core_controllers(mgr, cfg, metrics, provisioner=cluster)
+    slo_engine = SLOEngine(
+        default_objectives(cfg),
+        registries=[metrics.registry, mgr.metrics_registry],
+        clock=clock)
+    mgr.slo_engine = slo_engine
+    metrics.attach_slo(slo_engine)
+    mgr.settle(max_seconds=provision_s * 4 + 60)  # pre-warm the pool
+
+    namespaces = [f"tenant-{i}" for i in range(tenants)]
+    noisy_ns = namespaces[noisy % tenants]
+    victims = [ns for ns in namespaces if ns != noisy_ns]
+    names = [f"nb-{i:03d}" for i in range(per_tenant)]
+    expected_ready = shape.num_hosts * spec.slices
+
+    pending: dict[tuple[str, str], float] = {}
+    t0 = clock.now()
+    for ns in namespaces:
+        for name in names:
+            api.create(Notebook.new(name, ns, tpu=spec).obj)
+            pending[(ns, name)] = t0
+    deadline = clock.now() + provision_s * 4 + 600
+    while pending:
+        mgr.run_until_idle()
+        for ns, name in list(pending):
+            status = api.get("Notebook", ns, name).body.get("status") or {}
+            if status.get("readyReplicas") == expected_ready:
+                pending.pop((ns, name))
+        if not pending:
+            break
+        due = [d for (_, _, d) in mgr.pending_delayed()]
+        if not due or min(due) > deadline:
+            raise AssertionError(
+                f"{len(pending)} tenant notebooks unready past the "
+                f"deadline (first: {sorted(pending)[:3]})")
+        delta = min(due) - clock.now()
+        if delta > 0:
+            clock.advance(delta)
+
+    # attach metering only NOW: the detector's baselines must latch from
+    # post-convergence benign traffic, not the provisioning transient
+    # (whose requeue waits would inflate every tenant's "normal" p99)
+    metering = TenantMeteringLedger(
+        clock, registry=metrics.registry,
+        recorder=EventRecorder(api, "tenant-metering"),
+        max_tenants=max(tenants + 8, 16),
+        max_notebooks=max(4096, total),
+        keep_conservation=max(4096, total),
+        slo_engine=slo_engine)
+    mgr.metering = metering
+    metrics.attach_metering(metering)
+
+    def touch(ns: str) -> None:
+        """One spec-churn tick for every notebook of `ns` (annotation
+        bump -> update -> event -> reconcile: the smallest unit of
+        attributable control-plane work)."""
+        for name in names:
+            def bump() -> None:
+                live = api.get("Notebook", ns, name)
+                n = int(live.metadata.annotations.get(_TOUCH_ANNOTATION,
+                                                      "0"))
+                live.metadata.annotations[_TOUCH_ANNOTATION] = str(n + 1)
+                api.update(live)
+            retry_on_conflict(bump)
+
+    # benign phase: every tenant ticks over at the same rate — baselines
+    # latch low, the rolling control-plane windows fill with fair traffic
+    for _ in range(baseline_rounds):
+        for ns in namespaces:
+            touch(ns)
+        mgr.settle(max_seconds=60)
+        clock.advance(10.0)  # chip-seconds accrue between samples
+        metrics.scrape()     # sample + ingest + evaluate (fair verdict)
+    if metering.flagged():
+        raise AssertionError(
+            f"fair traffic flagged tenants {metering.flagged()} — the "
+            "detector fired with no noisy neighbor")
+
+    # flood phase: victims' events are stamped, then the clock advances by
+    # the backlog delay before the queue drains (their e2r degrades), and
+    # the noisy tenant churns specs flood_factor times per round
+    for _ in range(flood_rounds):
+        for ns in victims:
+            touch(ns)
+        clock.advance(victim_delay_s)
+        mgr.settle(max_seconds=60)
+        for _ in range(flood_factor):
+            touch(noisy_ns)
+            mgr.settle(max_seconds=60)
+        metrics.scrape()
+    flagged_flood = metering.flagged()
+    if flagged_flood != [noisy_ns]:
+        raise AssertionError(
+            f"flood attribution wrong: flagged {flagged_flood}, "
+            f"want exactly [{noisy_ns!r}]")
+    warnings = [e for e in api.list("Event")
+                if e.body.get("reason") == REASON_NOISY]
+    if len(warnings) != 1:
+        raise AssertionError(
+            f"{len(warnings)} {REASON_NOISY} Warning events exist, want "
+            "exactly one (EventRecorder dedup must aggregate re-fires)")
+    involved = (warnings[0].body.get("involvedObject") or {}).get("name")
+    if involved != noisy_ns:
+        raise AssertionError(
+            f"{REASON_NOISY} warning names {involved!r}, want {noisy_ns!r}")
+
+    # recovery phase: the flood stops; once its deltas roll out of the
+    # control-plane window the tenant's share drops and the flag clears
+    for _ in range(recovery_rounds):
+        for ns in namespaces:
+            touch(ns)
+        mgr.settle(max_seconds=60)
+        clock.advance(10.0)
+        metrics.scrape()
+    if metering.flagged():
+        raise AssertionError(
+            f"flag never cleared after the flood stopped: "
+            f"{metering.flagged()}")
+
+    table = metering.tenant_table()
+    cons = metering.conservation()
+    if cons["violations"]:
+        raise AssertionError(
+            f"tenant metering broke conservation for "
+            f"{cons['violations']}/{cons['checked']} placement intervals "
+            f"(first: {metering.violations()[:3]})")
+    if cons["checked"] < total:
+        raise AssertionError(
+            f"metering conservation checked only {cons['checked']}/{total} "
+            "placement intervals — some placed notebooks were never "
+            "metered")
+    if not table.get(noisy_ns, {}).get("last_trace"):
+        raise AssertionError(
+            f"no exemplar trace latched for {noisy_ns} — a fired fairness "
+            "alert would not resolve at /debug/traces")
+    _print_tenants(table, noisy_ns)
+    mgr.stop()
+    victim_p99s = {ns: table[ns]["e2r_p99_recent_s"] for ns in victims}
+    return {
+        "mode": "tenants",
+        "tenants": tenants,
+        "per_tenant": per_tenant,
+        "notebooks": total,
+        "tpu": tpu,
+        "noisy_tenant": noisy_ns,
+        "flagged_during_flood": flagged_flood,
+        "flagged_final": metering.flagged(),
+        "noisy_warning_events": len(warnings),
+        "noisy_fired_total": table[noisy_ns]["fired_total"],
+        "victim_p99_event_to_reconcile_s":
+            round(max(victim_p99s.values()), 6),
+        "per_tenant_p99_s": {
+            ns: round(table[ns]["e2r_p99_recent_s"], 6)
+            for ns in namespaces},
+        "chip_seconds": {
+            ns: round(table[ns]["chip_seconds_total"], 3)
+            for ns in namespaces},
+        "control_units": {
+            ns: table[ns]["apiserver_total"] + table[ns]["dispatches"]
+            for ns in namespaces},
+        "conservation": cons,
+        "slo": slo_engine.verdicts(),
+    }
+
+
+def _print_tenants(table: dict, noisy_ns: str) -> None:
+    """The per-tenant usage table (stderr; stdout carries the result
+    JSON): who used the chips and the control plane, and who got flagged."""
+    print("tenant usage:", file=sys.stderr)
+    print(f"  {'tenant':<12} {'chip_s':>10} {'dispatches':>10} "
+          f"{'api_reqs':>9} {'p99_e2r_s':>10} {'baseline_s':>10} "
+          f"{'flagged':>8}", file=sys.stderr)
+    for ns, row in sorted(table.items()):
+        mark = " <- noisy" if ns == noisy_ns else ""
+        baseline = row["e2r_p99_baseline_s"]
+        print(f"  {ns:<12} {row['chip_seconds_total']:>10.1f} "
+              f"{row['dispatches']:>10} {row['apiserver_total']:>9} "
+              f"{row['e2r_p99_recent_s']:>10.4f} "
+              f"{(baseline if baseline is not None else -1.0):>10.4f} "
+              f"{str(row['flagged']):>8}{mark}", file=sys.stderr)
+
+
+def check_tenant_budget(result: dict, budget: dict) -> list[str]:
+    """CI gate over the adversarial tenants run (ci/fleet_budget.json
+    "tenants" section): victim p99 ceiling under flood, exactly-one
+    deduped warning, zero conservation violations."""
+    failures = []
+    max_p99 = budget.get("max_victim_p99_event_to_reconcile_s")
+    if max_p99 is not None and \
+            result["victim_p99_event_to_reconcile_s"] > max_p99:
+        failures.append(
+            f"victim p99 event->reconcile "
+            f"{result['victim_p99_event_to_reconcile_s']}s > ceiling "
+            f"{max_p99}s")
+    if result["noisy_warning_events"] != 1:
+        failures.append(
+            f"{result['noisy_warning_events']} noisy-neighbor warnings, "
+            "want exactly 1")
+    max_viol = int(budget.get("max_conservation_violations", 0))
+    if result["conservation"]["violations"] > max_viol:
+        failures.append(
+            f"metering conservation violations "
+            f"{result['conservation']['violations']} > {max_viol}")
+    return failures
+
+
 def check_shard_budget(result: dict, budget: dict) -> list[str]:
     """CI gate over the sharded-fleet run (ci/fleet_budget.json
     "sharded" section): wall-clock + p99 ceilings like the flat fleet,
@@ -901,6 +1206,17 @@ def main(argv=None) -> int:
                         "N-replica active-active fleet with a kill+rejoin "
                         "cycle; --check-budget reads the 'sharded' section "
                         "of the budget JSON")
+    parser.add_argument("--tenants", type=int, default=0, metavar="N",
+                        help="adversarial multi-tenant mode: N namespaces "
+                        "of --per-tenant TPU notebooks, tenant --noisy "
+                        "floods spec churn; asserts metering attribution, "
+                        "exactly-one warning, and conservation; "
+                        "--check-budget reads the 'tenants' section")
+    parser.add_argument("--per-tenant", type=int, default=4,
+                        help="notebooks per tenant in --tenants mode")
+    parser.add_argument("--noisy", type=int, default=0, metavar="T",
+                        help="index of the flooding tenant in --tenants "
+                        "mode")
     parser.add_argument("--sweep", default="", metavar="N1,N2,...",
                         help="scale sweep: run the fleet (sharded when "
                         "--shards is set) at each point, print the "
@@ -912,6 +1228,24 @@ def main(argv=None) -> int:
 
     if args.sweep:
         return _run_sweep(args)
+
+    if args.tenants:
+        result = run_tenants(args.tenants, args.per_tenant, args.noisy,
+                             args.tpu or "v5e:2x2")
+        rc = 0
+        if args.check_budget:
+            budget = json.loads(Path(args.check_budget).read_text())
+            failures = check_tenant_budget(result,
+                                           budget.get("tenants", budget))
+            result["budget_ok"] = not failures
+            for f in failures:
+                print(f"TENANT BUDGET FAIL: {f}", file=sys.stderr)
+                rc = 1
+        print(json.dumps(result))
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=2,
+                                                 sort_keys=True) + "\n")
+        return rc
 
     if args.shards:
         result = run_sharded_fleet(args.count, args.shards)

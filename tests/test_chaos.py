@@ -239,8 +239,10 @@ class TestChaosSoak:
         return kind
 
     def test_seeded_random_fault_soak(self, env):
+        from kubeflow_tpu.core.metrics import metering_bucket, placement_chips
         from kubeflow_tpu.utils import tracing
         from kubeflow_tpu.utils.lifecycle import LifecycleLedger
+        from kubeflow_tpu.utils.metering import TenantMeteringLedger
 
         api, cluster, mgr = env
         # lifecycle conservation audit: every attempt the soak runs —
@@ -249,6 +251,12 @@ class TestChaosSoak:
         # (registry=None: no histogram, pure bookkeeping)
         ledger = LifecycleLedger()
         mgr.lifecycle = ledger
+        # tenant metering rides the same soak: every dispatch (retries
+        # included) attributes to user1, and the chip-second meter — fed
+        # each round from the live notebook's bucket — must conserve
+        # through every chaos excursion
+        metering = TenantMeteringLedger(mgr.clock)
+        mgr.metering = metering
         tracing.set_clock(mgr.clock)
         try:
             nb = Notebook.new(
@@ -288,6 +296,10 @@ class TestChaosSoak:
                     f"{mgr.dropped_errors}, injected={plan.summary()}")
                 assert_steady_state(api, "user1", "soak",
                                     self.EXPECTED_HOSTS)
+                live = api.get("Notebook", "user1", "soak")
+                metering.sample({("user1", "soak"):
+                                 (metering_bucket(live),
+                                  placement_chips(live))})
 
             # the soak must actually have injected chaos to mean anything
             assert total_faults > SOAK_ROUNDS, total_faults
@@ -300,6 +312,14 @@ class TestChaosSoak:
             cons = ledger.conservation()
             assert cons["finalized"] >= 1, cons
             assert cons["violations"] == 0, ledger.violations()[:3]
+            # metering conservation under chaos: the accrued buckets of
+            # the soak notebook's (still-live) meter sum to its measured
+            # wall time, and every dispatch was attributed to its tenant
+            mcons = metering.conservation()
+            assert mcons["checked"] >= 1, mcons
+            assert mcons["violations"] == 0, metering.violations()[:3]
+            row = metering.tenant_table()["user1"]
+            assert row["dispatches"] > 0 and row["chip_seconds_total"] > 0
         finally:
             tracing.set_clock(None)
 
@@ -457,6 +477,7 @@ class TestSliceRecoverySoak:
     def test_recovery_soak_with_failover(self):
         from kubeflow_tpu.utils import tracing
         from kubeflow_tpu.utils.lifecycle import LifecycleLedger
+        from kubeflow_tpu.utils.metering import TenantMeteringLedger
 
         api, cluster, mgr, clock, cfg, metrics = self._env()
         # ONE ledger across the failover (like a sharded fleet's shared
@@ -465,13 +486,21 @@ class TestSliceRecoverySoak:
         # handover plus every recovery excursion the soak provokes
         ledger = LifecycleLedger()
         mgr.lifecycle = ledger
+        # same deal for tenant metering: one ledger outlives the deposed
+        # manager, so user1's usage attribution spans the handover
+        metering = TenantMeteringLedger(clock)
+        mgr.metering = metering
         tracing.set_clock(clock)
         try:
-            self._recovery_soak_body(api, cluster, mgr, clock, ledger)
+            self._recovery_soak_body(api, cluster, mgr, clock, ledger,
+                                     metering)
         finally:
             tracing.set_clock(None)
 
-    def _recovery_soak_body(self, api, cluster, mgr, clock, ledger):
+    def _recovery_soak_body(self, api, cluster, mgr, clock, ledger,
+                            metering):
+        from kubeflow_tpu.core.metrics import metering_bucket, placement_chips
+
         nb = Notebook.new("healsoak", "user1", tpu=TPUSpec("v5e", "4x4"))
         api.create(nb.obj)
         mgr.run_until_idle()
@@ -493,6 +522,7 @@ class TestSliceRecoverySoak:
                 setup_core_controllers(mgr, CoreConfig(**self.CFG),
                                        NotebookMetrics(api))
                 mgr.lifecycle = ledger
+                mgr.metering = metering
                 with api.fault_exempt():
                     mgr.enqueue_all()
 
@@ -534,6 +564,10 @@ class TestSliceRecoverySoak:
             assert self._exhausted_cond(api, "user1", "healsoak") is None, \
                 (round_i, kind, status.get("sliceRecovery"))
             self._assert_slice_atomic(api, "healsoak")
+            live = api.get("Notebook", "user1", "healsoak")
+            metering.sample({("user1", "healsoak"):
+                             (metering_bucket(live),
+                              placement_chips(live))})
             # age the sliding window out between rounds so each round
             # gets a fresh budget (the exhaustion path is tested below)
             mgr.advance(self.CFG["recovery_window_s"])
@@ -548,6 +582,14 @@ class TestSliceRecoverySoak:
         cons = ledger.conservation()
         assert cons["finalized"] >= 1, cons
         assert cons["violations"] == 0, ledger.violations()[:3]
+        # metering conservation across the failover: the (single) meter
+        # accrued under both managers and its bucketed sum still equals
+        # the measured wall time; attribution kept flowing after handover
+        mcons = metering.conservation()
+        assert mcons["checked"] >= 1, mcons
+        assert mcons["violations"] == 0, metering.violations()[:3]
+        row = metering.tenant_table()["user1"]
+        assert row["dispatches"] > 0 and row["chip_seconds_total"] > 0
 
     def test_permanent_failure_exhausts_exactly_at_cap(self):
         api, cluster, mgr, clock, cfg, metrics = self._env()
@@ -1675,3 +1717,151 @@ class TestShardKillRejoinSoak:
         merged = merge_records(bundles)
         assert merged, "bundles carried no attempts"
         assert merge_overlaps(bundles) == []
+
+
+class TestNoisyNeighborSoak:
+    """ISSUE-17 acceptance: a multi-tenant soak where one tenant floods
+    the control plane WHILE bounded API faults fire.  The metering
+    ledger must attribute the flood to exactly that tenant (exactly one
+    deduped Warning event naming it), keep the victims' event->reconcile
+    p99 measurement honest (it shows the degradation, bounded by the CI
+    budget ceiling), conserve chip-seconds through the chaos, clear the
+    flag once traffic rebalances — and the whole verdict must
+    reconstruct offline from an ops.diagnose bundle."""
+
+    TENANTS = 4
+    PER_TENANT = 2
+    NOISY = 1  # tenant index that floods
+
+    def test_noisy_neighbor_soak_attribution_and_clear(self):
+        import json as _json
+
+        from kubeflow_tpu.core import constants as CC
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.kube import EventRecorder
+        from kubeflow_tpu.kube import retry_on_conflict
+        from kubeflow_tpu.ops.diagnose import collect_local
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.metering import (REASON_NOISY,
+                                                 TenantMeteringLedger)
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api, manager=mgr)
+        setup_core_controllers(mgr, CoreConfig(), metrics)
+        tracing.set_clock(clock)
+        try:
+            namespaces = [f"tenant-{i}" for i in range(self.TENANTS)]
+            noisy_ns = namespaces[self.NOISY]
+            for ns in namespaces:
+                for i in range(self.PER_TENANT):
+                    # placement-annotated from birth: the census meters
+                    # every tenant's wall time for the whole soak
+                    api.create(Notebook.new(
+                        f"nb-{i}", ns,
+                        annotations={CC.ANNOTATION_PLACEMENT:
+                                     _json.dumps({"pool": "p0"})}).obj)
+            mgr.run_until_idle()
+
+            # attach metering only after convergence so the fairness
+            # baselines latch from benign traffic (production managers
+            # boot into an already-converged fleet all the time)
+            metering = TenantMeteringLedger(
+                clock, registry=metrics.registry,
+                recorder=EventRecorder(api, "tenant-metering"))
+            mgr.metering = metering
+            metrics.attach_metering(metering)
+
+            touch_seq = [0]
+
+            def touch(ns):
+                for i in range(self.PER_TENANT):
+                    # strictly increasing stamp: an unchanged annotation
+                    # would be a no-op update and produce no watch event
+                    touch_seq[0] += 1
+
+                    def _bump(i=i, stamp=touch_seq[0]):
+                        nb = api.get("Notebook", ns, f"nb-{i}")
+                        nb.metadata.annotations["chaos/touch"] = str(stamp)
+                        api.update(nb)
+
+                    retry_on_conflict(_bump)
+
+            # benign phase: balanced traffic latches every tenant's
+            # baseline p99 (FakeClock + immediate settles => ~0s e2r)
+            for _ in range(20):
+                for ns in namespaces:
+                    touch(ns)
+                mgr.settle(max_seconds=60.0)
+                clock.advance(10.0)
+                metrics.scrape()
+            assert metering.flagged() == [], metering.tenant_table()
+
+            # flood phase UNDER FAULTS: the noisy tenant hammers the
+            # control plane while every round's bounded fault plan
+            # injects API errors/latency — attribution must stay exact
+            rng = random.Random(SOAK_SEED + 17)
+            for _ in range(6):
+                plan = random_fault_plan(rng.randrange(2**31),
+                                         kinds=FAULT_KINDS, clock=clock)
+                api.install_fault_plan(plan)
+                with api.fault_exempt():
+                    for ns in namespaces:
+                        if ns != noisy_ns:
+                            touch(ns)
+                clock.advance(2.5)   # victims wait behind the flood
+                mgr.settle(max_seconds=600.0)
+                with api.fault_exempt():
+                    for _ in range(80):
+                        touch(noisy_ns)
+                        mgr.settle(max_seconds=600.0)
+                api.clear_fault_plan()
+                mgr.settle(max_seconds=600.0)
+                metrics.scrape()
+            assert metering.flagged() == [noisy_ns], \
+                metering.tenant_table()
+
+            # exactly one deduped Warning names exactly the noisy tenant
+            warnings = [e for e in api.list("Event")
+                        if e.body.get("reason") == REASON_NOISY]
+            assert len(warnings) == 1, [e.body for e in warnings]
+            assert warnings[0].body["involvedObject"]["name"] == noisy_ns
+            assert metering.tenant_table()[noisy_ns]["fired_total"] == 1
+
+            # the victims' measured degradation stays under the same
+            # ceiling ci/fleet_budget.json gates the loadtest with
+            for ns in namespaces:
+                if ns == noisy_ns:
+                    continue
+                row = metering.tenant_table()[ns]
+                assert 0.0 < row["e2r_p99_recent_s"] <= 4.0, (ns, row)
+
+            # recovery: balanced traffic rolls the flood out of the
+            # window and the flag clears without operator action
+            for _ in range(metering.window_evals + 4):
+                for ns in namespaces:
+                    touch(ns)
+                mgr.settle(max_seconds=60.0)
+                clock.advance(10.0)
+                metrics.scrape()
+            assert metering.flagged() == [], metering.tenant_table()
+
+            # chip-second conservation held through faults + flood for
+            # every metered notebook, and the verdict reconstructs
+            # offline from a diagnose bundle
+            cons = metering.conservation()
+            assert cons["checked"] >= self.TENANTS * self.PER_TENANT
+            assert cons["violations"] == 0, metering.violations()[:3]
+            bundle = collect_local(mgr, metrics, env={})
+            tn = bundle["tenants"]
+            assert tn["tenants"][noisy_ns]["fired_total"] == 1, tn
+            assert tn["fairness"]["flagged"] == [], tn["fairness"]
+            assert tn["conservation"]["violations"] == 0
+            assert _json.dumps(tn)  # the bundle section serializes
+        finally:
+            api.clear_fault_plan()
+            tracing.set_clock(None)

@@ -1,0 +1,671 @@
+"""Tenant metering ledger: chip-second accrual + conservation,
+control-plane attribution, and the noisy-neighbor detector.
+
+Three layers, mirroring how the ledger is fed in production:
+
+* direct unit tests drive ``TenantMeteringLedger`` with a ``FakeClock``
+  and hand-built census dicts — interval accrual across bucket
+  transitions, finalization on release/eviction, the conservation
+  contract (including that a tampered meter IS flagged — the check must
+  be falsifiable), and apiserver delta semantics;
+* detector tests latch baselines from benign dispatch streams, then
+  flood one tenant while degrading another's event->reconcile p99 and
+  assert exactly-once firing, Warning-event dedup through a real
+  EventRecorder, SLO exemplar latching, and flag clearance;
+* integration tests run the real census pipeline — placement-annotated
+  Notebooks, the InformerCache ``tenant-metering`` aggregate, and
+  ``NotebookMetrics.scrape()`` — and check the incremental cache census
+  stays equal to a brute-force api.list scan under seeded churn.
+"""
+
+import json
+import random
+
+import pytest
+
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.metering import (BUCKET_IDLE, BUCKET_READY,
+                                         BUCKET_RECOVERING,
+                                         BUCKET_SCHEDULING, BUCKETS,
+                                         OTHER_TENANT, REASON_NOISY,
+                                         TenantMeteringLedger,
+                                         register_metering_metrics)
+from kubeflow_tpu.utils.metrics import Registry
+
+
+def _ledger(clock=None, **kw):
+    return TenantMeteringLedger(clock or FakeClock(), **kw)
+
+
+class TestChipSecondAccrual:
+    """sample() accrues wall time into the bucket observed at the
+    PREVIOUS sample, per placement interval, conserving exactly."""
+
+    def test_buckets_partition_measured_wall_time(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        key = ("team-a", "nb-0")
+
+        led.sample({key: (BUCKET_SCHEDULING, 8.0)})      # t=0: meter opens
+        clock.advance(5)
+        led.sample({key: (BUCKET_READY, 8.0)})           # 5s of scheduling
+        clock.advance(10)
+        led.sample({key: (BUCKET_READY, 8.0)})           # 10s of ready
+        clock.advance(3)
+        led.sample({key: (BUCKET_RECOVERING, 8.0)})      # 3s more of ready
+        clock.advance(2)
+        led.sample({key: (BUCKET_RECOVERING, 8.0)})      # 2s of recovering
+        led.sample({})                                   # released: finalize
+
+        assert led.finalized_total == 1
+        cons = led.conservation()
+        assert cons["violations"] == 0 and cons["checked"] == 1
+        assert cons["max_rel_err"] < 1e-9
+        rec = led.violations() or None
+        assert rec is None
+        row = led.tenant_table()["team-a"]
+        assert row["chip_seconds"] == pytest.approx({
+            BUCKET_SCHEDULING: 8.0 * 5,
+            BUCKET_READY: 8.0 * 13,
+            BUCKET_RECOVERING: 8.0 * 2,
+        })
+        assert row["chip_seconds_total"] == pytest.approx(8.0 * 20)
+        assert row["notebooks_metered"] == 1
+
+    def test_idle_bucket_accrues_for_stopped_chips(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        key = ("team-a", "nb-idle")
+        led.sample({key: (BUCKET_READY, 4.0)})
+        clock.advance(10)
+        led.sample({key: (BUCKET_IDLE, 4.0)})            # 10s ready
+        clock.advance(30)
+        led.sample({key: (BUCKET_IDLE, 4.0)})            # 30s idle
+        led.sample({})                                   # release
+        row = led.tenant_table()["team-a"]
+        assert row["chip_seconds"][BUCKET_IDLE] == pytest.approx(120.0)
+        assert led.conservation()["violations"] == 0
+
+    def test_replacement_opens_a_fresh_interval(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        key = ("team-a", "nb-0")
+        led.sample({key: (BUCKET_READY, 4.0)})
+        clock.advance(7)
+        led.sample({key: (BUCKET_READY, 4.0)})
+        led.sample({})                                   # interval 1 closes
+        clock.advance(100)                               # gap: not metered
+        led.sample({key: (BUCKET_SCHEDULING, 4.0)})      # interval 2 opens
+        clock.advance(3)
+        led.sample({key: (BUCKET_SCHEDULING, 4.0)})      # 3s scheduling
+        led.sample({})
+        assert led.finalized_total == 2
+        recs = led.conservation()
+        assert recs["checked"] == 2 and recs["violations"] == 0
+        # the 100s gap between intervals must NOT have been accrued
+        row = led.tenant_table()["team-a"]
+        assert row["chip_seconds_total"] == pytest.approx(4.0 * 10)
+        assert row["notebooks_metered"] == 2
+
+    def test_zero_chip_notebook_still_meters_wall_time(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        key = ("team-a", "cpu-nb")
+        led.sample({key: (BUCKET_READY, 0.0)})
+        clock.advance(42)
+        led.sample({key: (BUCKET_READY, 0.0)})
+        led.sample({})
+        cons = led.conservation()
+        assert cons["checked"] == 1 and cons["violations"] == 0
+        [rec] = [r for r in led._conservation]
+        assert rec["wall_s"] == pytest.approx(42.0)
+        assert led.tenant_table()["team-a"]["chip_seconds_total"] == 0.0
+
+    def test_conservation_flags_a_tampered_meter(self):
+        """Falsifiability: a double-counted bucket breaks the equality
+        and surfaces as a violation — the check is not vacuous."""
+        clock = FakeClock()
+        led = _ledger(clock, tolerance=0.05)
+        key = ("team-a", "nb-0")
+        led.sample({key: (BUCKET_READY, 4.0)})
+        clock.advance(10)
+        led.sample({key: (BUCKET_READY, 4.0)})
+        # white-box: inject a double-count into the live meter
+        led._meters[key].buckets[BUCKET_READY] += 5.0
+        assert led.conservation()["violations"] == 1   # live meter checked
+        [v] = led.violations()
+        assert v["live"] is True and v["rel_err"] > 0.05
+        led.sample({})                                  # finalize it
+        assert led.conservation()["violations"] == 1
+        [v] = led.violations()
+        assert "live" not in v and v["namespace"] == "team-a"
+
+    def test_lru_eviction_finalizes_oldest_meter(self):
+        clock = FakeClock()
+        led = _ledger(clock, max_notebooks=2)
+        a, b, c = [("ns", f"nb-{i}") for i in range(3)]
+        led.sample({a: (BUCKET_READY, 1.0)})
+        clock.advance(1)
+        led.sample({a: (BUCKET_READY, 1.0), b: (BUCKET_READY, 1.0)})
+        clock.advance(1)
+        led.sample({a: (BUCKET_READY, 1.0), b: (BUCKET_READY, 1.0),
+                    c: (BUCKET_READY, 1.0)})
+        # cap is 2: the least-recently-sampled meter was evicted+finalized
+        assert led.finalized_total == 1
+        assert len(led._meters) == 2
+        assert led.conservation()["violations"] == 0
+
+    def test_chip_seconds_counter_exported_per_bucket(self):
+        reg = Registry()
+        fams = register_metering_metrics(reg)
+        clock = FakeClock()
+        led = _ledger(clock, registry=reg)
+        key = ("team-a", "nb-0")
+        led.sample({key: (BUCKET_READY, 2.0)})
+        clock.advance(10)
+        led.sample({key: (BUCKET_READY, 2.0)})
+        assert fams["chip_seconds"].value("team-a", BUCKET_READY) \
+            == pytest.approx(20.0)
+        text = reg.render()
+        assert "# TYPE notebook_tenant_chip_seconds_total counter" in text
+
+
+class TestControlPlaneAttribution:
+    def test_dispatch_observations_accumulate_per_tenant(self):
+        reg = Registry()
+        fams = register_metering_metrics(reg)
+        led = _ledger(registry=reg)
+        led.observe_dispatch("team-a", queue_s=0.5, e2r_s=1.5)
+        led.observe_dispatch("team-a", queue_s=0.25, e2r_s=0.75)
+        led.observe_dispatch("team-b", queue_s=0.1, e2r_s=0.1)
+        tbl = led.tenant_table()
+        assert tbl["team-a"]["dispatches"] == 2
+        assert tbl["team-a"]["queue_s"] == pytest.approx(0.75)
+        assert tbl["team-a"]["event_to_reconcile_s"] == pytest.approx(2.25)
+        assert fams["queue"].value("team-a", "queue_wait") \
+            == pytest.approx(0.75)
+        assert fams["queue"].value("team-b", "event_to_reconcile") \
+            == pytest.approx(0.1)
+
+    def test_apiserver_snapshot_deltas_are_idempotent(self):
+        led = _ledger()
+        snap = {("update", "Notebook", "team-a"): 5,
+                ("get", "Notebook", "team-a"): 2}
+        led.ingest_apiserver(snap)
+        led.ingest_apiserver(snap)      # same snapshot: no double count
+        row = led.tenant_table()["team-a"]
+        assert row["apiserver"] == {"get": 2, "update": 5}
+        led.ingest_apiserver({("update", "Notebook", "team-a"): 8,
+                              ("get", "Notebook", "team-a"): 2})
+        assert led.tenant_table()["team-a"]["apiserver"]["update"] == 8
+
+    def test_cluster_scoped_requests_have_no_owning_tenant(self):
+        led = _ledger()
+        led.ingest_apiserver({("list", "Node", ""): 50})
+        assert led.tenant_table() == {}
+
+    def test_tenants_past_cap_fold_into_other(self):
+        led = _ledger(max_tenants=2)
+        led.observe_dispatch("team-a", 0.0, 0.0)
+        led.observe_dispatch("team-b", 0.0, 0.0)
+        led.observe_dispatch("team-c", 0.0, 0.0)   # over cap: folds
+        led.observe_dispatch("team-d", 0.0, 0.0)   # folds too
+        tbl = led.tenant_table()
+        assert sorted(tbl) == [OTHER_TENANT, "team-a", "team-b"]
+        assert tbl[OTHER_TENANT]["dispatches"] == 2
+
+    def test_empty_namespace_dispatch_folds_into_other(self):
+        led = _ledger()
+        led.observe_dispatch("", 0.1, 0.1)
+        assert led.tenant_table()[OTHER_TENANT]["dispatches"] == 1
+
+    def test_attempt_stream_latches_last_trace(self):
+        class Rec:
+            trace_id = "trace-xyz"
+            object_key = "team-a/nb-0"
+
+        led = _ledger()
+        led.observe_attempt(Rec())
+        assert led.tenant_table()["team-a"]["last_trace"] == "trace-xyz"
+        led.observe_attempt(None)            # feed path never raises
+
+        class ClusterRec:
+            trace_id = "t2"
+            object_key = "no-namespace"
+
+        led.observe_attempt(ClusterRec())    # cluster-scoped: ignored
+        assert "no-namespace" not in led.tenant_table()
+
+
+class _SLOStub:
+    def __init__(self):
+        self.latched = []
+
+    def latch_exemplar(self, objective, exemplar):
+        self.latched.append((objective, exemplar))
+
+
+def _latch_baselines(led, tenants, e2r=0.01):
+    """Pump enough benign dispatches through each tenant to latch its
+    baseline p99 (ledger latches at >= baseline_samples observations)."""
+    for ns in tenants:
+        for _ in range(led.baseline_samples):
+            led.observe_dispatch(ns, 0.0, e2r)
+
+
+class TestNoisyNeighborDetector:
+    def _detector(self, **kw):
+        # with only two tenants, factor 3 would need a >150% share —
+        # 1.5 keeps the threshold reachable (share > 75% of the window)
+        kw.setdefault("fairshare_factor", 1.5)
+        kw.setdefault("window_evals", 4)
+        return _ledger(slo_engine=_SLOStub(), **kw)
+
+    def _flood(self, led, noisy, victims, rounds=3, flood=100,
+               degraded_e2r=5.0):
+        """Drive flood rounds: the noisy tenant issues `flood` dispatches
+        per round while every victim sees a few degraded dispatches."""
+        out = {}
+        for _ in range(rounds):
+            for _ in range(flood):
+                led.observe_dispatch(noisy, 0.0, 0.0)
+            for v in victims:
+                for _ in range(3):
+                    led.observe_dispatch(v, 0.0, degraded_e2r)
+            out = led.evaluate()
+        return out
+
+    def test_flood_with_degraded_victim_fires_exactly_once(self):
+        led = self._detector()
+
+        class Rec:
+            trace_id = "noisy-trace"
+            object_key = "team-noisy/nb-0"
+
+        _latch_baselines(led, ["team-noisy", "team-quiet"])
+        led.observe_attempt(Rec())
+        # benign rounds: balanced shares, nothing fires
+        for _ in range(3):
+            for ns in ("team-noisy", "team-quiet"):
+                for _ in range(10):
+                    led.observe_dispatch(ns, 0.0, 0.01)
+            verdict = led.evaluate()
+            assert verdict["noisy"] == [] and verdict["fired"] == []
+
+        verdict = self._flood(led, "team-noisy", ["team-quiet"])
+        assert verdict["noisy"] == ["team-noisy"]
+        assert led.flagged() == ["team-noisy"]
+        row = led.tenant_table()["team-noisy"]
+        assert row["flagged"] is True and row["fired_total"] == 1
+        # firing is once per episode even though the flood spans rounds
+        assert led.checks["noisy"] >= 1
+        # the SLO exemplar carries the latched trace of the noisy tenant
+        assert led.slo_engine.latched == [
+            ("tenant_fairness",
+             {"trace_id": "noisy-trace", "tenant": "team-noisy"})]
+
+    def test_victim_not_degraded_means_no_flag(self):
+        led = self._detector()
+        _latch_baselines(led, ["team-noisy", "team-quiet"])
+        # flood, but the quiet tenant's p99 stays at baseline
+        verdict = self._flood(led, "team-noisy", ["team-quiet"],
+                              degraded_e2r=0.01)
+        assert verdict["noisy"] == [] and led.flagged() == []
+        assert led.checks["noisy"] == 0
+
+    def test_single_tenant_is_never_its_own_neighbor(self):
+        led = self._detector()
+        _latch_baselines(led, ["team-solo"])
+        for _ in range(3):
+            for _ in range(200):
+                led.observe_dispatch("team-solo", 0.0, 5.0)
+            verdict = led.evaluate()
+            assert verdict["noisy"] == []
+
+    def test_near_idle_window_is_not_judged(self):
+        """Below _MIN_WINDOW_UNITS total traffic, shares are all noise
+        and no verdict may fire even on a 100% share."""
+        led = self._detector()
+        _latch_baselines(led, ["team-a", "team-b"], e2r=0.01)
+        led.evaluate()  # roll the baseline burst out of the window
+        for _ in range(led.window_evals):
+            led.evaluate()
+        led.observe_dispatch("team-a", 0.0, 0.0)
+        led.observe_dispatch("team-b", 0.0, 5.0)   # degraded, tiny traffic
+        verdict = led.evaluate()
+        assert verdict["noisy"] == []
+
+    def test_flag_clears_when_share_drops_back(self):
+        led = self._detector()
+        _latch_baselines(led, ["team-noisy", "team-quiet"])
+        self._flood(led, "team-noisy", ["team-quiet"])
+        assert led.flagged() == ["team-noisy"]
+        # recovery: balanced traffic rolls the flood out of the window
+        cleared = []
+        for _ in range(led.window_evals + 1):
+            for ns in ("team-noisy", "team-quiet"):
+                for _ in range(10):
+                    led.observe_dispatch(ns, 0.0, 0.01)
+            cleared.extend(led.evaluate()["cleared"])
+        assert cleared == ["team-noisy"]
+        assert led.flagged() == []
+
+    def test_refire_after_clear_is_a_new_episode(self):
+        led = self._detector()
+        _latch_baselines(led, ["team-noisy", "team-quiet"])
+        self._flood(led, "team-noisy", ["team-quiet"])
+        for _ in range(led.window_evals + 1):
+            for ns in ("team-noisy", "team-quiet"):
+                for _ in range(10):
+                    led.observe_dispatch(ns, 0.0, 0.01)
+            led.evaluate()
+        assert led.flagged() == []
+        self._flood(led, "team-noisy", ["team-quiet"])
+        assert led.tenant_table()["team-noisy"]["fired_total"] == 2
+
+    def test_other_tenant_is_excluded_from_verdicts(self):
+        """The fold target aggregates many namespaces — flagging it
+        would name nobody, so it neither fires nor counts as a victim."""
+        led = self._detector(max_tenants=1)
+        _latch_baselines(led, ["team-a"])
+        # these two fold into "other", which then floods
+        for _ in range(3):
+            for _ in range(200):
+                led.observe_dispatch("team-x", 0.0, 0.0)
+            for _ in range(3):
+                led.observe_dispatch("team-a", 0.0, 5.0)
+            verdict = led.evaluate()
+        assert verdict["noisy"] == []
+        assert OTHER_TENANT in led.tenant_table()
+
+    def test_warning_event_dedupes_through_real_recorder(self):
+        from kubeflow_tpu.kube import ApiServer, EventRecorder
+
+        api = ApiServer()
+        led = self._detector()
+        led.recorder = EventRecorder(api, "tenant-metering")
+        _latch_baselines(led, ["team-noisy", "team-quiet"])
+        self._flood(led, "team-noisy", ["team-quiet"])
+        # clear, then refire: the second Warning must dedupe into the
+        # same Event object (stable message), not create a second one
+        for _ in range(led.window_evals + 1):
+            for ns in ("team-noisy", "team-quiet"):
+                for _ in range(10):
+                    led.observe_dispatch(ns, 0.0, 0.01)
+            led.evaluate()
+        self._flood(led, "team-noisy", ["team-quiet"])
+        events = [e for e in api.list("Event")
+                  if e.body.get("reason") == REASON_NOISY]
+        assert len(events) == 1, [e.body for e in events]
+        ev = events[0].body
+        assert ev["type"] == "Warning"
+        assert ev["involvedObject"]["name"] == "team-noisy"
+        assert ev["count"] == 2
+
+    def test_fairness_counter_and_snapshot_shape(self):
+        reg = Registry()
+        fams = register_metering_metrics(reg)
+        led = self._detector(registry=reg)
+        _latch_baselines(led, ["team-a", "team-b"])
+        led.evaluate()
+        snap = led.snapshot()
+        assert snap["enabled"] is True
+        assert snap["buckets"] == list(BUCKETS)
+        assert snap["fairness"]["evaluations"] == 1
+        assert snap["fairness"]["flagged"] == []
+        assert snap["conservation"]["violations"] == 0
+        assert fams["fairness"].value("ok") == 1.0
+        assert json.dumps(snap)  # the /debug/tenants body serializes
+
+    def test_clear_resets_all_state(self):
+        led = self._detector()
+        _latch_baselines(led, ["team-a", "team-b"])
+        led.sample({("team-a", "nb"): (BUCKET_READY, 2.0)})
+        led.evaluate()
+        led.clear()
+        assert led.tenant_table() == {}
+        assert led.conservation()["checked"] == 0
+        assert led.evaluations_total == 0
+
+
+class TestBucketMapping:
+    """The pure census classifiers in core/metrics.py."""
+
+    def _nb(self, tpu=None):
+        from kubeflow_tpu.api.types import Notebook, TPUSpec
+        spec = TPUSpec(*tpu) if tpu else None
+        return Notebook.new("nb", "ns", tpu=spec).obj
+
+    def test_placement_chips_resolves_topology(self):
+        from kubeflow_tpu.core.metrics import placement_chips
+        assert placement_chips(self._nb(("v5e", "2x2"))) == 4.0
+        nb = self._nb(("v5e", "2x4"))
+        nb.spec["tpu"]["slices"] = 2
+        assert placement_chips(nb) == 16.0
+        assert placement_chips(self._nb()) == 0.0
+        bad = self._nb(("v5e", "2x2"))
+        bad.spec["tpu"]["topology"] = "not-a-shape"
+        assert placement_chips(bad) == 0.0  # invalid spec: wall-time only
+
+    def test_metering_bucket_partitions_slice_health(self):
+        from kubeflow_tpu.core import constants as C
+        from kubeflow_tpu.core.metrics import metering_bucket
+        nb = self._nb(("v5e", "2x2"))
+        assert metering_bucket(nb) == BUCKET_SCHEDULING  # no status yet
+        for health, want in (("Healthy", BUCKET_READY),
+                             ("Unhealthy", BUCKET_RECOVERING),
+                             ("Degraded", BUCKET_RECOVERING),
+                             ("Stopping", BUCKET_IDLE),
+                             ("Stopped", BUCKET_IDLE),
+                             ("Scheduling", BUCKET_SCHEDULING)):
+            nb.body["status"] = {"sliceHealth": health}
+            assert metering_bucket(nb) == want, health
+        # the stop annotation wins over a healthy slice: chips held past
+        # the cull decision are idle
+        nb.body["status"] = {"sliceHealth": "Healthy"}
+        nb.metadata.annotations[C.STOP_ANNOTATION] = "2026-08-07T00:00:00Z"
+        assert metering_bucket(nb) == BUCKET_IDLE
+
+
+class TestCensusIntegration:
+    """The real pipeline: placement-annotated Notebooks -> InformerCache
+    aggregate -> NotebookMetrics scrape -> ledger."""
+
+    def _env(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.notebook_controller import \
+            setup_core_controllers
+        from kubeflow_tpu.kube import ApiServer, FakeCluster, Manager
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node", allocatable={"cpu": "64",
+                                                  "memory": "256Gi"})
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock)
+        metrics = NotebookMetrics(api, manager=mgr)
+        setup_core_controllers(mgr, CoreConfig(), metrics)
+        led = TenantMeteringLedger(clock, registry=metrics.registry)
+        mgr.metering = led
+        metrics.attach_metering(led)
+        return api, mgr, metrics, clock, led
+
+    def _place(self, api, mgr, name, ns, tpu=None):
+        from kubeflow_tpu.api.types import Notebook, TPUSpec
+        from kubeflow_tpu.core import constants as C
+        spec = TPUSpec(*tpu) if tpu else None
+        api.create(Notebook.new(name, ns, tpu=spec).obj)
+        mgr.run_until_idle()
+        nb = api.get("Notebook", ns, name)
+        nb.metadata.annotations[C.ANNOTATION_PLACEMENT] = json.dumps(
+            {"pool": "pool-0"})
+        api.update(nb)
+        mgr.run_until_idle()
+        return api.get("Notebook", ns, name)
+
+    def test_scrape_meters_placed_notebooks_and_attributes_dispatches(self):
+        api, mgr, metrics, clock, led = self._env()
+        self._place(api, mgr, "metered", "team-a", tpu=("v5e", "2x2"))
+
+        metrics.scrape()                  # meter opens
+        clock.advance(30)
+        metrics.scrape()                  # 30s accrued
+        row = led.tenant_table()["team-a"]
+        assert row["chip_seconds_total"] == pytest.approx(4.0 * 30)
+        assert row["notebooks_metered"] == 1
+        # the reconciles that created the notebook were attributed
+        assert row["dispatches"] > 0
+        assert row["apiserver_total"] > 0
+        assert "update" in row["apiserver"] or "create" in row["apiserver"]
+        assert led.conservation()["violations"] == 0
+
+    def test_release_finalizes_conserving_interval(self):
+        from kubeflow_tpu.core import constants as C
+        api, mgr, metrics, clock, led = self._env()
+        self._place(api, mgr, "short", "team-a", tpu=("v5e", "2x2"))
+        metrics.scrape()
+        clock.advance(15)
+        metrics.scrape()
+        nb = api.get("Notebook", "team-a", "short")
+        del nb.metadata.annotations[C.ANNOTATION_PLACEMENT]  # released
+        api.update(nb)
+        mgr.run_until_idle()
+        metrics.scrape()
+        cons = led.conservation()
+        assert cons["finalized"] == 1 and cons["violations"] == 0
+        assert led.snapshot()["live_meters"] == 0
+
+    def test_deletion_finalizes_the_meter(self):
+        api, mgr, metrics, clock, led = self._env()
+        self._place(api, mgr, "doomed", "team-a", tpu=("v5e", "2x2"))
+        metrics.scrape()
+        clock.advance(5)
+        api.delete("Notebook", "team-a", "doomed")
+        mgr.run_until_idle()
+        metrics.scrape()
+        assert led.conservation()["finalized"] == 1
+        assert led.conservation()["violations"] == 0
+
+    def test_cache_census_matches_bruteforce_under_seeded_churn(self):
+        """The incremental cache aggregate must stay equal to a full
+        api.list scan through placements, health flips, stop/unstop,
+        releases, deletes, and creates."""
+        from kubeflow_tpu.api.types import Notebook, TPUSpec
+        from kubeflow_tpu.core import constants as C
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+
+        api, mgr, metrics, clock, led = self._env()
+        metrics.scrape()   # registers the tenant-metering aggregate
+        rng = random.Random(1337)
+        names = []
+        for i in range(8):
+            ns = f"team-{i % 3}"
+            api.create(Notebook.new(f"nb-{i}", ns,
+                                    tpu=TPUSpec("v5e", "2x2")).obj)
+            names.append((ns, f"nb-{i}"))
+        mgr.run_until_idle()
+
+        def decode(pairs):
+            out = {}
+            for key, chips in pairs:
+                p = key.split(NotebookMetrics._SEP)
+                out[(p[0], p[1])] = (p[2], chips)
+            return out
+
+        def bruteforce():
+            acc = {}
+            for nb in api.list("Notebook"):
+                acc.update(NotebookMetrics._metering_census(nb).items())
+            return decode(acc.items())
+
+        next_id = 8
+        for _ in range(40):
+            ns, name = rng.choice(names)
+            nb = api.get("Notebook", ns, name)
+            op = rng.randrange(6)
+            if nb is None or op == 5:
+                if nb is not None:
+                    api.delete("Notebook", ns, name)
+                    names.remove((ns, name))
+                new = (f"team-{next_id % 3}", f"nb-{next_id}")
+                next_id += 1
+                api.create(Notebook.new(new[1], new[0],
+                                        tpu=TPUSpec("v5e", "2x2")).obj)
+                names.append(new)
+            elif op == 0:
+                nb.metadata.annotations[C.ANNOTATION_PLACEMENT] = \
+                    json.dumps({"pool": "p"})
+                api.update(nb)
+            elif op == 1:
+                nb.metadata.annotations.pop(C.ANNOTATION_PLACEMENT, None)
+                api.update(nb)
+            elif op == 2:
+                nb.body.setdefault("status", {})["sliceHealth"] = \
+                    rng.choice(["Healthy", "Unhealthy", "Degraded",
+                                "Scheduling", "Stopping"])
+                api.update(nb)
+            elif op == 3:
+                nb.metadata.annotations[C.STOP_ANNOTATION] = "stamp"
+                api.update(nb)
+            else:
+                nb.metadata.annotations.pop(C.STOP_ANNOTATION, None)
+                api.update(nb)
+            mgr.run_until_idle()
+            clock.advance(1)
+            metrics.scrape()
+            cached = decode(
+                mgr.cache.aggregate("Notebook", "tenant-metering").items())
+            assert cached == bruteforce()
+        assert led.conservation()["violations"] == 0
+
+    def test_shared_ledger_survives_manager_failover(self):
+        """One ledger serving successive managers (the sharded-fleet
+        wiring): accrual continues across the handoff and the interval
+        still conserves when it finally closes."""
+        from kubeflow_tpu.core import constants as C
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.notebook_controller import \
+            setup_core_controllers
+        from kubeflow_tpu.kube import Manager
+        from kubeflow_tpu.utils.config import CoreConfig
+
+        api, mgr, metrics, clock, led = self._env()
+        self._place(api, mgr, "durable", "team-a", tpu=("v5e", "2x2"))
+        metrics.scrape()
+        clock.advance(10)
+        metrics.scrape()
+
+        # "failover": a fresh manager + metrics attach the SAME ledger
+        mgr2 = Manager(api, clock=clock)
+        metrics2 = NotebookMetrics(api, manager=mgr2)
+        setup_core_controllers(mgr2, CoreConfig(), metrics2)
+        mgr2.metering = led
+        metrics2.attach_metering(led)
+        mgr2.run_until_idle()
+        metrics2.scrape()
+        clock.advance(20)
+        metrics2.scrape()
+
+        row = led.tenant_table()["team-a"]
+        assert row["chip_seconds_total"] == pytest.approx(4.0 * 30)
+        nb = api.get("Notebook", "team-a", "durable")
+        del nb.metadata.annotations[C.ANNOTATION_PLACEMENT]
+        api.update(nb)
+        mgr2.run_until_idle()
+        metrics2.scrape()
+        cons = led.conservation()
+        assert cons["finalized"] == 1 and cons["violations"] == 0
+        [rec] = list(led._conservation)
+        assert rec["wall_s"] == pytest.approx(30.0)
+
+    def test_tenant_families_render_in_the_exposition(self):
+        api, mgr, metrics, clock, led = self._env()
+        self._place(api, mgr, "vis", "team-a", tpu=("v5e", "2x2"))
+        metrics.scrape()
+        clock.advance(5)
+        text = metrics.scrape()
+        assert ('notebook_tenant_chip_seconds_total{namespace="team-a",'
+                'bucket="') in text
+        assert "notebook_tenant_queue_seconds_total" in text
+        assert "notebook_tenant_fairness_checks_total" in text

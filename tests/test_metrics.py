@@ -17,9 +17,11 @@ import pytest
 
 from kubeflow_tpu.utils.metrics import (
     DEFAULT_BUCKETS,
+    OVERFLOW_LABEL,
     Counter,
     Histogram,
     Registry,
+    register_cardinality_metrics,
 )
 
 _SAMPLE_RE = re.compile(
@@ -314,6 +316,102 @@ class TestFullStackScrape:
         assert fams["workqueue_retries_total"]["samples"][key] == 2
         assert fams["reconcile_errors_total"]["samples"][
             ("reconcile_errors_total", (("controller", "nb"),))] == 1
+
+
+class TestCardinalityGuard:
+    """Per-family label-set cap (METRICS_MAX_LABEL_SETS): series past the
+    cap fold into the reserved `other` series instead of growing the
+    exposition without bound, and every fold is counted."""
+
+    def test_overflow_folds_into_other_series(self):
+        r = Registry(max_label_sets=2)
+        c = r.counter("x_total", "h", labels=("tenant",))
+        c.labels("a").inc(1)
+        c.labels("b").inc(2)
+        c.labels("c").inc(5)   # third distinct series: folds
+        c.labels("d").inc(7)   # folds into the SAME other series
+        assert c.value("a") == 1 and c.value("b") == 2
+        assert c.value(OVERFLOW_LABEL) == 12
+        assert c.labelsets_dropped == 2
+
+    def test_known_series_keep_incrementing_past_cap(self):
+        r = Registry(max_label_sets=1)
+        c = r.counter("x_total", "h", labels=("l",))
+        c.labels("a").inc()
+        c.labels("b").inc()    # folds
+        c.labels("a").inc()    # known series: never folds
+        assert c.value("a") == 2
+        assert c.labelsets_dropped == 1
+
+    def test_render_stays_bounded_and_parseable(self):
+        r = Registry(max_label_sets=3)
+        c = r.counter("x_total", "h", labels=("tenant",))
+        for i in range(50):
+            c.labels(f"t{i}").inc()
+        fams = parse_exposition(r.render())
+        series = [k for k in fams["x_total"]["samples"]
+                  if k[0] == "x_total"]
+        # 3 admitted + 1 overflow series, never 50
+        assert len(series) == 4, series
+        assert ("x_total", (("tenant", OVERFLOW_LABEL),)) in \
+            fams["x_total"]["samples"]
+
+    def test_histogram_observations_fold(self):
+        r = Registry(max_label_sets=1)
+        h = r.histogram("lat_seconds", "h", labels=("c",), buckets=(1.0,))
+        h.labels("a").observe(0.5)
+        h.labels("b").observe(0.5)
+        h.labels("b").observe(2.0)
+        assert h.count_value("a") == 1
+        assert h.count_value(OVERFLOW_LABEL) == 2
+        assert h.labelsets_dropped == 2
+        parse_exposition(r.render())  # fold keeps the exposition valid
+
+    def test_unlabeled_and_exempt_metrics_never_fold(self):
+        r = Registry(max_label_sets=1)
+        g = r.gauge("depth", "h")
+        g.set(7)
+        assert g.labelsets_dropped == 0
+        exempt = r.counter("y_total", "h", labels=("l",), max_label_sets=0)
+        for i in range(10):
+            exempt.labels(f"v{i}").inc()
+        assert exempt.labelsets_dropped == 0
+        assert exempt.value("v9") == 1
+
+    def test_per_metric_override_beats_registry_default(self):
+        r = Registry(max_label_sets=100)
+        c = r.counter("x_total", "h", labels=("l",), max_label_sets=1)
+        c.labels("a").inc()
+        c.labels("b").inc()
+        assert c.value(OVERFLOW_LABEL) == 1
+
+    def test_env_sets_registry_default(self, monkeypatch):
+        monkeypatch.setenv("METRICS_MAX_LABEL_SETS", "1")
+        r = Registry()
+        assert r.max_label_sets == 1
+        c = r.counter("x_total", "h", labels=("l",))
+        c.labels("a").inc()
+        c.labels("b").inc()
+        assert c.value(OVERFLOW_LABEL) == 1
+        monkeypatch.setenv("METRICS_MAX_LABEL_SETS", "not-a-number")
+        assert Registry().max_label_sets > 0  # falls back to the default
+
+    def test_registry_drop_rollup_and_exported_counter(self):
+        r = Registry(max_label_sets=1)
+        c = r.counter("x_total", "h", labels=("l",))
+        c.labels("a").inc()
+        c.labels("b").inc()
+        c.labels("c").inc()
+        h = r.histogram("lat_seconds", "h", labels=("l",), buckets=(1.0,))
+        h.labels("a").observe(0.1)
+        assert r.labelsets_dropped() == {"x_total": 2}
+        dropped = register_cardinality_metrics(r)
+        # the exported family is itself exempt from the cap
+        for fam, n in r.labelsets_dropped().items():
+            dropped.labels(fam).inc(n)
+        assert dropped.value("x_total") == 2
+        fams = parse_exposition(r.render())
+        assert fams["metrics_labelsets_dropped_total"]["type"] == "counter"
 
 
 class TestExemplarsAndOpenMetrics:

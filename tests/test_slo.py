@@ -183,7 +183,7 @@ class TestBurnRateMath:
         names = {o.name for o in default_objectives(cfg)}
         assert names == {"time_to_ready", "event_to_reconcile",
                          "reconcile_errors", "recovery_duration",
-                         "promotion_duration"}
+                         "promotion_duration", "tenant_fairness"}
         cfg = CoreConfig(enable_slice_scheduler=True)
         assert "warmpool_hit_rate" in \
             {o.name for o in default_objectives(cfg)}
@@ -192,6 +192,9 @@ class TestBurnRateMath:
             {o.name for o in default_objectives(cfg)}
         cfg = CoreConfig(slo_promotion_p99_s=0.0)
         assert "promotion_duration" not in \
+            {o.name for o in default_objectives(cfg)}
+        cfg = CoreConfig(slo_tenant_fairness=0.0)
+        assert "tenant_fairness" not in \
             {o.name for o in default_objectives(cfg)}
 
 
