@@ -218,6 +218,48 @@ fleet = json.loads(body)
 assert fleet["tenants"]["conservation"]["violations"] == 0, \
     fleet.get("tenants")
 
+# causal diagnosis: the explainer serves a verdict for the demo
+# notebook (ranked candidates, every chain link citing evidence), an
+# unknown object degrades to an error body (never a 500), and the
+# change-point surface serves its findings/timeline shape
+status, _, body = get("/debug/explain?object=default/demo")
+ex = json.loads(body)
+assert status == 200, status
+assert ex["object"] == "default/demo", ex
+assert ex["cause"] and ex["verdict"], ex
+assert ex["chain"] and all("claim" in l and "evidence" in l
+                           for l in ex["chain"]), ex["chain"]
+assert ex["candidates"][0]["cause"] == ex["cause"], ex["candidates"][0]
+scores = [c["score"] for c in ex["candidates"]]
+assert scores == sorted(scores, reverse=True), scores
+
+status, _, body = get("/debug/explain?object=default/no-such-notebook")
+missing = json.loads(body)
+assert status == 200 and missing["verdict"] == "", missing
+assert "error" in missing, missing
+
+status, _, body = get("/debug/changepoints")
+cp = json.loads(body)
+assert status == 200 and cp["enabled"] is True, cp
+assert cp["evaluations"] > 0, cp
+assert isinstance(cp["changepoints"], list), cp
+assert isinstance(cp["timeline"], list), cp
+for f in cp["changepoints"]:
+    assert f["series"] and f["matched"], f
+    assert f["t_end"] >= f["t_start"], f
+
+# firing alerts carry a `diagnosis` line (vacuously checked on a healthy
+# demo — the field contract is exercised by the chaos soak)
+_, _, body = get("/debug/alerts")
+alerts = json.loads(body)
+for a in alerts["firing"]:
+    assert "diagnosis" in a, a
+
+# /debug/fleet embeds the diagnosis summary
+_, _, body = get("/debug/fleet")
+fleet = json.loads(body)
+assert fleet["diagnosis"]["evaluations"] > 0, fleet.get("diagnosis")
+
 # continuous profiler: enabled for this boot, samples flowing, overhead
 # gauge under the 5% always-on budget
 _, _, body = get("/debug/profile")
@@ -269,6 +311,18 @@ for name, tiers in tl["series"].items():
 tn = bundle["tenants"]
 assert tn["enabled"] is True and "default" in tn["tenants"], tn
 assert tn["conservation"]["violations"] == 0, tn["conservation"]
+# both diagnosis surfaces reconstruct offline: the per-object verdicts
+# are captured, and re-running the detector over the bundle's raw
+# curves is exactly what changepoints_from_bundle does
+diag = bundle["diagnosis"]
+assert diag["enabled"] is True, diag.get("enabled")
+demo = diag["explanations"]["default/demo"]
+assert demo["cause"] and demo["verdict"], demo
+sys.path.insert(0, ".")
+from kubeflow_tpu.utils.diagnosis import changepoints_from_bundle
+offline = changepoints_from_bundle(bundle)
+assert isinstance(offline, list)
 print("diagnose smoke: OK (bundle resolves its slowest attempt offline, "
-      "worker telemetry + critical path + tenants + timeline included)")
+      "worker telemetry + critical path + tenants + timeline + "
+      "diagnosis verdicts included)")
 EOF

@@ -133,6 +133,23 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   python loadtest/convergence.py --sweep 200,600 --shards 3 \
     --check-budget ci/fleet_budget.json \
     --out "${SHARD_RESULT_OUT:-/tmp/shard_fleet_sweep.json}"
+  # diagnosis sweep contract: every sweep point's record names a
+  # non-empty binding stage from the closed vocabulary, and the sweep
+  # names the knee of the wall-time curve (ROADMAP item 1's artifact)
+  python - "${SHARD_RESULT_OUT:-/tmp/shard_fleet_sweep.json}" <<'PYEOF'
+import json, sys
+from kubeflow_tpu.utils.lifecycle import STAGES
+out = json.load(open(sys.argv[1]))
+for rec in out["sweep"]:
+    assert rec.get("binding_stage"), \
+        f"sweep point {rec['count']} missing binding_stage"
+    assert rec["binding_stage"] in STAGES, rec["binding_stage"]
+knee = out["knee"]
+assert knee["count"] in out["points"], knee
+assert knee["binding_stage"] in STAGES, knee
+print(f"sweep diagnosis: knee at {knee['count']} notebooks "
+      f"(binding stage {knee['binding_stage']})")
+PYEOF
   # fleet-scale convergence gate: 10k notebooks must converge at the same
   # reconciles/notebook as the 200-notebook smoke (within tolerance),
   # reach a zero-write steady state, and stay under the committed

@@ -9,6 +9,7 @@ from typing import Optional
 
 from ..api.types import CONDITION_RECOVERY_EXHAUSTED, TPUSpec
 from ..kube import ApiServer, parse_quantity
+from ..utils.diagnosis import register_diagnosis_metrics
 from ..utils.lifecycle import register_lifecycle_metrics
 from ..utils.metering import (BUCKET_IDLE, BUCKET_READY, BUCKET_RECOVERING,
                               BUCKET_SCHEDULING, register_metering_metrics)
@@ -329,6 +330,10 @@ class NotebookMetrics:
         # for inventory stability; an attached TenantMeteringLedger
         # re-registers identically and feeds the same counters
         register_metering_metrics(self.registry)
+        # diagnosis family (utils/diagnosis.py): registered here for
+        # inventory stability; an attached DiagnosisEngine re-registers
+        # identically and feeds the same counter
+        register_diagnosis_metrics(self.registry)
         # cardinality-guard visibility (utils/metrics.py): ONE exported
         # family fed at scrape time from every scraped registry's
         # labelsets_dropped() — per-registry auto-registration would emit
@@ -386,6 +391,14 @@ class NotebookMetrics:
         # diagnostics-bundle history)
         self.tsdb = None
         self._tsdb_clock = None
+        # DiagnosisEngine attached via attach_diagnosis(): every scrape()
+        # runs one change-point evaluation AFTER the TSDB sample lands
+        # (the detector consumes the raw tier this scrape just extended);
+        # fleet_snapshot grows a `diagnosis` section
+        self.diagnosis = None
+        # previous-scrape values behind the TSDB's *_delta series (a
+        # cumulative counter can't feed a level-shift detector)
+        self._tsdb_prev: dict[str, float] = {}
         # last snapshot of the manager's cumulative totals, so each scrape
         # feeds the counters exactly the delta since the previous scrape
         self._counter_snapshots: dict[tuple, float] = {}
@@ -439,6 +452,13 @@ class NotebookMetrics:
         FakeClock-deterministic in tests."""
         self.tsdb = store
         self._tsdb_clock = clock
+
+    def attach_diagnosis(self, engine) -> None:
+        """Attach a DiagnosisEngine (utils/diagnosis.py); every scrape()
+        runs one change-point evaluation over the TSDB's fresh raw
+        points and diffs the discrete evidence surfaces, and
+        fleet_snapshot() grows a `diagnosis` section."""
+        self.diagnosis = engine
 
     def _feed_counter(self, counter, label, total: float) -> None:
         """Advance a monotonic counter to `total` using deltas against the
@@ -605,6 +625,10 @@ class NotebookMetrics:
         if self.tsdb is not None:
             # last, so the sample reads this scrape's fresh evaluations
             self._feed_tsdb()
+        if self.diagnosis is not None:
+            # after the TSDB feed: the change-point detector consumes the
+            # raw point this scrape just appended
+            self.diagnosis.evaluate()
         return self.render(openmetrics=openmetrics)
 
     def _feed_metering(self) -> None:
@@ -647,6 +671,13 @@ class NotebookMetrics:
         for family, total in sorted(merged.items()):
             self._feed_counter(self.labelsets_dropped, family, total)
 
+    def _tsdb_delta(self, key: str, total: float) -> float:
+        """Per-scrape delta of a cumulative total (floored at 0 across
+        source resets) for the TSDB's *_delta series."""
+        prev = self._tsdb_prev.get(key, 0.0)
+        self._tsdb_prev[key] = total
+        return max(total - prev, 0.0)
+
     def _feed_tsdb(self) -> None:
         """One TSDB sample per scrape: the handful of series whose curves
         answer 'where does it bend' — ready/reaction p99s, queue state,
@@ -669,13 +700,31 @@ class NotebookMetrics:
                     histogram_quantile(e2r, 0.99)
             rt = mgr_registry.get("controller_runtime_reconcile_total")
             if rt is not None:
-                values["reconciles_total"] = sum(rt.collect().values())
+                counts = rt.collect()
+                values["reconciles_total"] = sum(counts.values())
+                # errored ATTEMPTS (not retry-budget drops): the rate a
+                # fault-plan window actually moves
+                values["reconcile_errors_delta"] = self._tsdb_delta(
+                    "reconcile_errors",
+                    float(sum(v for k, v in counts.items()
+                              if "error" in k)))
         if self.manager is not None:
             stats = self.manager.queue_stats()
             values["workqueue_depth"] = float(
                 sum(stats["depth"].values()))
             values["workqueue_backoff_pending"] = float(
                 sum(stats["backoff_pending"].values()))
+        # level-shift-friendly shapes for the diagnosis engine: active
+        # straggler count plus per-scrape deltas of the promotion counter
+        # (cumulative totals ramp forever; only their rate level-shifts)
+        straggler = self.registry.get("notebook_dataplane_straggler")
+        if straggler is not None:
+            values["dataplane_stragglers"] = float(
+                sum(straggler.collect().values()))
+        promotions = self.registry.get("notebook_promotions_total")
+        if promotions is not None:
+            values["promotions_delta"] = self._tsdb_delta(
+                "promotions", float(sum(promotions.collect().values())))
         if self.lifecycle is not None:
             for stage, p99 in self.lifecycle.stage_p99s().items():
                 values["stage_p99.%s" % stage] = p99
@@ -770,6 +819,11 @@ class NotebookMetrics:
             # conservation gate — /debug/fleet alone reconstructs a
             # noisy-neighbor incident
             out["tenants"] = self.metering.snapshot()
+        if self.diagnosis is not None:
+            # the causal view: change-point counts and the most recent
+            # annotated findings (full detail at /debug/changepoints,
+            # per-object verdicts at /debug/explain)
+            out["diagnosis"] = self.diagnosis.fleet_summary()
         return out
 
     def _scrape_census_from_cache(self, cache) -> None:

@@ -366,6 +366,9 @@ class Manager:
         # tenant metering ledger (utils/metering.py): receives per-tenant
         # workqueue dispatch attribution and the completed-attempt stream
         self.metering = None
+        # causal diagnosis engine (utils/diagnosis.py): mines the attempt
+        # stream for discrete evidence (faults, promotions, recoveries)
+        self.diagnosis = None
         # replica identity for lifecycle attribution: a sharded fleet sets
         # this to the shard id so a manager change between consecutive
         # attempts of one notebook reads as handoff/adoption wait
@@ -879,6 +882,11 @@ class Manager:
                         # per-tenant exemplar trace a fired fairness
                         # alert resolves at /debug/traces
                         self.metering.observe_attempt(rec)
+                    if rec is not None and self.diagnosis is not None:
+                        # attempt stream -> diagnosis engine: injected
+                        # faults / promotions / recoveries become the
+                        # discrete timeline change points correlate to
+                        self.diagnosis.observe_attempt(rec)
                 except Exception:  # noqa: BLE001 — observability must
                     # never take the reconcile loop down with it
                     logger.exception("flight recorder rejected a span")

@@ -85,8 +85,17 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
       trace payloads carry object names and error strings that must not be
       scrapeable from off-pod);
     - /debug/alerts — the SLO engine's burn-alert surface: objective
-      stats, firing alerts, and the bounded fire/resolve history (each
-      alert carrying an exemplar trace_id resolvable at /debug/traces);
+      stats, firing alerts (each annotated with the diagnosis engine's
+      one-line verdict for its exemplar), and the bounded fire/resolve
+      history (each alert carrying an exemplar trace_id resolvable at
+      /debug/traces);
+    - /debug/explain — ?object=<ns>/<name> returns the diagnosis
+      engine's ranked causal chain for one notebook, every link citing
+      its evidence (trace_id, event, metric sample);
+    - /debug/changepoints — the fleet change-point detector's annotated
+      findings over the TSDB's watched series, each correlated against
+      the discrete event timeline (fault windows, promotions, shard
+      epochs, warm-pool resizes, straggler onsets, noisy tenants);
     - /debug/profile — the continuous profiler's aggregated collapsed
       stacks (JSON, or flamegraph text with ?format=collapsed);
     - /debug/fleet — per-namespace / per-shape health rollup off the
@@ -212,6 +221,40 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
             body = engine.snapshot() if engine is not None else {
                 "enabled": False,
                 "error": "no SLO engine attached to this manager"}
+            diagnosis = getattr(mgr, "diagnosis", None)
+            if engine is not None and diagnosis is not None:
+                # each firing alert gains a one-line `diagnosis` verdict
+                # for its latched exemplar ("" when no verdict, never an
+                # error)
+                body = diagnosis.annotate_alerts(body)
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
+        elif path == "/debug/explain":
+            diagnosis = getattr(mgr, "diagnosis", None)
+            object_key = (query.get("object") or [""])[0]
+            if diagnosis is None:
+                body = {"enabled": False,
+                        "error": "no diagnosis engine attached to this "
+                                 "manager"}
+            elif "/" not in object_key:
+                body = {"error": "pass ?object=<namespace>/<name>",
+                        "object": object_key, "verdict": ""}
+            else:
+                ns, _, name = object_key.partition("/")
+                body = diagnosis.explain(ns, name)
+            self._respond(200, json.dumps(body, default=str),
+                          "application/json")
+        elif path == "/debug/changepoints":
+            diagnosis = getattr(mgr, "diagnosis", None)
+            if diagnosis is None:
+                body = {"enabled": False,
+                        "error": "no diagnosis engine attached to this "
+                                 "manager"}
+            else:
+                # evaluate on read so an operator polling between scrapes
+                # sees shifts in the latest samples, not the last scrape's
+                diagnosis.evaluate()
+                body = diagnosis.snapshot()
             self._respond(200, json.dumps(body, default=str),
                           "application/json")
         elif path == "/debug/profile":
@@ -389,6 +432,18 @@ def build_manager(
         slo_engine=engine)
     mgr.metering = metering
     metrics.attach_metering(metering)
+    # causal diagnosis engine: fuses every stream above into per-notebook
+    # verdicts (/debug/explain) and TSDB change-point findings
+    # (/debug/changepoints); evaluated once per scrape after the TSDB
+    # sample lands
+    from .utils.diagnosis import DiagnosisEngine
+
+    diagnosis = DiagnosisEngine(
+        mgr.clock, registry=metrics.registry,
+        recorder=mgr.flight_recorder, lifecycle=ledger, slo_engine=engine,
+        metering=metering, tsdb=tsdb, dataplane=aggregator, api=api)
+    mgr.diagnosis = diagnosis
+    metrics.attach_diagnosis(diagnosis)
     if core_cfg.enable_continuous_profiler:
         # always-on (controller, phase) CPU attribution; self-overhead is
         # exported so "can it stay on" is a gauge (/debug/profile)
@@ -481,6 +536,14 @@ def build_sharded_fleet(
         fairshare_factor=core_cfg.tenant_fairshare_factor,
         top_k=core_cfg.tenant_top_k)
     metrics.attach_metering(metering)
+    # ONE diagnosis engine across every replica (same sharing rationale):
+    # change points and verdicts read the fleet-wide fused timeline
+    from .utils.diagnosis import DiagnosisEngine
+
+    diagnosis = DiagnosisEngine(
+        clock, registry=metrics.registry, lifecycle=ledger,
+        metering=metering, tsdb=tsdb, api=api)
+    metrics.attach_diagnosis(diagnosis)
 
     def controllers(replica):
         # replica.manager.api is the FencedApi: every controller write is
@@ -489,10 +552,19 @@ def build_sharded_fleet(
         replica.manager.manager_id = replica.shard_id
         replica.manager.tsdb = tsdb
         replica.manager.metering = metering
+        replica.manager.diagnosis = diagnosis
         if metering.clock is None:
             # clock=None build: the first replica's manager clock drives
             # the accrual timestamps (same fallback as the TSDB feed)
             metering.clock = replica.manager.clock
+        if diagnosis.clock is None:
+            diagnosis.clock = replica.manager.clock
+        if diagnosis.recorder is None:
+            # the first replica's flight recorder anchors trace->object
+            # resolution for alert annotation (each replica records its
+            # own attempts; explain() still works per replica via the
+            # shared ledger)
+            diagnosis.recorder = replica.manager.flight_recorder
         setup_core_controllers(replica.manager, core_cfg, metrics,
                                provisioner=cluster)
         setup_culling(replica.manager, core_cfg, metrics=metrics)
@@ -503,6 +575,8 @@ def build_sharded_fleet(
         api, count=count, clock=clock, controller_factory=controllers,
         lease_duration_s=core_cfg.shard_lease_duration_s)
     metrics.attach_shard(fleet)
+    # membership epochs feed the diagnosis engine's discrete timeline
+    diagnosis.fleet = fleet
     return fleet, api, cluster, metrics
 
 

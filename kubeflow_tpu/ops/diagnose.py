@@ -29,7 +29,11 @@ A third, offline mode — `--merge a.json b.json c.json` — takes one
 bundle per manager replica of a sharded fleet and sweeps the COMBINED
 attempt histories for same-key reconciles with overlapping real-time
 windows: the cross-process double-reconcile audit that no single
-replica's recorder can run alone.
+replica's recorder can run alone.  It also folds each bundle's TSDB
+timeline into one merged per-series curve (timestamp-sorted, tagged
+with its source replica) and runs the offline change-point sweep over
+the fused curves — fleet-wide level shifts that no single replica's
+capture can see (pass --out to write the merged artifact).
 """
 
 from __future__ import annotations
@@ -100,6 +104,7 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
     ledger = getattr(manager, "lifecycle", None)
     metering = getattr(manager, "metering", None)
     tsdb = getattr(manager, "tsdb", None)
+    diagnosis = getattr(manager, "diagnosis", None)
     reconciles = manager.flight_recorder.snapshot()
     traces = {}
     for tid in _trace_ids(reconciles):
@@ -114,7 +119,11 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
                     else manager.metrics_registry.render()),
         "fleet": (metrics.fleet_snapshot() if metrics is not None
                   else None),
-        "alerts": engine.snapshot() if engine is not None else None,
+        # firing alerts annotated with the diagnosis engine's one-line
+        # verdict per exemplar (same body /debug/alerts serves)
+        "alerts": ((diagnosis.annotate_alerts(engine.snapshot())
+                    if diagnosis is not None else engine.snapshot())
+                   if engine is not None else None),
         "slo_verdicts": engine.verdicts() if engine is not None else None,
         "reconciles": reconciles,
         "traces": traces,
@@ -132,6 +141,10 @@ def collect_local(manager, metrics=None, env: Optional[Mapping[str, str]]
         # full multi-tier dump, not just the inventory: the bundle is
         # what reconstructs a loadtest's p99-vs-time curve offline
         "timeline": tsdb.dump() if tsdb is not None else None,
+        # change-point findings + per-object causal verdicts: both halves
+        # of the diagnosis engine reconstruct offline (and
+        # changepoints_from_bundle re-runs the detector over `timeline`)
+        "diagnosis": diagnosis.export() if diagnosis is not None else None,
         "config": redacted_config(env),
     }
 
@@ -168,6 +181,18 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
         if "error" not in trace:
             traces[tid] = trace
     alerts = get_json("/debug/alerts")
+    # mirror collect_local's diagnosis export: the change-point snapshot
+    # plus one causal verdict per recorded object (bounded)
+    diagnosis = get_json("/debug/changepoints")
+    if isinstance(diagnosis, dict) and "error" not in diagnosis:
+        explanations = {}
+        objects = (reconciles.get("objects") or {}
+                   if isinstance(reconciles, dict) else {})
+        for key in sorted(objects)[:64]:
+            verdict = get_json(f"/debug/explain?object={key}")
+            if isinstance(verdict, dict):
+                explanations[key] = verdict
+        diagnosis["explanations"] = explanations
     return {
         "bundle_format": BUNDLE_FORMAT,
         "captured_at": Clock().now(),
@@ -189,6 +214,7 @@ def collect_http(addr: str, timeout: float = 10.0) -> dict:
         "criticalpath": get_json("/debug/criticalpath"),
         "tenants": get_json("/debug/tenants"),
         "timeline": get_json("/debug/timeline?dump=1"),
+        "diagnosis": diagnosis,
         "config": redacted_config(),
     }
 
@@ -227,7 +253,39 @@ def merge_overlaps(bundles) -> list:
     return sweep_overlaps(merge_records(bundles))
 
 
-def summarize_merge(bundles, records, overlaps) -> str:
+def merge_timelines(bundles) -> dict:
+    """Fold each bundle's TSDB capture into one merged per-series curve
+    (timestamp-sorted, per-replica source tag) so sharded-fleet
+    change-point analysis works offline across per-replica bundles."""
+    from ..utils.diagnosis import merge_timelines as _merge
+
+    return _merge(bundles)
+
+
+def merge_changepoints(merged: dict, bundles) -> list:
+    """Offline change-point sweep over the merged curves, correlated
+    against the union of the bundles' discrete event timelines."""
+    from ..utils.diagnosis import (correlate_events, detect_level_shifts,
+                                   matched_kind, watched_series)
+
+    events = []
+    for bundle in bundles:
+        events.extend((bundle.get("diagnosis") or {}).get("timeline") or ())
+    events.sort(key=lambda e: e.get("t", 0.0))
+    out = []
+    for name, points in merged.get("series", {}).items():
+        if not watched_series(name):
+            continue
+        for hit in detect_level_shifts([(p["t"], p["v"]) for p in points]):
+            matched = correlate_events(events, hit["t_start"], hit["t_end"])
+            hit.update({"series": name, "matched": matched_kind(matched),
+                        "events": matched[-8:]})
+            out.append(hit)
+    return out
+
+
+def summarize_merge(bundles, records, overlaps, merged=None,
+                    changepoints=None) -> str:
     lines = [
         f"merged {len(bundles)} bundles: {len(records)} distinct attempts, "
         f"{len(overlaps)} overlapping pairs"
@@ -237,6 +295,16 @@ def summarize_merge(bundles, records, overlaps) -> str:
             f"  OVERLAP {cur.controller} {cur.object_key}: "
             f"[{prev.mono_start:.6f}, {prev.mono_end:.6f}] vs "
             f"[{cur.mono_start:.6f}, {cur.mono_end:.6f}]")
+    if merged is not None:
+        lines.append(
+            f"  timeline: {len(merged['series'])} merged series, "
+            f"{merged['points_total']} points from "
+            f"{len(merged['sources'])} sources")
+    for cp in changepoints or ():
+        lines.append(
+            f"  CHANGEPOINT {cp['series']} {cp['direction']} "
+            f"{cp['baseline']:.3g}->{cp['level']:.3g} at "
+            f"t={cp['t_start']:.1f} (matched={cp['matched']})")
     return "\n".join(lines)
 
 
@@ -290,7 +358,21 @@ def main(argv: Optional[list[str]] = None) -> int:
                 return 1
         records = merge_records(bundles)
         overlaps = merge_overlaps(bundles)
-        print(summarize_merge(bundles, records, overlaps))
+        merged = merge_timelines(bundles)
+        changepoints = merge_changepoints(merged, bundles)
+        print(summarize_merge(bundles, records, overlaps, merged,
+                              changepoints))
+        if args.out != parser.get_default("out"):
+            # an explicit --out in merge mode writes the merged artifact:
+            # the fused per-series curves + the offline change-point sweep
+            with open(args.out, "w") as f:
+                json.dump({"merged_timeline": merged,
+                           "changepoints": changepoints,
+                           "bundles": len(bundles),
+                           "overlaps": len(overlaps)},
+                          f, indent=2, sort_keys=True, default=str)
+                f.write("\n")
+            print(f"wrote {args.out}")
         return 1 if overlaps else 0
 
     try:

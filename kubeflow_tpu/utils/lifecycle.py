@@ -166,12 +166,18 @@ class LifecycleLedger:
                  max_notebooks: int = 4096,
                  samples_per_stage: int = 2048,
                  keep_conservation: int = 4096,
-                 tolerance: float = 0.05) -> None:
+                 tolerance: float = 0.05,
+                 excursions_per_notebook: int = 32) -> None:
         self.max_notebooks = max_notebooks
         self.samples_per_stage = samples_per_stage
         self.tolerance = tolerance
+        self.excursions_per_notebook = excursions_per_notebook
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        # (ns, name) -> bounded ring of post-ready excursion records, so
+        # recovery/migrate/promote churn is explainable after the fact
+        # (excursions_total alone says "how many", not "what")
+        self._excursion_log: "OrderedDict[tuple, deque]" = OrderedDict()
         # latest observed generation per (ns, name) — scheduler attempts
         # carry it too, but a stale cache read may omit it
         self._gen: "OrderedDict[tuple, int]" = OrderedDict()
@@ -374,11 +380,24 @@ class LifecycleLedger:
         under the lock."""
         exemplar = ({"trace_id": attempt.trace_id}
                     if attempt.trace_id else None)
+        nskey = (entry.namespace, entry.name)
         for (s, e, stage) in attempt.segments:
             if stage not in (STAGE_RECOVER, STAGE_MIGRATE, STAGE_PROMOTE):
                 continue
             dur = max(e - s, 0.0)
             self.excursions_total += 1
+            ring = self._excursion_log.get(nskey)
+            if ring is None:
+                ring = deque(maxlen=self.excursions_per_notebook)
+                self._excursion_log[nskey] = ring
+                while len(self._excursion_log) > self.max_notebooks:
+                    self._excursion_log.popitem(last=False)
+            self._excursion_log.move_to_end(nskey)
+            ring.append({
+                "stage": stage, "duration_s": dur, "start": s, "end": e,
+                "trace_id": attempt.trace_id,
+                "generation": entry.generation,
+            })
             self._stage_total[stage] = \
                 self._stage_total.get(stage, 0.0) + dur
             self._stage_count[stage] = self._stage_count.get(stage, 0) + 1
@@ -484,6 +503,22 @@ class LifecycleLedger:
                 "attempts": len(e.attempts),
             }
 
+    def latest_entry(self, namespace: str, name: str) -> Optional[dict]:
+        """The notebook's most recent generation's partition (the
+        diagnosis engine's entry point — callers don't know generations)."""
+        with self._lock:
+            gen = self._gen.get((namespace, name))
+        if gen is None:
+            return None
+        return self.entry(namespace, name, gen)
+
+    def excursions(self, namespace: str, name: str) -> list[dict]:
+        """The bounded post-ready excursion ring for one notebook:
+        recover/migrate/promote records with stage, duration, trace_id."""
+        with self._lock:
+            ring = self._excursion_log.get((namespace, name))
+            return [dict(r) for r in ring] if ring else []
+
     def stage_p99s(self) -> dict[str, float]:
         """Stage -> p99 seconds over the retained samples (the TSDB's
         per-scrape stage series)."""
@@ -508,12 +543,14 @@ class LifecycleLedger:
             base["pending"] = sum(
                 1 for e in self._entries.values() if not e.finalized)
             base["excursions_total"] = self.excursions_total
+            base["excursion_objects"] = len(self._excursion_log)
         return base
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self._gen.clear()
+            self._excursion_log.clear()
             self._stage_total.clear()
             self._stage_count.clear()
             self._stage_samples.clear()

@@ -368,6 +368,10 @@ def _run_fleet(count: int, workers: int, tpu: str,
             "ranking": ledger.ranking(),
             "conservation": cons,
         },
+        # the diagnosis engine's sweep contract: each point names the
+        # stage that dominates its event->ready attribution
+        "binding_stage": (ledger.ranking()[0]["stage"]
+                          if ledger.ranking() else ""),
         # tenant metering verdict (utils/metering): the chip-second
         # partition's conservation summary + attribution totals
         "tenants": {
@@ -705,13 +709,25 @@ def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
                 f"{tag}: {len(not_ready)} notebooks never converged "
                 f"(first: {not_ready[:3]})")
 
+    # the flood arrives in batches, and each batch sits in the queue for
+    # a deterministic beat before the fleet drains it — the only
+    # fake-clock duration a hermetic rollout accrues, so the lifecycle
+    # ledger has stage time to attribute and the sweep's binding_stage
+    # contract has a stage to name (queue_wait, by construction)
+    n_batches = min(4, count) or 1
     t0 = time.perf_counter()
-    for i in range(count):
-        api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE).obj)
-    rollout_reconciles_total = fleet.settle()
+    rollout_reconciles_total = 0
+    created = 0
+    for b in range(n_batches):
+        batch = count // n_batches + (1 if b < count % n_batches else 0)
+        for i in range(created, created + batch):
+            api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE).obj)
+        created += batch
+        clock.advance(2.0)  # queue dwell (well under the shard lease)
+        rollout_reconciles_total += fleet.settle()
+        metrics.scrape()  # one TSDB sample per batch at this instant
     rollout_wall_s = time.perf_counter() - t0
     assert_converged("rollout")
-    metrics.scrape()  # one TSDB sample at rollout convergence
 
     # conservation gate over the SHARED ledger: attempts from every
     # replica (and handoff waits between them) must still partition each
@@ -815,6 +831,10 @@ def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
             "ranking": ledger.ranking(),
             "conservation": ledger.conservation(),
         },
+        # the diagnosis engine's sweep contract: each point names the
+        # stage that dominates its event->ready attribution
+        "binding_stage": (ledger.ranking()[0]["stage"]
+                          if ledger.ranking() else ""),
     }
     metrics.scrape()  # post-kill/rejoin TSDB sample (clock moved on)
     _print_criticalpath(f"{count} notebooks x {shards} shards",
@@ -1374,12 +1394,39 @@ def _run_sweep(args) -> int:
         "shards": args.shards or 0,
         "tpu": args.tpu or "cpu",
         "sweep": sweep,
+        # where the wall-time curve bends: the point with the largest
+        # slope increase (per-notebook cost), plus what binds there —
+        # ROADMAP item 1's "name the binding stage at each point"
+        "knee": _sweep_knee(points, sweep),
     }
     print(json.dumps(out))
     if args.out:
         Path(args.out).write_text(json.dumps(out, indent=2,
                                              sort_keys=True) + "\n")
     return rc
+
+
+def _sweep_knee(points: list[int], sweep: list[dict]) -> dict:
+    """Name the knee of the wall-time curve: per segment the marginal
+    cost (wall seconds per added notebook); the knee is the point whose
+    segment's marginal cost grows the most over the previous segment's.
+    With fewer than 3 points there is no curvature — the largest point
+    stands in."""
+    knee_idx = len(points) - 1
+    if len(points) >= 3:
+        slopes = []
+        for i in range(1, len(points)):
+            dn = points[i] - points[i - 1]
+            slopes.append((sweep[i]["wall_s"] - sweep[i - 1]["wall_s"])
+                          / dn if dn else 0.0)
+        growth = [slopes[i] - slopes[i - 1] for i in range(1, len(slopes))]
+        knee_idx = growth.index(max(growth)) + 2  # segment i ends at i+1
+    at = sweep[knee_idx]
+    return {
+        "count": points[knee_idx],
+        "wall_s": at["wall_s"],
+        "binding_stage": at.get("binding_stage", ""),
+    }
 
 
 def _profile_fleet(args, out_path: str) -> None:

@@ -1865,3 +1865,300 @@ class TestNoisyNeighborSoak:
         finally:
             api.clear_fault_plan()
             tracing.set_clock(None)
+
+
+class TestDiagnosisSoak:
+    """ISSUE-18 acceptance: a seeded soak with THREE disjoint injected
+    degradation windows of different kinds — an API fault plan, a slow
+    data-plane worker, and killed replication primaries.  The causal
+    diagnosis engine must (1) name the true injected cause as the
+    top-ranked explanation for EVERY affected notebook, (2) fire the
+    change-point detector inside each window and NEVER on the quiet
+    baseline segments between them (zero false positives), (3) attach a
+    non-empty one-line diagnosis to the firing burn alert, and (4) have
+    both verdicts reconstruct offline from an ops.diagnose bundle."""
+
+    FAULT_A = 3   # API-fault batch
+    SLOW_B = 2    # telemetry batch (index 0 gets the slow worker)
+    REPL_C = 3    # replicated batch (all primaries killed at once)
+    SCRAPE_S = 60.0
+
+    CFG = dict(
+        checkpoint_store_uri="mem://session-state",
+        recovery_backoff_base_s=0.25,
+        recovery_backoff_max_s=30.0,
+    )
+
+    def _env(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+        from kubeflow_tpu.core.telemetry import WorkerTelemetryAggregator
+        from kubeflow_tpu.kube import EventRecorder
+        from kubeflow_tpu.utils.diagnosis import DiagnosisEngine
+        from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+        from kubeflow_tpu.utils.lifecycle import LifecycleLedger
+        from kubeflow_tpu.utils.slo import SLOEngine, default_objectives
+        from kubeflow_tpu.utils.tsdb import TimeSeriesStore
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        cluster.add_node("cpu-node",
+                         allocatable={"cpu": "64", "memory": "256Gi"})
+        # fault batch + telemetry batch + two gangs per replicated nb,
+        # 4 hosts per gang
+        gangs = self.FAULT_A + self.SLOW_B + 2 * self.REPL_C + 1
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4",
+                                    4 * gangs, 4)
+        clock = FakeClock()
+        mgr = Manager(api, clock=clock,
+                      flight_recorder=FlightRecorder(capacity=16384,
+                                                     per_object=4096))
+        store = InMemorySessionStore(clock=clock)
+        cluster.attach_session_store(store)
+        cfg = CoreConfig(**self.CFG)
+        metrics = NotebookMetrics(api, manager=mgr)
+        setup_core_controllers(mgr, cfg, metrics, session=store)
+        ledger = LifecycleLedger(metrics.registry)
+        mgr.lifecycle = ledger
+        metrics.attach_lifecycle(ledger)
+        tsdb = TimeSeriesStore()
+        mgr.tsdb = tsdb
+        metrics.attach_tsdb(tsdb, clock=clock)
+        slo = SLOEngine(
+            default_objectives(cfg),
+            registries=[metrics.registry, mgr.metrics_registry],
+            clock=clock, recorder=mgr.flight_recorder,
+            burn_threshold=2.0)
+        mgr.slo_engine = slo
+        metrics.attach_slo(slo)
+        aggregator = WorkerTelemetryAggregator(
+            api, metrics.registry, clock, cache=mgr.cache,
+            recorder=EventRecorder(api, "dataplane-telemetry"),
+            straggler_ratio=cfg.dataplane_straggler_ratio,
+            min_workers=cfg.dataplane_straggler_min_workers)
+        metrics.attach_dataplane(aggregator)
+        mgr.telemetry_aggregator = aggregator
+        diag = DiagnosisEngine(
+            clock, registry=metrics.registry,
+            recorder=mgr.flight_recorder, lifecycle=ledger,
+            slo_engine=slo, tsdb=tsdb, dataplane=aggregator, api=api)
+        mgr.diagnosis = diag
+        metrics.attach_diagnosis(diag)
+        return api, cluster, mgr, clock, metrics, diag, slo
+
+    def test_three_window_soak_names_every_injected_cause(self):
+        import json as _json
+
+        from kubeflow_tpu.api.types import ReplicationSpec
+        from kubeflow_tpu.kube.faults import FaultPlan, FaultRule
+        from kubeflow_tpu.models.configs import LLAMA2_350M
+        from kubeflow_tpu.ops.diagnose import collect_local
+        from kubeflow_tpu.utils import tracing
+        from kubeflow_tpu.utils.diagnosis import (
+            CAUSE_FAULT_INJECTION,
+            CAUSE_PRIMARY_FAILOVER,
+            CAUSE_SLOW_WORKER,
+            changepoints_from_bundle,
+        )
+
+        api, cluster, mgr, clock, metrics, diag, slo = self._env()
+        tracing.set_clock(clock)
+
+        def stamp(slow=None):
+            """Fresh telemetry for the SLOW batch every scrape beat so
+            the straggler gauge is level, not flapping on staleness."""
+            for i in range(self.SLOW_B):
+                cluster.stamp_worker_telemetry(
+                    "user1", f"slow-b-{i}", step_time_s=0.5,
+                    config=LLAMA2_350M, batch=8, seq_len=2048,
+                    num_chips=4,
+                    slow_worker=(slow if i == 0 else None),
+                    slow_factor=4.0, now=clock.now())
+
+        def beat(slow=None, n=1):
+            for _ in range(n):
+                clock.advance(self.SCRAPE_S)
+                stamp(slow=slow)
+                metrics.scrape()
+
+        try:
+            for i in range(self.FAULT_A):
+                api.create(Notebook.new(f"fault-a-{i}", "user1",
+                                        tpu=TPUSpec("v5e", "4x4")).obj)
+            for i in range(self.SLOW_B):
+                api.create(Notebook.new(f"slow-b-{i}", "user1",
+                                        tpu=TPUSpec("v5e", "4x4")).obj)
+            for i in range(self.REPL_C):
+                api.create(Notebook.new(
+                    f"repl-c-{i}", "user1", tpu=TPUSpec("v5e", "4x4"),
+                    replication=ReplicationSpec(replicas=2)).obj)
+            mgr.run_until_idle()
+
+            # quiet baseline: latch every series level; nothing may fire
+            beat(n=8)
+            assert diag.findings() == [], diag.findings()
+
+            # -- window A: API fault plan ------------------------------
+            wa0 = clock.now()
+            for r in range(6):
+                plan = FaultPlan([FaultRule(
+                    verbs=("create",), kinds=("Service",),
+                    error="unavailable", max_matches=3,
+                    name=f"diag-api-{r}")], clock=clock)
+                with api.fault_exempt():
+                    api.delete("Service", "user1",
+                               f"fault-a-{r % self.FAULT_A}")
+                api.install_fault_plan(plan)
+                with api.fault_exempt():
+                    mgr.enqueue_all()
+                mgr.settle(max_seconds=7200.0)
+                api.clear_fault_plan()
+                assert len(plan.log) == 3, (r, plan.log)
+                beat()
+            # mid-window: the burn alert fires AND carries a one-line
+            # diagnosis naming the fault plan (the /debug/alerts field)
+            firing = [a.objective for a in slo.firing()]
+            assert "reconcile_errors" in firing, firing
+            ann = diag.annotate_alerts(slo.snapshot())
+            lines = [a["diagnosis"] for a in ann["firing"]
+                     if a["objective"] == "reconcile_errors"]
+            assert lines and all(line for line in lines), ann["firing"]
+            assert any("fault plan" in line for line in lines), lines
+            # settle-back margin: the recovery edge of the same injected
+            # window (the errors-rate step back to zero) detects here
+            beat(n=4)
+            wa1 = clock.now()
+
+            # quiet segment 1: drain the alert, freeze every series
+            n_quiet1 = len(diag.findings())
+            beat(n=2)
+            for _ in range(8):
+                clock.advance(150.0)
+                stamp()
+                metrics.scrape()
+            assert not slo.firing()
+            quiet1_end = clock.now()
+            assert len(diag.findings()) == n_quiet1, diag.findings()
+
+            # -- window B: slow data-plane worker ----------------------
+            wb0 = clock.now()
+            beat(slow=1, n=8)
+            # the straggler verdict is live: the explainer must name the
+            # slow worker for the afflicted notebook, and ONLY for it
+            assert diag.explain("user1", "slow-b-0")["cause"] == \
+                CAUSE_SLOW_WORKER
+            assert diag.explain("user1", "slow-b-1")["cause"] != \
+                CAUSE_SLOW_WORKER
+            wb1 = clock.now()
+
+            # quiet segment 2: the worker stays slow (constant level —
+            # a held degradation is not a new change point)
+            n_quiet2 = len(diag.findings())
+            beat(slow=1, n=10)
+            quiet2_end = clock.now()
+            assert len(diag.findings()) == n_quiet2, diag.findings()
+
+            # -- window C: kill every replication primary --------------
+            wc0 = clock.now()
+            for i in range(self.REPL_C):
+                cluster.set_session_payload("user1", f"repl-c-{i}",
+                                            b"kernel-%d" % i)
+                cluster.snapshot_sessions("user1", f"repl-c-{i}")
+                cluster.sync_followers("user1", f"repl-c-{i}")
+            mgr.enqueue_all()
+            mgr.settle(max_seconds=7200.0)
+            for i in range(self.REPL_C):
+                cluster.fail_pod("user1", f"repl-c-{i}-0")
+            mgr.enqueue_all()
+
+            def promoted(i):
+                st = api.get("Notebook", "user1",
+                             f"repl-c-{i}").body.get("status") or {}
+                rep = st.get("replication") or {}
+                return rep.get("promotion", {}).get("phase") == "promoted"
+
+            for _ in range(12):
+                if all(promoted(i) for i in range(self.REPL_C)):
+                    break
+                mgr.enqueue_all()
+                mgr.advance(1.0)
+            assert all(promoted(i) for i in range(self.REPL_C))
+            mgr.settle(max_seconds=7200.0)
+            # window C runs to soak end: the promotion-rate pulse and its
+            # settle-back edge both belong to this injected degradation
+            beat(slow=1, n=9)
+
+            # -- verdicts ---------------------------------------------
+            # (1) the explainer names the true injected cause for every
+            # affected notebook, per batch
+            for i in range(self.FAULT_A):
+                out = diag.explain("user1", f"fault-a-{i}")
+                assert out["cause"] == CAUSE_FAULT_INJECTION, (i, out)
+                assert out["verdict"], out
+            assert diag.explain("user1", "slow-b-0")["cause"] == \
+                CAUSE_SLOW_WORKER
+            for i in range(self.REPL_C):
+                out = diag.explain("user1", f"repl-c-{i}")
+                assert out["cause"] == CAUSE_PRIMARY_FAILOVER, (i, out)
+
+            # (2) the detector fired inside each window, with the right
+            # correlated event kind...
+            findings = diag.findings()
+            windows = [(wa0, wa1), (wb0, wb1), (wc0, clock.now())]
+
+            def in_window(f, w):
+                return w[0] <= f["t_end"] <= w[1]
+
+            assert any(f["series"] == "reconcile_errors_delta"
+                       and f["matched"] == "fault"
+                       and in_window(f, windows[0]) for f in findings), \
+                findings
+            assert any(f["series"] == "dataplane_stragglers"
+                       and f["matched"] == "slow_worker"
+                       and in_window(f, windows[1]) for f in findings), \
+                findings
+            assert any(f["series"] == "promotions_delta"
+                       and f["matched"] == "promotion"
+                       and in_window(f, windows[2]) for f in findings), \
+                findings
+            # ... and NEVER on the quiet baseline segments: every finding
+            # triggered inside one of the three injected windows
+            for f in findings:
+                assert any(in_window(f, w) for w in windows), f
+            assert quiet1_end <= wb0 and quiet2_end <= wc0
+
+            # the bounded counter carries the same verdicts
+            counts = metrics.registry.get(
+                "notebook_changepoints_total").collect()
+            assert counts.get(("reconcile_errors_delta", "fault"))
+            assert counts.get(("dataplane_stragglers", "slow_worker"))
+            assert counts.get(("promotions_delta", "promotion"))
+
+            # (4) both verdicts reconstruct OFFLINE from the bundle
+            clock.advance(self.SCRAPE_S)
+            stamp(slow=1)
+            bundle = _json.loads(_json.dumps(
+                collect_local(mgr, metrics), default=str))
+            ex = bundle["diagnosis"]["explanations"]
+            for i in range(self.FAULT_A):
+                assert ex[f"user1/fault-a-{i}"]["cause"] == \
+                    CAUSE_FAULT_INJECTION
+            assert ex["user1/slow-b-0"]["cause"] == CAUSE_SLOW_WORKER
+            for i in range(self.REPL_C):
+                assert ex[f"user1/repl-c-{i}"]["cause"] == \
+                    CAUSE_PRIMARY_FAILOVER
+            offline = changepoints_from_bundle(bundle)
+            live = {(f["series"], f["t_start"], f["direction"])
+                    for f in bundle["diagnosis"]["changepoints"]}
+            recon = {(f["series"], f["t_start"], f["direction"])
+                     for f in offline}
+            assert live == recon, (live ^ recon)
+            kinds = {e["kind"] for e in bundle["diagnosis"]["timeline"]}
+            assert {"fault", "slow_worker", "promotion"} <= kinds, kinds
+
+            assert not mgr.dropped_errors
+            assert_no_concurrent_per_key_reconciles(mgr)
+        finally:
+            api.clear_fault_plan()
+            tracing.set_clock(None)
+            mgr.stop()

@@ -1,0 +1,385 @@
+"""Causal diagnosis engine (utils/diagnosis.py): level-latch change-point
+math on synthetic series, the online engine over a real TSDB, the
+per-notebook explainer's deterministic ranking, the /debug/alerts
+annotation contract, the lifecycle excursion ring it reads, and offline
+reconstruction from diagnose bundles.
+
+Everything runs off the FakeClock — the detector consumes injected TSDB
+sample timestamps, never a wall clock, so every boundary here is exact."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.diagnosis import (
+    CAUSE_FAULT_INJECTION,
+    CAUSE_NOMINAL,
+    CAUSE_PRIMARY_FAILOVER,
+    DiagnosisEngine,
+    changepoints_from_bundle,
+    correlate_events,
+    detect_level_shifts,
+    matched_kind,
+    merge_timelines,
+    register_diagnosis_metrics,
+    watched_series,
+)
+from kubeflow_tpu.utils.flightrecorder import FlightRecorder
+from kubeflow_tpu.utils.lifecycle import LifecycleLedger
+from kubeflow_tpu.utils.metrics import Registry
+from kubeflow_tpu.utils.tracing import get_tracer
+from kubeflow_tpu.utils.tsdb import TimeSeriesStore
+
+
+@pytest.fixture()
+def clock():
+    c = FakeClock()
+    tracing.set_clock(c)
+    yield c
+    tracing.set_clock(None)
+
+
+def series(values, t0=0.0, dt=60.0):
+    """[[t, v], ...] with evenly spaced injected timestamps."""
+    return [[t0 + i * dt, float(v)] for i, v in enumerate(values)]
+
+
+class TestLevelShiftMath:
+    """detect_level_shifts on synthetic step/ramp/noise: a step fires
+    exactly once, stationary noise never fires, a ramp fires at least
+    once — the detector's falsifiable contract."""
+
+    def test_step_fires_exactly_once(self):
+        hits = detect_level_shifts(series([1] * 8 + [9] * 12))
+        assert len(hits) == 1
+        assert hits[0]["direction"] == "up"
+        # the firing tail window straddles the transition at t=8*60
+        assert hits[0]["t_start"] <= 8 * 60.0 <= hits[0]["t_end"]
+
+    def test_down_step_fires_down(self):
+        hits = detect_level_shifts(series([9] * 8 + [1] * 12))
+        assert [h["direction"] for h in hits] == ["down"]
+
+    def test_flat_never_fires(self):
+        assert detect_level_shifts(series([4] * 30)) == []
+
+    def test_stationary_noise_never_fires(self):
+        # deterministic bounded noise around level 10: the latched spread
+        # covers the oscillation amplitude
+        noise = [10 + ((i * 7) % 5 - 2) * 0.3 for i in range(40)]
+        assert detect_level_shifts(series(noise)) == []
+
+    def test_ramp_fires_at_least_once(self):
+        hits = detect_level_shifts(series([i * 2.0 for i in range(30)]))
+        assert len(hits) >= 1
+        assert all(h["direction"] == "up" for h in hits)
+
+    def test_step_up_then_down_is_two_findings(self):
+        hits = detect_level_shifts(
+            series([1] * 10 + [9] * 10 + [1] * 10))
+        assert [h["direction"] for h in hits] == ["up", "down"]
+
+    def test_relative_threshold_scales_with_level(self):
+        # 10% shift on a high flat level stays quiet (rel_factor 0.25);
+        # a 4x shift fires
+        assert detect_level_shifts(series([100] * 10 + [110] * 10)) == []
+        hits = detect_level_shifts(series([100] * 10 + [400] * 10))
+        assert len(hits) == 1
+
+    def test_short_series_never_fires(self):
+        # fewer points than window+1: baseline never challenged
+        assert detect_level_shifts(series([1, 9, 1, 9])) == []
+
+    def test_correlation_window_and_kind_priority(self):
+        events = [
+            {"t": 100.0, "kind": "recovery", "detail": "", "object": ""},
+            {"t": 110.0, "kind": "fault", "detail": "", "object": ""},
+            {"t": 500.0, "kind": "promotion", "detail": "", "object": ""},
+        ]
+        matched = correlate_events(events, 120.0, 240.0, lookback_s=120.0)
+        assert {e["kind"] for e in matched} == {"recovery", "fault"}
+        # fault is the most causally-specific kind present
+        assert matched_kind(matched) == "fault"
+        assert matched_kind([]) == "none"
+
+    def test_watched_series_vocabulary(self):
+        assert watched_series("ready_p99_s")
+        assert watched_series("stage_p99.schedule_cold")
+        assert not watched_series("tenant_cs.user1")
+
+
+class TestEngineDetection:
+    """The online engine over a real TimeSeriesStore: incremental
+    consumption, counter labels, event correlation, and equivalence with
+    the offline batch detector."""
+
+    def _engine(self, clock):
+        tsdb = TimeSeriesStore()
+        reg = Registry()
+        eng = DiagnosisEngine(clock, registry=reg, tsdb=tsdb)
+        return eng, tsdb, reg
+
+    def _tick(self, clock, tsdb, eng, value, name="workqueue_depth"):
+        clock.advance(60.0)
+        tsdb.sample(clock.now(), {name: float(value)})
+        return eng.evaluate()
+
+    def test_step_emits_single_finding_and_counter(self, clock):
+        eng, tsdb, reg = self._engine(clock)
+        found = []
+        for v in [0] * 8 + [12] * 10:
+            found.extend(self._tick(clock, tsdb, eng, v))
+        assert len(found) == 1
+        f = found[0]
+        assert f["series"] == "workqueue_depth"
+        assert f["direction"] == "up"
+        assert f["matched"] == "none"
+        counts = reg.get("notebook_changepoints_total").collect()
+        assert counts == {("workqueue_depth", "none"): 1.0}
+        snap = eng.snapshot()
+        assert snap["enabled"] and snap["evaluations"] == 18
+        assert snap["changepoints"] == [f]
+
+    def test_evaluate_without_new_samples_is_idempotent(self, clock):
+        eng, tsdb, reg = self._engine(clock)
+        for v in [0] * 8 + [12] * 10:
+            self._tick(clock, tsdb, eng, v)
+        before = len(eng.findings())
+        for _ in range(5):
+            eng.evaluate()  # no new points: nothing to consume
+        assert len(eng.findings()) == before
+
+    def test_fault_event_correlates_shift(self, clock):
+        eng, tsdb, reg = self._engine(clock)
+        recorder = FlightRecorder()
+        tracer = get_tracer("diag-test")
+        for v in [0] * 8:
+            self._tick(clock, tsdb, eng, v)
+        # a faulted attempt lands just before the shift
+        with tracer.start_span("reconcile", {
+                "controller": "notebook", "namespace": "u1",
+                "name": "nb"}) as root:
+            root.add_event("fault.injected", {"fault.rule": "api-degrade"})
+            root.set_attribute("reconcile.result", "error")
+        eng.observe_attempt(recorder.record(root))
+        found = []
+        for v in [12] * 10:
+            found.extend(self._tick(clock, tsdb, eng, v))
+        assert len(found) == 1
+        assert found[0]["matched"] == "fault"
+        assert any(e["detail"] == "api-degrade" for e in found[0]["events"])
+        counts = reg.get("notebook_changepoints_total").collect()
+        assert counts == {("workqueue_depth", "fault"): 1.0}
+
+    def test_unwatched_series_ignored(self, clock):
+        eng, tsdb, reg = self._engine(clock)
+        for v in [0] * 8 + [50] * 10:
+            self._tick(clock, tsdb, eng, v, name="tenant_cs.user1")
+        assert eng.findings() == []
+
+    def test_incremental_matches_offline_batch(self, clock):
+        eng, tsdb, reg = self._engine(clock)
+        found = []
+        for v in [2] * 8 + [20] * 8 + [2] * 8:
+            found.extend(self._tick(clock, tsdb, eng, v))
+        raw = tsdb.query("workqueue_depth", tier="raw")["points"]
+        offline = detect_level_shifts(raw)
+        assert [(h["t_start"], h["direction"]) for h in offline] == \
+            [(h["t_start"], h["direction"]) for h in found]
+
+
+class _Harness:
+    """Feeds recorder + ledger the way the Manager does (one finished
+    root span per attempt), with the diagnosis engine attached."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.tracer = get_tracer("diag-explain-test")
+        self.recorder = FlightRecorder()
+        self.ledger = LifecycleLedger()
+        self.engine = DiagnosisEngine(clock, recorder=self.recorder,
+                                      lifecycle=self.ledger)
+
+    def attempt(self, *, ns="u1", name="nb", gen=1, cause_ts=None,
+                result="success", body=None):
+        attrs = {"controller": "notebook", "namespace": ns, "name": name,
+                 "generation": gen}
+        if cause_ts is not None:
+            attrs["cause_ts"] = cause_ts
+        with self.tracer.start_span("reconcile", attrs) as root:
+            if body is not None:
+                body(root)
+            root.set_attribute("reconcile.result", result)
+        rec = self.recorder.record(root)
+        self.ledger.observe_attempt(rec, root, "")
+        self.engine.observe_attempt(rec)
+        return rec
+
+    def phase(self, phase, seconds):
+        with self.tracer.start_span(phase, {"phase": phase}):
+            self.clock.advance(seconds)
+
+    def ready(self, *, ns="u1", name="nb", gen=1, cold_s=5.0):
+        cause = self.clock.now()
+        self.clock.advance(1.0)
+        return self.attempt(
+            ns=ns, name=name, gen=gen, cause_ts=cause,
+            body=lambda root: (self.phase("schedule", cold_s),
+                               root.add_event("notebook.ready", {})))
+
+
+class TestExplainer:
+    def test_fault_injection_outranks_stage_share(self, clock):
+        h = _Harness(clock)
+        h.ready(cold_s=30.0)
+
+        def faulted(root):
+            root.add_event("fault.injected", {"fault.rule": "api-window"})
+            h.phase("apply", 0.5)
+
+        h.attempt(result="error", body=faulted)
+        out = h.engine.explain("u1", "nb")
+        assert out["cause"] == CAUSE_FAULT_INJECTION
+        causes = [c["cause"] for c in out["candidates"]]
+        # direct evidence outranks every stage-share inference
+        assert causes[0] == CAUSE_FAULT_INJECTION
+        assert causes[-1] == CAUSE_NOMINAL
+        scores = [c["score"] for c in out["candidates"]]
+        assert scores == sorted(scores, reverse=True)
+        assert "fault plan" in out["verdict"]
+        assert all(link["claim"] for link in out["chain"])
+
+    def test_ranking_is_deterministic(self, clock):
+        h = _Harness(clock)
+        h.ready(cold_s=30.0)
+        h.attempt(result="error", body=lambda root: root.add_event(
+            "fault.injected", {"fault.rule": "api-window"}))
+        first = h.engine.explain("u1", "nb")
+        second = h.engine.explain("u1", "nb")
+        assert first == second
+
+    def test_promote_excursion_names_primary_failover(self, clock):
+        h = _Harness(clock)
+        h.ready()
+        h.attempt(body=lambda root: h.phase("promote", 2.0))
+        out = h.engine.explain("u1", "nb")
+        assert out["cause"] == CAUSE_PRIMARY_FAILOVER
+        ex = out["evidence"]["excursions"]
+        assert ex and ex[-1]["stage"] == "promote"
+        assert ex[-1]["duration_s"] == pytest.approx(2.0)
+
+    def test_unknown_object_is_verdictless_not_an_error(self, clock):
+        h = _Harness(clock)
+        out = h.engine.explain("ghost", "nb")
+        assert out["verdict"] == "" and out["cause"] == ""
+        assert out["error"]
+
+    def test_nominal_floor_when_healthy(self, clock):
+        h = _Harness(clock)
+        # all wall time in apply (not a candidate stage): no queue wait,
+        # no cold schedule, no faults — nothing beats the nominal floor
+        h.attempt(cause_ts=clock.now(),
+                  body=lambda root: (h.phase("apply", 5.0),
+                                     root.add_event("notebook.ready", {})))
+        out = h.engine.explain("u1", "nb")
+        assert out["cause"] == CAUSE_NOMINAL
+        assert out["verdict"]
+
+    def test_one_line_cause_and_alert_annotation(self, clock):
+        h = _Harness(clock)
+        h.ready()
+        rec = h.attempt(result="error", body=lambda root: root.add_event(
+            "fault.injected", {"fault.rule": "api-window"}))
+        line = h.engine.one_line_cause(rec.trace_id)
+        assert "fault plan" in line
+        snap = h.engine.annotate_alerts(
+            {"firing": [{"objective": "reconcile_errors",
+                         "trace_id": rec.trace_id}]})
+        assert snap["firing"][0]["diagnosis"] == line
+        # unknown trace and malformed entries degrade to "" — never raise
+        assert h.engine.one_line_cause("no-such-trace") == ""
+        snap = h.engine.annotate_alerts({"firing": [{}]})
+        assert snap["firing"][0]["diagnosis"] == ""
+
+    def test_register_twice_returns_same_family(self):
+        reg = Registry()
+        a = register_diagnosis_metrics(reg)["changepoints"]
+        b = register_diagnosis_metrics(reg)["changepoints"]
+        assert a is b
+
+
+class TestExcursionRing:
+    def test_ring_records_stage_duration_trace(self, clock):
+        h = _Harness(clock)
+        h.ready()
+        rec = h.attempt(body=lambda root: h.phase("recover", 2.5))
+        ring = h.ledger.excursions("u1", "nb")
+        assert len(ring) == 1
+        x = ring[0]
+        assert x["stage"] == "recover"
+        assert x["duration_s"] == pytest.approx(2.5)
+        assert x["trace_id"] == rec.trace_id
+        assert h.ledger.snapshot()["excursion_objects"] == 1
+
+    def test_ring_is_bounded(self, clock):
+        h = _Harness(clock)
+        h.ledger.excursions_per_notebook = 4
+        h.ready()
+        for _ in range(10):
+            h.attempt(body=lambda root: h.phase("recover", 1.0))
+        assert len(h.ledger.excursions("u1", "nb")) == 4
+
+    def test_latest_entry_tracks_newest_generation(self, clock):
+        h = _Harness(clock)
+        h.ready(gen=1)
+        h.ready(gen=2, cold_s=9.0)
+        entry = h.ledger.latest_entry("u1", "nb")
+        assert entry is not None and entry["generation"] == 2
+        assert h.ledger.latest_entry("u1", "ghost") is None
+
+    def test_clear_resets_ring(self, clock):
+        h = _Harness(clock)
+        h.ready()
+        h.attempt(body=lambda root: h.phase("recover", 1.0))
+        h.ledger.clear()
+        assert h.ledger.excursions("u1", "nb") == []
+
+
+class TestOfflineBundles:
+    def _bundle(self, source, values, t0=0.0):
+        return {
+            "source": source,
+            "timeline": {"series": {
+                "workqueue_depth": {"raw": series(values, t0=t0)}}},
+            "diagnosis": {"timeline": [
+                {"t": t0 + 7 * 60.0, "kind": "fault",
+                 "detail": "api-window", "object": "u1/nb"}]},
+        }
+
+    def test_changepoints_from_bundle_correlates(self):
+        bundle = self._bundle("m-0", [0] * 8 + [12] * 10)
+        # survives a JSON round trip (the ops/diagnose artifact path)
+        bundle = json.loads(json.dumps(bundle))
+        hits = changepoints_from_bundle(bundle)
+        assert len(hits) == 1
+        assert hits[0]["series"] == "workqueue_depth"
+        assert hits[0]["matched"] == "fault"
+
+    def test_merge_timelines_sorts_and_tags(self):
+        merged = merge_timelines([
+            self._bundle("m-0", [1, 2, 3], t0=0.0),
+            self._bundle("m-1", [4, 5, 6], t0=30.0),
+        ])
+        assert merged["sources"] == ["m-0", "m-1"]
+        pts = merged["series"]["workqueue_depth"]
+        assert [p["t"] for p in pts] == sorted(p["t"] for p in pts)
+        assert {p["source"] for p in pts} == {"m-0", "m-1"}
+        assert merged["points_total"] == 6
+
+    def test_merge_handles_missing_series(self):
+        merged = merge_timelines([{"source": "empty"}])
+        assert merged["series"] == {} and merged["points_total"] == 0
