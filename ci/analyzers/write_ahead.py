@@ -64,6 +64,13 @@ FLOWS: tuple[Flow, ...] = (
     Flow("kubeflow_tpu/kube/shard.py", "ShardedReplica.join_fleet",
          destructive=("self._drain_and_adopt",),
          persist=("self.member.join",)),
+    # preemption: the write-ahead eviction record lands on TenantQuota
+    # status before any victim teardown — a crash between them would
+    # leave half-evicted gangs no successor knows to finish (or worse,
+    # re-evict)
+    Flow("kubeflow_tpu/core/preemption.py", "PreemptionEngine.preempt",
+         destructive=("self._teardown_victim",),
+         persist=("self._commit_record",)),
 )
 
 

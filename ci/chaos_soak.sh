@@ -28,7 +28,13 @@
 # promote the warm follower with zero kernel-state loss and exactly one
 # epoch bump, fence the demoted zombie's writes, and keep the promotion
 # p99 at least 5x below the snapshot->restore baseline and under the
-# ci/fleet_budget.json "failover" ceiling).  All driven on the
+# ci/fleet_budget.json "failover" ceiling), plus the preemption
+# lane (TestPreemptionSoak: seeded manager kills at every point of
+# the checkpoint-then-preempt write-ahead protocol — the successor
+# must resume, never repeat, the eviction: exactly one whole-slice
+# StatefulSet delete per victim across both managers, zero pod-level
+# client deletes, every record folding terminal exactly once, and
+# the victims' secured checkpoints intact).  All driven on the
 # FakeClock so wall time stays in seconds regardless of how much backoff
 # the injected faults provoke.
 #
@@ -44,6 +50,7 @@ ROUNDS="${CHAOS_SOAK_ROUNDS:-25}"
 SHARD_ROUNDS="${SHARD_SOAK_ROUNDS:-10}"
 HEAL_ROUNDS="${SELFHEAL_SOAK_ROUNDS:-16}"
 MIGRATE_ROUNDS="${MIGRATE_SOAK_ROUNDS:-12}"
+PREEMPT_ROUNDS="${PREEMPT_SOAK_ROUNDS:-6}"
 FAILOVER_ROUNDS="${FAILOVER_SOAK_ROUNDS:-50}"
 SEED="${CHAOS_SOAK_SEED:-20260804}"
 # the CI soak runs the manager with a parallel worker pool: the invariants
@@ -61,25 +68,28 @@ if [[ "$SEED" == "random" ]]; then
   SEED=$((RANDOM * 32768 + RANDOM))
 fi
 
-echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} shard_rounds=${SHARD_ROUNDS} failover_rounds=${FAILOVER_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
+echo "== chaos soak: seed=${SEED} rounds=${ROUNDS} selfheal_rounds=${HEAL_ROUNDS} migrate_rounds=${MIGRATE_ROUNDS} preempt_rounds=${PREEMPT_ROUNDS} shard_rounds=${SHARD_ROUNDS} failover_rounds=${FAILOVER_ROUNDS} workers=${WORKERS} strict=${STRICT} =="
 if ! CHAOS_SOAK_SEED="$SEED" CHAOS_SOAK_ROUNDS="$ROUNDS" \
     SELFHEAL_SOAK_ROUNDS="$HEAL_ROUNDS" MIGRATE_SOAK_ROUNDS="$MIGRATE_ROUNDS" \
     SHARD_SOAK_ROUNDS="$SHARD_ROUNDS" FAILOVER_SOAK_ROUNDS="$FAILOVER_ROUNDS" \
+    PREEMPT_SOAK_ROUNDS="$PREEMPT_ROUNDS" \
     WORKQUEUE_WORKERS="$WORKERS" INVARIANTS_STRICT="$STRICT" \
     python -m pytest tests/test_chaos.py::TestChaosSoak \
       tests/test_chaos.py::TestSliceRecoverySoak \
       tests/test_chaos.py::TestMigrationRecoverySoak \
+      tests/test_chaos.py::TestPreemptionSoak \
       tests/test_chaos.py::TestFleetSLOSoak \
       tests/test_chaos.py::TestShardKillRejoinSoak \
       tests/test_chaos.py::TestFailoverSoak -q "$@"; then
   echo "chaos soak FAILED — reproduce with:" >&2
   echo "  CHAOS_SOAK_SEED=${SEED} CHAOS_SOAK_ROUNDS=${ROUNDS} \\" >&2
   echo "    SELFHEAL_SOAK_ROUNDS=${HEAL_ROUNDS} MIGRATE_SOAK_ROUNDS=${MIGRATE_ROUNDS} \\" >&2
+  echo "    PREEMPT_SOAK_ROUNDS=${PREEMPT_ROUNDS} \\" >&2
   echo "    SHARD_SOAK_ROUNDS=${SHARD_ROUNDS} FAILOVER_SOAK_ROUNDS=${FAILOVER_ROUNDS} \\" >&2
   echo "    WORKQUEUE_WORKERS=${WORKERS} ci/chaos_soak.sh" >&2
   exit 1
 fi
-echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, shard_rounds=${SHARD_ROUNDS}, failover_rounds=${FAILOVER_ROUNDS}, workers=${WORKERS})"
+echo "chaos soak OK (seed=${SEED}, rounds=${ROUNDS}, selfheal_rounds=${HEAL_ROUNDS}, migrate_rounds=${MIGRATE_ROUNDS}, preempt_rounds=${PREEMPT_ROUNDS}, shard_rounds=${SHARD_ROUNDS}, failover_rounds=${FAILOVER_ROUNDS}, workers=${WORKERS})"
 
 # INTERLEAVE_DEEP=1: re-run the schedule-exploring protocol tests
 # (tests/test_interleave.py) with a much larger enumeration budget than

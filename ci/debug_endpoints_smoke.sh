@@ -21,7 +21,8 @@
 #     query, and the full ?dump=1 capture,
 #   - /debug/tenants serves the tenant metering ledger's usage table with
 #     the demo namespace attributed and its chip-second conservation
-#     check clean,
+#     check clean, plus the tenancy (priority/quota/preemption)
+#     admission-gate snapshot mirrored on /debug/fleet,
 #   - `python -m kubeflow_tpu.ops.diagnose` captures a bundle over the
 #     same surface from which the slowest attempt resolves offline.
 # Wired into ci/run_tests.sh (controlplane lane).
@@ -205,6 +206,25 @@ assert tn["fairness"]["evaluations"] > 0, tn["fairness"]
 assert tn["fairness"]["flagged"] == [], tn["fairness"]
 assert set(tn["buckets"]) == {"ready", "scheduling", "recovering",
                               "idle"}, tn["buckets"]
+
+# tenancy (priority/quota/preemption) view: /debug/tenants embeds the
+# admission-gate snapshot, /debug/fleet carries the same section, and
+# the queue-wait family is registered even with nothing ever queued
+assert "tenancy" in tn, sorted(tn)
+tenancy = tn["tenancy"]
+for k in ("queued", "usage_chips", "quota", "pending_preemptions",
+          "recent_preemptions"):
+    assert k in tenancy, (k, sorted(tenancy))
+assert tenancy["queued"] == {}, tenancy["queued"]       # healthy demo
+assert tenancy["pending_preemptions"] == 0, tenancy
+_, _, body = get("/debug/fleet")
+fleet = json.loads(body)
+assert fleet["tenancy"]["pending_preemptions"] == 0, fleet.get("tenancy")
+_, _, body = get("/metrics")
+assert "# TYPE notebook_queue_wait_seconds histogram" in body, \
+    "queue-wait family missing from scrape"
+assert "# TYPE notebook_preemptions_total counter" in body, \
+    "preemptions family missing from scrape"
 
 # the tenant families surface on /metrics, and /debug/fleet embeds the
 # same snapshot under its "tenants" key

@@ -129,6 +129,15 @@ if [[ -z "$LANE" || "$LANE" == "controlplane" ]]; then
   echo "== loadtest tenant fairness smoke =="
   python loadtest/convergence.py --tenants 4 --per-tenant 3 --noisy 1 \
     --check-budget ci/fleet_budget.json
+  # tenancy adversarial smoke: a low-priority flood oversubscribes the
+  # fleet past its chip quota, then a high-priority burst must land via
+  # checkpoint-then-preempt — flood contained at sliceHealth=Queued,
+  # benign tenants untouched, zero checkpointless teardowns, zero
+  # preempted-state loss, and the burst's p99 time-to-placement under
+  # the ci/fleet_budget.json "tenancy" ceiling
+  echo "== loadtest tenancy priorities smoke =="
+  python loadtest/convergence.py --priorities 2 --benign 2 \
+    --per-tenant 2 --flood 6 --check-budget ci/fleet_budget.json
   echo "== loadtest sharded fleet sweep (3 shards) =="
   python loadtest/convergence.py --sweep 200,600 --shards 3 \
     --check-budget ci/fleet_budget.json \

@@ -34,6 +34,15 @@ STORAGE_VERSION = "v1"
 HUB_VERSION = "v1beta1"
 VERSIONS = ("v1alpha1", "v1beta1", "v1")
 
+# Priority classes (spec.priority): the tenancy layer's admission and
+# preemption ordering (core/scheduler.py, core/preemption.py).  Rank gaps
+# leave room for future classes without renumbering.  A notebook without
+# spec.priority inherits its tenant's default from the TenantQuota object
+# (or PRIORITY_DEFAULT when no quota is configured).
+PRIORITY_RANK = {"low": 0, "standard": 100, "high": 200}
+PRIORITY_CLASSES = tuple(sorted(PRIORITY_RANK, key=PRIORITY_RANK.get))
+PRIORITY_DEFAULT = "standard"
+
 # Condition types mirror pod conditions (reference PodCondToNotebookCond,
 # notebook_controller.go:376-414)
 CONDITION_RUNNING = "Running"
@@ -185,10 +194,20 @@ class Notebook:
     def status(self) -> dict:
         return self.obj.status
 
+    @property
+    def priority(self) -> Optional[str]:
+        """Explicit priority class, or None to defer to the tenant default."""
+        p = self.obj.spec.get("priority")
+        return str(p) if p is not None else None
+
     def validate(self) -> None:
         containers = self.pod_spec.get("containers") or []
         if not containers:
             raise InvalidError("spec.template.spec.containers must be non-empty")
+        if self.priority is not None and self.priority not in PRIORITY_RANK:
+            raise InvalidError(
+                f"spec.priority must be one of {sorted(PRIORITY_RANK)}, "
+                f"got {self.priority!r}")
         if self.tpu is not None:
             self.tpu.validate()
         if self.replication is not None:

@@ -86,6 +86,26 @@ GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 TPU_RESOURCE = "google.com/tpu"
 
+# tenancy (core/scheduler.py admission gate, core/preemption.py): stamped
+# while a gang is held back by quota / fair share.  Value is JSON
+# {"since": <clock>, "priority": <class>, "reason": "quota"|"fair-share"|
+# "ordered"|"preempted"} — `since` feeds the aged fair-share dequeue score
+# so queue order is deterministic and starvation-free.  Contains
+# "notebooks.kubeflow.org" so _propagated_annotations never copies it onto
+# pods.
+ANNOTATION_QUEUED = "notebooks.kubeflow.org/queued"
+
+# cluster-scoped tenancy policy + write-ahead preemption bookkeeping
+# object: spec holds per-namespace chip quota / fair-share weight /
+# default priority, status.preemptions holds in-flight preemption records
+# (written BEFORE any teardown, same optimistic-concurrency RMW pattern
+# as TPUWarmPool) so a manager crash or shard failover resumes — never
+# repeats — an eviction.  Singleton named TENANTQUOTA_NAME.
+TENANTQUOTA_KIND = "TenantQuota"
+TENANTQUOTA_NAME = "default"
+PREEMPTION_PENDING = "Pending"
+PREEMPTION_DONE = "Done"
+
 # warm-pool bookkeeping object (core/scheduler.py): one cluster-scoped
 # TPUWarmPool per accelerator/topology shape; claim/release state lives in
 # its status so it survives manager crash and leader failover

@@ -23,6 +23,7 @@ from . import constants as C
 from . import culler
 from .jupyter import JupyterAPI
 from .metrics import NotebookMetrics
+from .preemption import pending_preemption
 
 logger = logging.getLogger("kubeflow_tpu.culling")
 
@@ -116,6 +117,14 @@ class CullingReconciler:
                 else:
                     if self._should_wait_for_checkpoint(nb, meta):
                         span.add_event("culling.checkpoint_wait")
+                        return
+                    if pending_preemption(self.api, req.namespace, req.name):
+                        # a write-ahead preemption record owns this
+                        # notebook's teardown and claim release; a stop
+                        # annotation landing mid-eviction would race the
+                        # engine for the pool claims.  Hold the cull —
+                        # the requeue re-checks after the record closes.
+                        span.add_event("culling.preemption_wait")
                         return
                     logger.info("culling notebook %s/%s", req.namespace, req.name)
                     span.add_event("notebook.culled")

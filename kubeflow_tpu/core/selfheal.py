@@ -327,6 +327,16 @@ class RecoveryEngine:
                 self._write_bookkeeping(nb, {})
             return 0.0
 
+        # Preemption precedence (cull > preempt > migrate > restart): a
+        # queued gang — including a preemption victim mid-teardown or
+        # already re-queued — holds no placed capacity; "recovering" it
+        # would fight the preemption engine pod-for-pod exactly the way
+        # the cull guard above prevents for culls.  Its sessionState
+        # (the checkpoint-then-preempt restore intent) survives untouched
+        # and rides the ordinary restore path once the gang re-places.
+        if C.ANNOTATION_QUEUED in live.metadata.annotations:
+            return 0.0
+
         # voluntary migration request: drain/defrag annotation on the CR
         ann_raw = live.metadata.annotations.get(
             C.ANNOTATION_MIGRATE, "").strip().lower()

@@ -294,6 +294,11 @@ class HealthAndMetricsHandler(http.server.BaseHTTPRequestHandler):
                 "enabled": False,
                 "error": "no tenant metering ledger attached to this "
                          "manager"}
+            if self.metrics is not None:
+                # per-tenant admission-gate view (queue depth, quota
+                # usage, recent preemptions) — same source as the
+                # tenancy section of /debug/fleet
+                body["tenancy"] = self.metrics.tenancy_snapshot()
             self._respond(200, json.dumps(body, default=str),
                           "application/json")
         elif path == "/debug/timeline":

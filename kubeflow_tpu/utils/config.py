@@ -151,6 +151,20 @@ class CoreConfig:
     warmpool_max_size: int = 64               # WARMPOOL_MAX_SIZE
     warmpool_target_hit_rate: float = 0.9     # WARMPOOL_TARGET_HIT_RATE
     warmpool_decay_s: float = 600.0           # WARMPOOL_DECAY_S
+    # tenancy layer (core/scheduler.py admission gate + core/preemption.py
+    # checkpoint-then-preempt).  A gang over its tenant's chip quota or
+    # weighted fair share queues (sliceHealth="Queued") and is re-examined
+    # every queue_requeue_s; dequeue order is the aged weighted fair-share
+    # score priority_rank + weight * age / queue_aging_s, so every queued
+    # gang's score grows without bound and starvation is impossible (a
+    # "low" gang overtakes an idle "high" slot after
+    # (200 - 0) / weight * queue_aging_s seconds).  enable_preemption
+    # gates checkpoint-then-preempt; slo_placement_p99_s bounds the
+    # queue-wait (time-to-placement) latency objective (<= 0 disables it).
+    enable_preemption: bool = True            # ENABLE_PREEMPTION
+    queue_requeue_s: float = 15.0             # QUEUE_REQUEUE_S
+    queue_aging_s: float = 60.0               # QUEUE_AGING_S
+    slo_placement_p99_s: float = 0.0          # SLO_PLACEMENT_P99_S
     # fleet SLO engine (utils/slo.py): declared objectives over the
     # existing metric streams, evaluated into multi-window burn rates at
     # every scrape.  Latency knobs are p99 ceilings (at most 1% of events
@@ -289,6 +303,10 @@ class CoreConfig:
             warmpool_target_hit_rate=_float(
                 env, "WARMPOOL_TARGET_HIT_RATE", 0.9),
             warmpool_decay_s=_float(env, "WARMPOOL_DECAY_S", 600.0),
+            enable_preemption=_bool(env, "ENABLE_PREEMPTION", True),
+            queue_requeue_s=_float(env, "QUEUE_REQUEUE_S", 15.0),
+            queue_aging_s=_float(env, "QUEUE_AGING_S", 60.0),
+            slo_placement_p99_s=_float(env, "SLO_PLACEMENT_P99_S", 0.0),
             slo_time_to_ready_p99_s=_float(
                 env, "SLO_TIME_TO_READY_P99_S", 600.0),
             slo_event_to_reconcile_p99_s=_float(

@@ -55,6 +55,10 @@ from .metrics import Registry
 # discipline).  `schedule_wait` is an internal placeholder resolved to
 # warm/cold at finalize; it never leaves the ledger.
 STAGE_QUEUE_WAIT = "queue_wait"
+# time parked behind the tenancy admission gate (quota / fair share /
+# preemption fence, core/scheduler.py) — distinct from queue_wait (the
+# workqueue) and schedule_* (capacity): the gang was not even in line
+STAGE_QUOTA_WAIT = "quota_wait"
 STAGE_HANDOFF_WAIT = "handoff_wait"
 STAGE_SCHEDULE_WARM = "schedule_warm"
 STAGE_SCHEDULE_COLD = "schedule_cold"
@@ -73,7 +77,8 @@ STAGE_OTHER = "reconcile_other"
 _SCHEDULE_WAIT = "_schedule_wait"  # placeholder, resolved warm/cold
 
 STAGES = (
-    STAGE_QUEUE_WAIT, STAGE_HANDOFF_WAIT, STAGE_SCHEDULE_WARM,
+    STAGE_QUEUE_WAIT, STAGE_QUOTA_WAIT, STAGE_HANDOFF_WAIT,
+    STAGE_SCHEDULE_WARM,
     STAGE_SCHEDULE_COLD, STAGE_RENDER, STAGE_APPLY, STAGE_STATUS,
     STAGE_POD_SCHEDULE, STAGE_POD_START, STAGE_RETRY_BACKOFF,
     STAGE_RECOVERY_WAIT, STAGE_RECOVER, STAGE_MIGRATE, STAGE_PROMOTE,
@@ -251,6 +256,7 @@ class LifecycleLedger:
                      trace_id=rec.trace_id)
         waiting_on = ""
         saw_backoff_wait = False
+        saw_queued = False
         for span in _walk_spans(root_span):
             stage = _PHASE_STAGES.get(str(span.attributes.get("phase", "")))
             if stage is not None and span is not root_span:
@@ -262,13 +268,20 @@ class LifecycleLedger:
                     waiting_on = str(ev.attributes.get("on", ""))
                 elif ev.name == "schedule.wait":
                     a.saw_cold = True
+                elif ev.name == "schedule.queued":
+                    saw_queued = True
                 elif ev.name == "schedule.placed":
                     waiting_on = "placed"
                 elif ev.name == "recovery.backoff_wait":
                     saw_backoff_wait = True
         a.segments.sort(key=lambda s: (s[0], s[1]))
         result = rec.result
-        if result in ("error", "requeue"):
+        if saw_queued or waiting_on == "quota_wait":
+            # the admission gate parked the gang this attempt: the idle
+            # gap that follows is quota_wait, regardless of the requeue
+            # the gate returns to re-examine the line
+            a.next_hint = STAGE_QUOTA_WAIT
+        elif result in ("error", "requeue"):
             a.next_hint = STAGE_RETRY_BACKOFF
         elif saw_backoff_wait:
             a.next_hint = STAGE_RECOVERY_WAIT

@@ -181,6 +181,18 @@ def default_objectives(cfg) -> tuple[Objective, ...]:
             threshold_s=cfg.slo_promotion_p99_s,
             description="p99 primary disruption -> follower promoted "
                         "(replicated notebooks)"))
+    # time-to-placement objective (core/scheduler.py tenancy admission):
+    # notebook_queue_wait_seconds observes EVERY placement (0 for gangs
+    # that never queued), so its p99 under the ceiling is exactly "a
+    # gang's wait behind quota/fair share/preemption stays bounded" —
+    # the starvation alarm for the priority/queue machinery
+    if getattr(cfg, "slo_placement_p99_s", 0.0) > 0:
+        out.append(Objective(
+            name="time_to_placement", kind=KIND_LATENCY,
+            metric="notebook_queue_wait_seconds",
+            threshold_s=cfg.slo_placement_p99_s,
+            description="p99 quota/fair-share queue wait before the "
+                        "placement intent lands"))
     if cfg.enable_slice_scheduler and cfg.slo_warmpool_hit_rate > 0:
         out.append(Objective(
             name="warmpool_hit_rate", kind=KIND_RATIO,

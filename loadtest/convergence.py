@@ -1080,6 +1080,296 @@ def _print_tenants(table: dict, noisy_ns: str) -> None:
               f"{str(row['flagged']):>8}{mark}", file=sys.stderr)
 
 
+def run_priorities(high_gangs: int, benign: int, per_tenant: int,
+                   flood: int, tpu: str,
+                   provision_s: float = 3600.0) -> dict:
+    """Adversarial tenancy run (ISSUE-19): a low-priority batch tenant
+    floods an oversubscribed fleet past its chip quota, then a
+    high-priority burst arrives with zero free capacity.  The flood must
+    queue (never place, never hold claims), the burst must land within
+    the time-to-placement ceiling by evicting ONLY checkpointed
+    low-priority victims — benign standard tenants untouched, zero
+    checkpointless teardowns — and once the burst drains every victim
+    must restore its session byte-for-byte (digest) from the secured
+    checkpoint: preemption moves work, it never loses state."""
+    clock = FakeClock()
+    tracing.set_clock(clock)
+    try:
+        return _run_priorities(high_gangs, benign, per_tenant, flood,
+                               tpu, provision_s, clock)
+    finally:
+        tracing.set_clock(None)
+
+
+def _run_priorities(high_gangs: int, benign: int, per_tenant: int,
+                    flood: int, tpu: str, provision_s: float,
+                    clock: FakeClock) -> dict:
+    from kubeflow_tpu.core import constants as CC
+    from kubeflow_tpu.core.preemption import new_quota_object
+    from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+
+    if high_gangs < 1 or benign < 1 or flood < 1:
+        raise ValueError("--priorities needs >=1 high gang, >=1 benign "
+                         "tenant and >=1 flood gang")
+    accel, topology = tpu.split(":")
+    spec = TPUSpec(accel, topology)
+    shape = spec.validate()
+    # capacity fits the benign tenants plus exactly high_gangs
+    # low-priority victims-in-waiting: the burst can ONLY land by
+    # evicting; cold provisioning (1h) never bails it out in-run
+    capacity_slices = benign * per_tenant + high_gangs
+    env = {
+        "ENABLE_SLICE_SCHEDULER": "true",
+        "WARMPOOL_SIZE": "0",
+        "WARMPOOL_PROVISION_S": f"{provision_s:g}",
+        "SLO_PLACEMENT_P99_S": "120",
+    }
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    cluster.add_tpu_slice_nodes(
+        shape.accelerator.gke_label, topology,
+        capacity_slices * shape.num_hosts, shape.chips_per_host)
+    mgr = Manager(api, clock=clock,
+                  flight_recorder=FlightRecorder(capacity=8192,
+                                                 max_objects=2048))
+    cfg = CoreConfig.from_env(env)
+    metrics = NotebookMetrics(api, manager=mgr)
+    store = InMemorySessionStore(clock=clock)
+    cluster.attach_session_store(store)
+    setup_core_controllers(mgr, cfg, metrics, session=store,
+                           provisioner=cluster)
+    slo_engine = SLOEngine(
+        default_objectives(cfg),
+        registries=[metrics.registry, mgr.metrics_registry],
+        clock=clock)
+    mgr.slo_engine = slo_engine
+    metrics.attach_slo(slo_engine)
+
+    # hard chip quota pins the batch tenant to its placed share: the
+    # flood queues on quota, not on a capacity accident
+    quota = new_quota_object()
+    quota.body["spec"] = {
+        "tenants": {"batch": {
+            "chipQuota": float(high_gangs * shape.chips * spec.slices),
+            "priority": "low"}},
+        "defaults": {},
+    }
+    api.create(quota)
+
+    def drive_until(cond, deadline_s: float, what: str) -> None:
+        deadline = clock.now() + deadline_s
+        while True:
+            mgr.run_until_idle()
+            if cond():
+                return
+            due = [d for (_, _, d) in mgr.pending_delayed()]
+            if not due or min(due) > deadline:
+                raise AssertionError(f"{what}: not reached within "
+                                     f"{deadline_s:g}s modeled seconds")
+            delta = min(due) - clock.now()
+            if delta > 0:
+                clock.advance(delta)
+
+    def healthy(ns: str, name: str) -> bool:
+        st = api.get("Notebook", ns, name).body.get("status") or {}
+        return st.get("sliceHealth") == "Healthy"
+
+    # phase 1 — fill: benign standard tenants + the batch tenant's
+    # placed (victim-eligible) gangs converge on the whole capacity
+    benign_nbs = [(f"team-{i}", f"team-{i}-nb-{j:02d}")
+                  for i in range(benign) for j in range(per_tenant)]
+    batch_placed = [f"bat-{i:03d}" for i in range(high_gangs)]
+    for ns, name in benign_nbs:
+        api.create(Notebook.new(name, ns, tpu=spec).obj)
+    for name in batch_placed:
+        nb = Notebook.new(name, "batch", tpu=spec)
+        nb.obj.spec["priority"] = "low"
+        api.create(nb.obj)
+    drive_until(
+        lambda: all(healthy(ns, n) for ns, n in benign_nbs)
+        and all(healthy("batch", n) for n in batch_placed),
+        provision_s * 4 + 600, "fill phase")
+    digests = {}
+    for name in batch_placed:
+        cluster.set_session_payload("batch", name,
+                                    b"kernel-" + name.encode())
+        (snap,) = cluster.snapshot_sessions("batch", name)
+        digests[name] = snap.digest
+
+    # phase 2 — oversubscribe: the flood must queue behind the quota
+    # with sliceHealth Queued and zero claims
+    flood_names = [f"flood-{i:03d}" for i in range(flood)]
+    for name in flood_names:
+        nb = Notebook.new(name, "batch", tpu=spec)
+        nb.obj.spec["priority"] = "low"
+        api.create(nb.obj)
+    for _ in range(3):
+        mgr.run_until_idle()
+        clock.advance(20.0)
+    mgr.run_until_idle()
+    for name in flood_names:
+        obj = api.get("Notebook", "batch", name)
+        st = obj.body.get("status") or {}
+        if CC.ANNOTATION_PLACEMENT in obj.metadata.annotations or \
+                st.get("sliceHealth") != "Queued":
+            raise AssertionError(
+                f"flood gang batch/{name} broke containment: "
+                f"placement={CC.ANNOTATION_PLACEMENT in obj.metadata.annotations} "
+                f"sliceHealth={st.get('sliceHealth')!r}")
+    tenancy = metrics.tenancy_snapshot()
+    queued_depth_peak = sum(
+        e.get("depth", 0) for e in (tenancy.get("queued") or {}).values())
+
+    # phase 3 — the high-priority burst: placement only via
+    # checkpoint-then-preempt of the batch victims
+    high_names = [f"hp-{i:02d}" for i in range(high_gangs)]
+    t_burst = clock.now()
+    for name in high_names:
+        nb = Notebook.new(name, "urgent", tpu=spec)
+        nb.obj.spec["priority"] = "high"
+        api.create(nb.obj)
+    placed_at: dict[str, float] = {}
+
+    def burst_done() -> bool:
+        for name in high_names:
+            if name not in placed_at and healthy("urgent", name):
+                placed_at[name] = clock.now()
+        return len(placed_at) == len(high_names)
+
+    drive_until(burst_done, 600.0, "high-priority burst placement")
+    waits = sorted(placed_at[n] - t_burst for n in high_names)
+    high_p99 = _percentile(waits, 0.99)
+
+    evicted = [
+        n for n in batch_placed
+        if CC.ANNOTATION_PLACEMENT not in
+        api.get("Notebook", "batch", n).metadata.annotations]
+    benign_evictions = sum(
+        1 for ns, n in benign_nbs if not healthy(ns, n))
+    batch_sts_deletes = {
+        n: len([r for r in api.audit_log(verb="delete",
+                                         kind="StatefulSet")
+                if r.ok and r.name == n])
+        for n in batch_placed}
+    checkpointless = 0
+    for name, count in batch_sts_deletes.items():
+        if count == 0:
+            continue
+        sess = (api.get("Notebook", "batch", name)
+                .body.get("status") or {}).get("sessionState") or {}
+        entry = sess.get("0") or {}
+        if entry.get("trigger") != "preempt" or \
+                entry.get("digest") != digests[name]:
+            checkpointless += 1
+    if any(count > 1 for count in batch_sts_deletes.values()):
+        raise AssertionError(
+            f"victim torn down more than once: {batch_sts_deletes}")
+
+    # phase 4 — drain and restore: the flood withdraws, the burst
+    # finishes; every evicted victim must restore its checkpoint
+    for name in flood_names:
+        api.delete("Notebook", "batch", name)
+    for name in high_names:
+        live = api.get("Notebook", "urgent", name)
+        live.metadata.annotations[CC.STOP_ANNOTATION] = "true"
+        api.update(live)
+    restored_at: dict[str, float] = {}
+
+    def victims_back() -> bool:
+        for name in evicted:
+            if name in restored_at:
+                continue
+            sess = (api.get("Notebook", "batch", name)
+                    .body.get("status") or {}).get("sessionState") or {}
+            if healthy("batch", name) and \
+                    (sess.get("0") or {}).get("phase") == "restored":
+                restored_at[name] = clock.now()
+        return len(restored_at) == len(evicted)
+
+    drive_until(victims_back, provision_s * 2 + 1200,
+                "preempted victims restored")
+    state_loss = 0
+    for name in evicted:
+        sess = (api.get("Notebook", "batch", name)
+                .body.get("status") or {}).get("sessionState") or {}
+        if (sess.get("0") or {}).get("digest") != digests[name]:
+            state_loss += 1
+
+    mgr.stop()
+    result = {
+        "mode": "priorities",
+        "tpu": tpu,
+        "capacity_slices": capacity_slices,
+        "benign_tenants": benign,
+        "per_tenant": per_tenant,
+        "flood_gangs": flood,
+        "high_gangs": high_gangs,
+        "queued_depth_peak": queued_depth_peak,
+        "high_p99_placement_s": round(high_p99, 3),
+        "high_max_placement_s": round(waits[-1], 3),
+        "evicted_victims": len(evicted),
+        "benign_evictions": benign_evictions,
+        "checkpointless_teardowns": checkpointless,
+        "preempted_state_loss": state_loss,
+        "restored_victims": len(restored_at),
+        "queue_wait_counts": {
+            p: metrics.queue_wait_seconds.count_value(p)
+            for p in ("low", "standard", "high")},
+        "preemptions_evicted_low":
+            metrics.preemptions.value("evicted", "low"),
+        "slo": slo_engine.verdicts(),
+    }
+    _print_priorities(result)
+    return result
+
+
+def _print_priorities(result: dict) -> None:
+    print("tenancy run:", file=sys.stderr)
+    for k in ("capacity_slices", "queued_depth_peak",
+              "high_p99_placement_s", "evicted_victims",
+              "benign_evictions", "checkpointless_teardowns",
+              "preempted_state_loss", "restored_victims"):
+        print(f"  {k:<26} {result[k]}", file=sys.stderr)
+
+
+def check_priorities_budget(result: dict, budget: dict) -> list[str]:
+    """CI gate over the adversarial tenancy run (ci/fleet_budget.json
+    "tenancy" section): high-priority time-to-placement ceiling, zero
+    state loss, zero benign evictions, zero checkpointless teardowns,
+    and the lane must actually have exercised preemption."""
+    failures = []
+    max_p99 = budget.get("max_high_p99_placement_s")
+    if max_p99 is not None and \
+            result["high_p99_placement_s"] > max_p99:
+        failures.append(
+            f"high-priority p99 time-to-placement "
+            f"{result['high_p99_placement_s']}s > ceiling {max_p99}s")
+    if result["preempted_state_loss"] > \
+            int(budget.get("max_preempted_state_loss", 0)):
+        failures.append(
+            f"{result['preempted_state_loss']} preempted victims lost "
+            "session state")
+    if result["benign_evictions"] > \
+            int(budget.get("max_benign_evictions", 0)):
+        failures.append(
+            f"{result['benign_evictions']} benign-tenant gangs evicted")
+    if result["checkpointless_teardowns"] > \
+            int(budget.get("max_checkpointless_teardowns", 0)):
+        failures.append(
+            f"{result['checkpointless_teardowns']} teardowns without a "
+            "secured checkpoint")
+    min_evict = int(budget.get("min_evictions", 1))
+    if result["evicted_victims"] < min_evict:
+        failures.append(
+            f"only {result['evicted_victims']} evictions — the lane "
+            f"never exercised preemption (want >= {min_evict})")
+    if result["restored_victims"] < result["evicted_victims"]:
+        failures.append(
+            f"{result['evicted_victims'] - result['restored_victims']} "
+            "evicted victims never restored")
+    return failures
+
+
 def check_tenant_budget(result: dict, budget: dict) -> list[str]:
     """CI gate over the adversarial tenants run (ci/fleet_budget.json
     "tenants" section): victim p99 ceiling under flood, exactly-one
@@ -1237,6 +1527,18 @@ def main(argv=None) -> int:
     parser.add_argument("--noisy", type=int, default=0, metavar="T",
                         help="index of the flooding tenant in --tenants "
                         "mode")
+    parser.add_argument("--priorities", type=int, default=0, metavar="N",
+                        help="adversarial tenancy mode: a low-priority "
+                        "flood oversubscribes the fleet, then an "
+                        "N-gang high-priority burst must land via "
+                        "checkpoint-then-preempt with zero state loss; "
+                        "--check-budget reads the 'tenancy' section")
+    parser.add_argument("--flood", type=int, default=6,
+                        help="queued low-priority gangs in --priorities "
+                        "mode")
+    parser.add_argument("--benign", type=int, default=2,
+                        help="untouchable standard-priority tenants in "
+                        "--priorities mode")
     parser.add_argument("--sweep", default="", metavar="N1,N2,...",
                         help="scale sweep: run the fleet (sharded when "
                         "--shards is set) at each point, print the "
@@ -1248,6 +1550,25 @@ def main(argv=None) -> int:
 
     if args.sweep:
         return _run_sweep(args)
+
+    if args.priorities:
+        result = run_priorities(args.priorities, args.benign,
+                                args.per_tenant, args.flood,
+                                args.tpu or "v5e:2x2")
+        rc = 0
+        if args.check_budget:
+            budget = json.loads(Path(args.check_budget).read_text())
+            failures = check_priorities_budget(
+                result, budget.get("tenancy", budget))
+            result["budget_ok"] = not failures
+            for f in failures:
+                print(f"TENANCY BUDGET FAIL: {f}", file=sys.stderr)
+                rc = 1
+        print(json.dumps(result))
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=2,
+                                                 sort_keys=True) + "\n")
+        return rc
 
     if args.tenants:
         result = run_tenants(args.tenants, args.per_tenant, args.noisy,

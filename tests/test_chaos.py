@@ -37,6 +37,7 @@ SOAK_ROUNDS = int(os.environ.get("CHAOS_SOAK_ROUNDS", "20"))
 SOAK_SEED = int(os.environ.get("CHAOS_SOAK_SEED", "20260804"))
 SELFHEAL_SOAK_ROUNDS = int(os.environ.get("SELFHEAL_SOAK_ROUNDS", "12"))
 MIGRATE_SOAK_ROUNDS = int(os.environ.get("MIGRATE_SOAK_ROUNDS", "10"))
+PREEMPT_SOAK_ROUNDS = int(os.environ.get("PREEMPT_SOAK_ROUNDS", "6"))
 FAILOVER_SOAK_ROUNDS = int(os.environ.get("FAILOVER_SOAK_ROUNDS", "50"))
 
 # the kinds the workbench controllers actually traffic in — the fault
@@ -866,6 +867,202 @@ class TestMigrationRecoverySoak:
         assert self._delete_groups(api, "doomed") == \
             cfg.recovery_max_attempts
         assert not mgr.dropped_errors
+
+
+class TestPreemptionSoak:
+    """ISSUE-19 acceptance: checkpoint-then-preempt under seeded manager
+    kills.  Each round a high-priority two-slice gang forces the eviction
+    of two checkpointed low-priority victims, and the acting manager is
+    killed at a seeded point of the write-ahead protocol — after the
+    record commit but before any teardown, between the two victim
+    teardowns, or after both teardowns but before the records fold
+    terminal.  A fresh successor must RESUME (never repeat) the eviction:
+    every victim's StatefulSet is client-deleted exactly once across both
+    managers, always whole-slice (zero pod-level client deletes — pods
+    cascade through the apiserver's owner-ref GC), every record reaches
+    its terminal phase exactly once, the victims' secured checkpoints
+    survive intact, and the beneficiary lands on the freed capacity."""
+
+    HOSTS = 4          # per slice: v5e 4x4 = 4 hosts x 4 chips
+
+    class _Killed(RuntimeError):
+        """Stands in for the manager process dying mid-protocol."""
+
+    def _env(self):
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+        from kubeflow_tpu.utils.clock import FakeClock as _FakeClock
+
+        api = ApiServer()
+        cluster = FakeCluster(api)
+        # two slices of capacity; cold provisioning effectively disabled
+        # so the only road to placement for the beneficiary is eviction
+        cluster.add_tpu_slice_nodes("tpu-v5-lite-podslice", "4x4", 8, 4)
+        clock = _FakeClock()
+        mgr = Manager(api, clock=clock)
+        cfg = CoreConfig.from_env({
+            "ENABLE_SLICE_SCHEDULER": "true",
+            "WARMPOOL_SIZE": "0",
+            "WARMPOOL_PROVISION_S": "3600",
+        })
+        store = InMemorySessionStore(clock=clock)
+        cluster.attach_session_store(store)
+        metrics = NotebookMetrics(api)
+        setup_core_controllers(mgr, cfg, metrics, session=store,
+                               provisioner=cluster)
+        return api, cluster, mgr, clock, cfg, store
+
+    def _sts_deletes(self, api, name):
+        return [r for r in api.audit_log(verb="delete", kind="StatefulSet")
+                if r.name == name and r.ok]
+
+    def _pod_deletes(self, api, name):
+        return [r for r in api.audit_log(verb="delete", kind="Pod")
+                if r.name.startswith(name + "-")]
+
+    def test_seeded_kill_points_resume_exactly_once(self):
+        import json as _json
+
+        from kubeflow_tpu.api.types import TPUSpec as _TPUSpec
+        from kubeflow_tpu.core import constants as CC
+        from kubeflow_tpu.core.metrics import NotebookMetrics
+        from kubeflow_tpu.core.preemption import (
+            PREEMPT_RESULT_EVICTED,
+            PREEMPT_RESULT_RESUMED,
+            pending_preemption,
+        )
+
+        class _Span:
+            def add_event(self, *a, **k):
+                pass
+
+            def set_attribute(self, *a, **k):
+                pass
+
+        print(f"\npreemption soak: seed={SOAK_SEED} "
+              f"rounds={PREEMPT_SOAK_ROUNDS} "
+              "(reproduce with CHAOS_SOAK_SEED/PREEMPT_SOAK_ROUNDS)")
+        rng = random.Random(SOAK_SEED + 53)
+        victims = [("t-low-a", "v-a"), ("t-low-b", "v-b")]
+        for round_i in range(PREEMPT_SOAK_ROUNDS):
+            api, cluster, mgr_a, clock, cfg, store = self._env()
+            snaps = {}
+            for ns, name in victims:
+                nb = Notebook.new(name, ns, tpu=TPUSpec("v5e", "4x4"))
+                nb.obj.spec["priority"] = "low"
+                api.create(nb.obj)
+                mgr_a.run_until_idle()
+                payload = b"%s-%d-%d" % (
+                    name.encode(), round_i, rng.randrange(2**32))
+                cluster.set_session_payload(ns, name, payload)
+                (snaps[(ns, name)],) = cluster.snapshot_sessions(ns, name)
+            ben_spec = _TPUSpec("v5e", "4x4", 2)
+            ben = Notebook.new("ben", "t-hi", tpu=ben_spec)
+            ben.obj.spec["priority"] = "high"
+            api.create(ben.obj)
+
+            # kill point: after j completed teardowns (j == len(victims)
+            # kills between the teardowns and the terminal record fold) —
+            # a fixed cadence so ANY round count exercises every point
+            kill_after = round_i % (len(victims) + 1)
+            engine = mgr_a.preemption_engine
+            orig_teardown = engine._teardown_victim
+            done = {"n": 0}
+
+            def kill_teardown(victim_rec):
+                if done["n"] >= kill_after:
+                    raise self._Killed()
+                out = orig_teardown(victim_rec)
+                done["n"] += 1
+                return out
+
+            engine._teardown_victim = kill_teardown
+            if kill_after >= len(victims):
+                engine._finish_records = lambda plan, result: (
+                    (_ for _ in ()).throw(self._Killed()))
+
+            # manager A plans the eviction and dies mid-protocol.  The
+            # engine is driven directly (as the scheduler's waiting
+            # branch would) so the kill cannot leak into A's workqueue —
+            # A is abandoned from here on, exactly like a dead process.
+            with pytest.raises(self._Killed):
+                engine.maybe_preempt(
+                    Notebook(api.get("Notebook", "t-hi", "ben")),
+                    ben_spec.shape, 2 * float(ben_spec.shape.chips),
+                    _Span())
+            for ns, name in victims:
+                assert pending_preemption(api, ns, name), (
+                    round_i, kill_after, ns, name,
+                    "the write-ahead record must be down before ANY kill "
+                    "point")
+
+            # successor manager resumes from the record alone
+            mgr_b = Manager(api, clock=clock)
+            metrics_b = NotebookMetrics(api)
+            setup_core_controllers(mgr_b, cfg, metrics_b, session=store,
+                                   provisioner=cluster)
+            mgr_b.enqueue_all()
+            mgr_b.run_until_idle()
+            for _ in range(3):
+                mgr_b.advance(20.0)
+            assert not mgr_b.dropped_errors, (round_i, kill_after)
+
+            quota = api.get(CC.TENANTQUOTA_KIND, "", CC.TENANTQUOTA_NAME)
+            st = quota.body.get("status") or {}
+            assert not (st.get("preemptions") or {}), (
+                round_i, kill_after, st)
+            recents = st.get("recentPreemptions") or []
+            for ns, name in victims:
+                key = f"{ns}/{name}"
+                mine = [r for r in recents if r.get("victim") == key]
+                assert len(mine) == 1 \
+                    and mine[0]["phase"] == CC.PREEMPTION_DONE, (
+                    round_i, kill_after, recents)
+                # exactly-once, whole-slice teardown across BOTH managers
+                assert len(self._sts_deletes(api, name)) == 1, (
+                    round_i, kill_after, name)
+                assert self._pod_deletes(api, name) == [], (
+                    round_i, kill_after, name)
+                assert api.list("Pod", namespace=ns) == [], (
+                    round_i, kill_after, ns)
+                vobj = api.get("Notebook", ns, name)
+                assert CC.ANNOTATION_PLACEMENT not in \
+                    vobj.metadata.annotations, (round_i, kill_after, name)
+                # the eviction stamps reason "preempted"; once the
+                # beneficiary places the fence lifts and ordinary
+                # re-admission may restamp the line reason — but the
+                # victim re-queues at its OWN priority either way
+                info = _json.loads(
+                    vobj.metadata.annotations[CC.ANNOTATION_QUEUED])
+                assert info.get("reason") in (
+                    "preempted", "quota", "fair-share", "ordered"), info
+                assert info.get("priority") == "low", info
+                sess = (vobj.body.get("status") or {}) \
+                    .get("sessionState") or {}
+                snap = snaps[(ns, name)]
+                assert sess.get("0", {}).get("digest") == snap.digest, (
+                    round_i, kill_after, name, sess)
+                assert sess.get("0", {}).get("trigger") == "preempt", sess
+            # A died before folding anything: the successor RESUMES every
+            # record — each counted exactly once, none double-evicted
+            assert metrics_b.preemptions.value(
+                PREEMPT_RESULT_RESUMED, "low") == len(victims), (
+                round_i, kill_after)
+            assert metrics_b.preemptions.value(
+                PREEMPT_RESULT_EVICTED, "low") == 0, (round_i, kill_after)
+            # the beneficiary lands on the freed capacity
+            ben_obj = api.get("Notebook", "t-hi", "ben")
+            assert CC.ANNOTATION_PLACEMENT in ben_obj.metadata.annotations
+            assert ben_obj.body["status"]["sliceHealth"] == "Healthy", (
+                round_i, kill_after)
+            # a second sweep is a no-op: the resume ran exactly once
+            mgr_b.enqueue_all()
+            mgr_b.run_until_idle()
+            assert metrics_b.preemptions.value(
+                PREEMPT_RESULT_RESUMED, "low") == len(victims)
+            for _, name in victims:
+                assert len(self._sts_deletes(api, name)) == 1
+            assert not mgr_b.dropped_errors, (round_i, kill_after)
 
 
 class TestFailoverSoak:

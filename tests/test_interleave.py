@@ -787,3 +787,204 @@ def test_mutant_reordered_claim_commit_is_caught():
     assert fail.directives, fail.narrative
     assert "double-sold" in fail.message or "Conflict" in fail.message, (
         fail.message)
+
+
+# -- protocol F: checkpoint-then-preempt vs failover ---------------------------
+class _NullSpan:
+    def add_event(self, *a, **k):
+        pass
+
+    def set_attribute(self, *a, **k):
+        pass
+
+
+def _preemption_scenario(engine_cls):
+    """A preemption engine evicts a placed low-priority victim for a
+    high-priority beneficiary while its failover twin re-drives the
+    write-ahead record and the victim's own scheduler reconcile races
+    both (claim / evict / restore).  Every schedule must keep the
+    checkpoint-then-preempt contract: by the time ANY victim teardown
+    runs, the Pending record, its restore manifest (digest included) and
+    the victim's sessionState intent are all persisted (so a crash at
+    any point resumes — never repeats — the eviction); the record
+    reaches its terminal phase exactly once; the claims drain and the
+    placement retires; and the victim is never resurrected onto the
+    freed capacity while the beneficiary still waits for it."""
+    from kubeflow_tpu.core import constants as CC
+
+    api = ApiServer()
+    clock = FakeClock()
+    cfg = _scheduler_cfg()
+    metrics = NotebookMetrics(api)
+    store = InMemorySessionStore(clock=clock)
+    snap = store.put("t-low", "victim", 0, b"kernel-state",
+                     trigger="interval")
+
+    victim = Notebook.new("victim", "t-low", tpu=SPEC)
+    victim.obj.spec["priority"] = "low"
+    victim.obj.metadata.annotations[C.ANNOTATION_PLACEMENT] = json.dumps(
+        {"slices": {"0": {"pool": "warm-a"}}, "v": 1},
+        sort_keys=True, separators=(",", ":"))
+    api.create(victim.obj)
+    ben = Notebook.new("ben", "t-hi", tpu=SPEC)
+    ben.obj.spec["priority"] = "high"
+    api.create(ben.obj)
+    api.create(KubeObject(
+        api_version="kubeflow.org/v1", kind=C.WARMPOOL_KIND,
+        metadata=ObjectMeta(name=POOL_NAME),
+        body={"spec": {"accelerator": "v5e", "topology": "4x4"},
+              "status": {"slices": {
+                  "ws-0001": {"state": CC.WARMSLICE_CLAIMED,
+                              "pool": "warm-a",
+                              "claimedBy": "t-low/victim",
+                              "claimedSlice": 0}}}}))
+
+    teardowns: list[str] = []
+
+    class _Checked(engine_cls):
+        def _teardown_victim(self, victim_rec):
+            quota = api.try_get(C.TENANTQUOTA_KIND, "",
+                                C.TENANTQUOTA_NAME)
+            st = {} if quota is None else (quota.body.get("status") or {})
+            rec = (st.get("preemptions") or {}).get(victim_rec["key"])
+            if rec is None:
+                # a racing manager may have finished this victim while
+                # we were paused — legitimate ONLY if the record folded
+                # to its terminal phase (the in-engine duplicate guard
+                # then makes super() a no-op); a teardown with no record
+                # trace at all is the write-ahead violation
+                recents = st.get("recentPreemptions") or []
+                assert any(r.get("victim") == victim_rec["key"]
+                           for r in recents), (
+                    "teardown with no write-ahead record trace "
+                    "(neither Pending nor terminal): %r" % st)
+            else:
+                assert rec.get("phase") == C.PREEMPTION_PENDING, (
+                    "teardown before the write-ahead record persisted: "
+                    "%r" % rec)
+                restore = rec.get("restore") or {}
+                assert restore.get("0", {}).get("digest") \
+                    == snap.digest, (
+                    "teardown before the restore manifest persisted: %r"
+                    % restore)
+                sess = (api.get("Notebook", "t-low", "victim")
+                        .body.get("status") or {}) \
+                    .get("sessionState") or {}
+                assert (sess.get("0") or {}).get("trigger") \
+                    == "preempt", (
+                    "teardown before the victim intent persisted: %r"
+                    % sess)
+                teardowns.append(victim_rec["key"])
+            super()._teardown_victim(victim_rec)
+
+    engines = {
+        n: _Checked(api, cfg, metrics, EventRecorder(api, n),
+                    clock=clock, session=store)
+        for n in ("mgr-a", "mgr-b")}
+
+    def preempt():
+        engines["mgr-a"].maybe_preempt(
+            Notebook(api.get("Notebook", "t-hi", "ben")),
+            SPEC.shape, float(SPEC.shape.chips), _NullSpan())
+
+    def resume():
+        engines["mgr-b"].reconcile(Request("", C.TENANTQUOTA_NAME))
+
+    def victim_sched():
+        SliceScheduler(api, cfg, metrics, clock=clock).reconcile(
+            Request("t-low", "victim"))
+
+    def check():
+        assert teardowns, "eviction never ran"
+        quota = api.get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        st = quota.body.get("status") or {}
+        assert not (st.get("preemptions") or {}), (
+            "record left Pending: %r" % st)
+        recents = st.get("recentPreemptions") or []
+        mine = [r for r in recents if r.get("victim") == "t-low/victim"]
+        assert len(mine) == 1 and mine[0]["phase"] == C.PREEMPTION_DONE, (
+            "record must fold to terminal exactly once: %r" % recents)
+        vobj = api.get("Notebook", "t-low", "victim")
+        assert C.ANNOTATION_PLACEMENT not in vobj.metadata.annotations, (
+            "victim resurrected onto the freed capacity: %r"
+            % vobj.metadata.annotations)
+        info = json.loads(
+            vobj.metadata.annotations[C.ANNOTATION_QUEUED])
+        assert info.get("reason") == "preempted", info
+        assert info.get("beneficiary") == "t-hi/ben", info
+        sess = (vobj.body.get("status") or {}).get("sessionState") or {}
+        assert sess.get("0", {}).get("digest") == snap.digest, sess
+        assert sess.get("0", {}).get("restoreGeneration") \
+            == snap.generation, sess
+        pool = api.get(C.WARMPOOL_KIND, "", POOL_NAME)
+        slices = (pool.body.get("status") or {}).get("slices") or {}
+        assert not any(e.get("claimedBy") == "t-low/victim"
+                       for e in slices.values()), (
+            "victim claims never drained (or were re-taken): %r" % slices)
+
+    return [("preempt", preempt), ("resume", resume),
+            ("victim-sched", victim_sched)], check
+
+
+def preemption_scenario():
+    from kubeflow_tpu.core.preemption import PreemptionEngine
+
+    return _preemption_scenario(PreemptionEngine)
+
+
+def test_preemption_write_ahead_under_all_schedules():
+    _explore(preemption_scenario)
+
+
+# Mutant D: delete the write-ahead record commit in preempt — victims are
+# torn down with no persisted record, so a crash mid-plan strands
+# half-evicted gangs no successor knows to finish.
+MUTANT_PREEMPT = [(
+    """        self._commit_record(nb, plan)
+        for victim in plan:""",
+    "        for victim in plan:"
+    "  # MUTANT D: teardown before the record",
+)]
+
+
+def test_mutant_preempt_before_record_is_caught():
+    mod = _load_mutant("kubeflow_tpu.core.preemption", MUTANT_PREEMPT,
+                       "kubeflow_tpu.core._preemption_mutant_d")
+
+    fail = _explore_mutant(
+        lambda: _preemption_scenario(mod.PreemptionEngine))
+    # pinned shrunk schedule: the very first (sequential, zero-preemption)
+    # schedule already tears the victim down with nothing persisted
+    assert fail.preemptions == 0, fail.narrative
+    assert fail.directives == {}, fail.narrative
+    assert "write-ahead record" in fail.message, fail.message
+
+
+def test_mutant_preempt_before_record_fails_writeahead_analyzer():
+    """The same mutant must also trip the STATIC half of the gate: with
+    the commit gone from preempt, the destructive teardown call has no
+    persist dominator on the CFG (ci/analyzers/write_ahead.py)."""
+    import ast as _ast
+    from pathlib import Path
+
+    from ci.analyzers import Module
+    from ci.analyzers import write_ahead as wa
+
+    src_path = importlib.import_module(
+        "kubeflow_tpu.core.preemption").__file__
+    rel = "kubeflow_tpu/core/preemption.py"
+    src = Path(src_path).read_text()
+    clean = Module(Path(src_path), rel, src,
+                   _ast.parse(src, filename=rel))
+    assert [v for v in wa.analyze(clean)
+            if v.context == "PreemptionEngine.preempt"] == [], \
+        "the committed order must satisfy the analyzer"
+    old, new = MUTANT_PREEMPT[0]
+    assert src.count(old) == 1
+    mutated_src = src.replace(old, new)
+    mutated = Module(Path(src_path), rel, mutated_src,
+                     _ast.parse(mutated_src, filename=rel))
+    found = [v for v in wa.analyze(mutated)
+             if v.context == "PreemptionEngine.preempt"]
+    assert found, "analyzer missed the record-after-teardown reorder"
+    assert "not dominated" in found[0].message

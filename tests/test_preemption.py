@@ -1,0 +1,591 @@
+"""Fleet tenancy under oversubscription: priority classes, quota /
+fair-share admission (core/scheduler.py `_admission`), and
+checkpoint-then-preempt (core/preemption.py).
+
+Covers the tenancy invariants end to end on the in-memory control plane:
+queued gangs read sliceHealth "Queued" and never hold claims, dequeue
+order is deterministic and starvation-free (aged weighted fair share),
+preemption never tears down an unsecured or equal-or-higher-priority
+victim, the write-ahead record resumes exactly once across a manager
+crash, and the cull/preempt precedence holds in BOTH orderings."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api.types import PRIORITY_RANK, Notebook, TPUSpec
+from kubeflow_tpu.core import constants as C
+from kubeflow_tpu.core.culling_controller import CullingReconciler
+from kubeflow_tpu.core.jupyter import FakeJupyterState
+from kubeflow_tpu.core.metrics import NotebookMetrics
+from kubeflow_tpu.core.notebook_controller import setup_core_controllers
+from kubeflow_tpu.core.preemption import (
+    PREEMPT_RESULT_EVICTED,
+    PREEMPT_RESULT_NO_VICTIM,
+    PREEMPT_RESULT_RESUMED,
+    new_quota_object,
+    pending_preemption,
+)
+from kubeflow_tpu.core.scheduler import (
+    queued_info,
+    rank_of,
+    resolve_priority,
+    tenant_policy,
+)
+from kubeflow_tpu.core.sessionstate import InMemorySessionStore
+from kubeflow_tpu.kube import (
+    ApiServer,
+    FakeCluster,
+    InvalidError,
+    Manager,
+    Request,
+)
+from kubeflow_tpu.utils.clock import FakeClock
+from kubeflow_tpu.utils.config import CoreConfig
+
+HOSTS = 4                      # v5e 4x4: 4 hosts x 4 chips = 16 chips
+GKE_LABEL = "tpu-v5-lite-podslice"
+
+
+def make_env(extra=None, nodes=0, provisioner=True):
+    """Scheduler + notebook controller + session store, cold provisioning
+    effectively disabled (1h) so capacity scarcity is real."""
+    api = ApiServer()
+    cluster = FakeCluster(api)
+    clock = FakeClock()
+    mgr = Manager(api, clock=clock)
+    env = {
+        "ENABLE_SLICE_SCHEDULER": "true",
+        "WARMPOOL_SIZE": "0",
+        "WARMPOOL_PROVISION_S": "3600",
+    }
+    env.update(extra or {})
+    cfg = CoreConfig.from_env(env)
+    metrics = NotebookMetrics(api, manager=mgr)
+    store = InMemorySessionStore(clock=clock)
+    cluster.attach_session_store(store)
+    setup_core_controllers(mgr, cfg, metrics, session=store,
+                           provisioner=cluster if provisioner else None)
+    if nodes:
+        cluster.add_tpu_slice_nodes(GKE_LABEL, "4x4", nodes, 4)
+    return api, cluster, clock, mgr, metrics, store
+
+
+def create_nb(api, name, ns, priority=None, slices=1, annotations=None):
+    nb = Notebook.new(name, ns, tpu=TPUSpec("v5e", "4x4", slices),
+                      annotations=annotations)
+    if priority is not None:
+        nb.obj.spec["priority"] = priority
+    api.create(nb.obj)
+    return nb
+
+
+def set_quota(api, tenants=None, defaults=None):
+    if api.try_get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME) is None:
+        api.create(new_quota_object())
+    live = api.get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+    live.body["spec"] = {"tenants": tenants or {},
+                         "defaults": defaults or {}}
+    api.update(live)
+
+
+def queued_stamp(since, priority="standard", reason="quota"):
+    return {C.ANNOTATION_QUEUED: json.dumps(
+        {"since": since, "priority": priority, "reason": reason})}
+
+
+def queue_of(api, ns, name):
+    return queued_info(api.get("Notebook", ns, name).metadata.annotations)
+
+
+def placed(api, ns, name):
+    return C.ANNOTATION_PLACEMENT in \
+        api.get("Notebook", ns, name).metadata.annotations
+
+
+def health(api, ns, name):
+    return (api.get("Notebook", ns, name).body.get("status") or {}) \
+        .get("sliceHealth")
+
+
+def victim_sts_deletes(api, name):
+    """Client-side deletes against the victim's gang STS.  Pods cascade
+    through the apiserver's owner-ref GC, so slice-atomicity reads as:
+    exactly one whole-StatefulSet delete, ZERO pod-level client deletes
+    (a pod-by-pod teardown would be a partial eviction in flight)."""
+    return [r for r in api.audit_log(verb="delete", kind="StatefulSet")
+            if r.name == name and r.ok]
+
+
+def victim_pod_deletes(api, name):
+    return [r for r in api.audit_log(verb="delete", kind="Pod")
+            if r.name.startswith(name + "-")]
+
+
+# -- priority classes ----------------------------------------------------------
+class TestPriorityClass:
+    def test_invalid_priority_rejected(self):
+        nb = Notebook.new("nb", "t1", tpu=TPUSpec("v5e", "4x4"))
+        nb.obj.spec["priority"] = "urgent"
+        with pytest.raises(InvalidError):
+            nb.validate()
+
+    def test_valid_classes_pass_validation(self):
+        for p in PRIORITY_RANK:
+            nb = Notebook.new("nb", "t1", tpu=TPUSpec("v5e", "4x4"))
+            nb.obj.spec["priority"] = p
+            nb.validate()
+
+    def test_resolution_explicit_beats_tenant_default(self):
+        quota = new_quota_object()
+        quota.body["spec"] = {"defaults": {"priority": "low"},
+                              "tenants": {"vip": {"priority": "high"}}}
+        anon = Notebook.new("a", "t1", tpu=TPUSpec("v5e", "4x4"))
+        assert resolve_priority(anon, quota) == "low"
+        viper = Notebook.new("b", "vip", tpu=TPUSpec("v5e", "4x4"))
+        assert resolve_priority(viper, quota) == "high"
+        viper.obj.spec["priority"] = "standard"
+        assert resolve_priority(viper, quota) == "standard"
+        # no quota object at all: the module default
+        assert resolve_priority(anon, None) == "standard"
+
+    def test_tenant_policy_merging_and_clamping(self):
+        quota = new_quota_object()
+        quota.body["spec"] = {
+            "defaults": {"chipQuota": 32, "weight": 2},
+            "tenants": {"t1": {"chipQuota": "garbage", "weight": -5},
+                        "t2": {"weight": 4}},
+        }
+        p1 = tenant_policy(quota, "t1")
+        assert p1["chip_quota"] == 32.0      # garbage -> default kept
+        assert p1["weight"] > 0              # clamped positive
+        p2 = tenant_policy(quota, "t2")
+        assert (p2["chip_quota"], p2["weight"]) == (32.0, 4.0)
+        assert tenant_policy(None, "t3") == {
+            "chip_quota": 0.0, "weight": 1.0, "priority": "standard"}
+        assert rank_of("high") > rank_of("standard") > rank_of("low")
+
+
+# -- quota / fair-share admission ----------------------------------------------
+class TestAdmissionGate:
+    def test_over_quota_gang_queues_then_admits_on_quota_raise(self):
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=8)
+        set_quota(api, tenants={"ta": {"chipQuota": 16}})
+        create_nb(api, "a1", "ta")
+        mgr.run_until_idle()
+        assert placed(api, "ta", "a1")
+        create_nb(api, "a2", "ta")
+        mgr.run_until_idle()
+        assert not placed(api, "ta", "a2")
+        info = queue_of(api, "ta", "a2")
+        assert info["reason"] == "quota"
+        assert info["priority"] == "standard"
+        assert health(api, "ta", "a2") == "Queued"
+        # a queued gang holds NO pool claims
+        for pool in api.list(C.WARMPOOL_KIND):
+            claims = (pool.body.get("status", {}).get("slices") or {})
+            assert not any(e.get("claimedBy") == "ta/a2"
+                           for e in claims.values())
+        # the /debug/fleet tenancy section sees the queue
+        tenancy = metrics.tenancy_snapshot()
+        assert tenancy["queued"]["ta"]["depth"] == 1
+        # raising the quota wakes every queued gang (TenantQuota watch)
+        clock.advance(30.0)
+        set_quota(api, tenants={"ta": {"chipQuota": 32}})
+        mgr.run_until_idle()
+        assert placed(api, "ta", "a2")
+        assert C.ANNOTATION_QUEUED not in \
+            api.get("Notebook", "ta", "a2").metadata.annotations
+        # queue wait observed, labeled by priority: EVERY placement is
+        # observed (0s for gangs that never queued) so the distribution's
+        # p99 is the time-to-placement SLO — a1 and a2 make two samples
+        assert metrics.queue_wait_seconds.count_value("standard") == 2
+
+    def test_stopped_while_queued_leaves_the_line(self):
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=4)
+        set_quota(api, tenants={"ta": {"chipQuota": 16}})
+        create_nb(api, "a1", "ta")
+        create_nb(api, "a2", "ta")
+        mgr.run_until_idle()
+        assert queue_of(api, "ta", "a2") or queue_of(api, "ta", "a1")
+        queued_name = "a2" if queue_of(api, "ta", "a2") else "a1"
+        live = api.get("Notebook", "ta", queued_name)
+        live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+        api.update(live)
+        mgr.run_until_idle()
+        assert C.ANNOTATION_QUEUED not in \
+            api.get("Notebook", "ta", queued_name).metadata.annotations
+
+    def test_fair_share_parks_tenant_over_its_share(self):
+        """Capacity 32, two tenants, equal weights -> 16-chip shares.
+        With tb's gang waiting mid-provision, ta (already at 16 placed)
+        may not claim MORE; once the contention clears and capacity
+        frees, the parked gang admits and places."""
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=8)
+        create_nb(api, "a1", "ta")
+        mgr.run_until_idle()
+        create_nb(api, "b1", "tb")
+        mgr.run_until_idle()
+        assert placed(api, "ta", "a1") and placed(api, "tb", "b1")
+        create_nb(api, "b2", "tb")    # no capacity left: cold reservation
+        mgr.run_until_idle()
+        assert not placed(api, "tb", "b2")
+        create_nb(api, "a2", "ta")
+        mgr.run_until_idle()
+        assert queue_of(api, "ta", "a2").get("reason") == "fair-share"
+        assert health(api, "ta", "a2") == "Queued"
+        # contention ends: a1 and b2 stop; a2 takes the freed capacity
+        for ns, name in (("ta", "a1"), ("tb", "b2")):
+            live = api.get("Notebook", ns, name)
+            live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+            api.update(live)
+        mgr.run_until_idle()
+        for _ in range(3):
+            mgr.advance(20.0)
+        assert placed(api, "ta", "a2")
+
+    def test_quota_counts_inflight_reservations(self):
+        """A burst of concurrent cold reservations must not oversubscribe
+        the quota: the second gang queues even though the first has not
+        PLACED yet (its reservation already spends the quota)."""
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=0)
+        set_quota(api, tenants={"ta": {"chipQuota": 16}})
+        create_nb(api, "a1", "ta")
+        mgr.run_until_idle()          # no capacity: a1 -> reservation
+        assert not placed(api, "ta", "a1")
+        create_nb(api, "a2", "ta")
+        mgr.run_until_idle()
+        assert queue_of(api, "ta", "a2").get("reason") == "quota"
+
+
+# -- deterministic, starvation-free dequeue order ------------------------------
+class TestDequeueOrder:
+    def _race(self, api, clock, mgr, winner, loser):
+        mgr.run_until_idle()
+        for _ in range(4):
+            mgr.advance(20.0)
+        (wns, wname), (lns, lname) = winner, loser
+        assert placed(api, wns, wname), f"{wname} should have won"
+        assert not placed(api, lns, lname)
+
+    def test_older_gang_dequeues_first(self):
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=4)
+        t0 = clock.now()
+        clock.advance(100.0)
+        create_nb(api, "old", "ta", annotations=queued_stamp(t0))
+        create_nb(api, "young", "ta", annotations=queued_stamp(t0 + 90.0))
+        self._race(api, clock, mgr, ("ta", "old"), ("ta", "young"))
+
+    def test_priority_outranks_small_age_gap(self):
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=4)
+        t0 = clock.now()
+        clock.advance(100.0)
+        create_nb(api, "lo", "ta",
+                  annotations=queued_stamp(t0, priority="standard"))
+        create_nb(api, "hi", "tb", priority="high",
+                  annotations=queued_stamp(t0 + 90.0, priority="high"))
+        self._race(api, clock, mgr, ("tb", "hi"), ("ta", "lo"))
+
+    def test_aging_eventually_beats_priority(self):
+        """Starvation-freedom: age grows without bound, so a low-priority
+        gang queued long enough outranks a fresh high-priority one.
+        Preemption is off so the dequeue order is observable in
+        isolation — with it on, the high gang would (correctly) admit
+        second and then evict the placed low gang."""
+        api, cluster, clock, mgr, metrics, _ = make_env(
+            nodes=4, extra={"QUEUE_AGING_S": "1",
+                            "ENABLE_PREEMPTION": "false"})
+        t0 = clock.now()
+        clock.advance(1000.0)
+        create_nb(api, "lo", "ta", priority="low",
+                  annotations=queued_stamp(t0, priority="low"))
+        create_nb(api, "hi", "tb", priority="high",
+                  annotations=queued_stamp(t0 + 990.0, priority="high"))
+        self._race(api, clock, mgr, ("ta", "lo"), ("tb", "hi"))
+
+
+# -- checkpoint-then-preempt ---------------------------------------------------
+class TestPreemption:
+    def _place_victim(self, api, cluster, mgr, name="victim", ns="t-low",
+                      priority="low", payload=b"kernel-state-A"):
+        create_nb(api, name, ns, priority=priority)
+        mgr.run_until_idle()
+        assert placed(api, ns, name)
+        cluster.set_session_payload(ns, name, payload)
+
+    def test_checkpoint_then_preempt_happy_path(self):
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        self._place_victim(api, cluster, mgr)
+        create_nb(api, "ben", "t-hi", priority="high")
+        mgr.run_until_idle()
+        # beneficiary holds the freed capacity
+        assert placed(api, "t-hi", "ben")
+        assert health(api, "t-hi", "ben") == "Healthy"
+        # victim: evicted, re-queued at its OWN priority, fenced on the
+        # beneficiary, session secured
+        assert not placed(api, "t-low", "victim")
+        info = queue_of(api, "t-low", "victim")
+        assert info["reason"] == "preempted"
+        assert info["priority"] == "low"
+        assert info["beneficiary"] == "t-hi/ben"
+        session = (api.get("Notebook", "t-low", "victim")
+                   .body["status"]["sessionState"])
+        assert session["0"]["trigger"] == "preempt"
+        assert session["0"]["digest"]
+        # write-ahead record reached its terminal state
+        quota = api.get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        st = quota.body.get("status") or {}
+        assert not (st.get("preemptions") or {})
+        (rec,) = st["recentPreemptions"]
+        assert rec["victim"] == "t-low/victim"
+        assert rec["phase"] == C.PREEMPTION_DONE
+        assert metrics.preemptions.value(
+            PREEMPT_RESULT_EVICTED, "low") == 1
+        # teardown was slice-atomic: one whole-STS delete, no pod-level
+        # client deletes (pods cascade via owner-ref GC), nothing left
+        assert len(victim_sts_deletes(api, "victim")) == 1
+        assert victim_pod_deletes(api, "victim") == []
+        assert api.list("Pod", namespace="t-low") == []
+        # events on both sides
+        reasons = {e.body.get("reason") for e in api.list("Event")}
+        assert {"NotebookPreempted", "PreemptionIssued"} <= reasons
+
+    def test_victim_restores_from_checkpoint_on_replacement(self):
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        self._place_victim(api, cluster, mgr)
+        create_nb(api, "ben", "t-hi", priority="high")
+        mgr.run_until_idle()
+        assert placed(api, "t-hi", "ben")
+        # beneficiary leaves; the victim's fence lifts and its cold
+        # reservation eventually provisions; the migrate-verb restore
+        # machinery carries the secured checkpoint back
+        live = api.get("Notebook", "t-hi", "ben")
+        live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+        api.update(live)
+        mgr.run_until_idle()
+        for _ in range(4):
+            mgr.advance(20.0)
+        mgr.advance(3700.0)
+        for _ in range(3):
+            mgr.advance(20.0)
+        assert placed(api, "t-low", "victim")
+        session = (api.get("Notebook", "t-low", "victim")
+                   .body["status"]["sessionState"])
+        assert session["0"]["phase"] == "restored"
+        assert metrics.migrations.value("preempt", "restored") == 1
+
+    def test_never_evicts_equal_or_higher_priority(self):
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        self._place_victim(api, cluster, mgr, ns="t-std",
+                           priority="standard")
+        create_nb(api, "ben", "t-hi", priority="standard")
+        mgr.run_until_idle()
+        assert placed(api, "t-std", "victim")      # untouched
+        assert not placed(api, "t-hi", "ben")
+        for result in (PREEMPT_RESULT_EVICTED, PREEMPT_RESULT_NO_VICTIM):
+            for p in PRIORITY_RANK:
+                assert metrics.preemptions.value(result, p) == 0
+        assert api.try_get(
+            C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME) is None
+
+    def test_no_secured_checkpoint_means_no_eviction(self):
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        self._place_victim(api, cluster, mgr)
+        # sever the checkpoint path: no final-snapshot handler, nothing
+        # stored -> the victim's state cannot be secured
+        store.set_final_snapshot_handler(None)
+        create_nb(api, "ben", "t-hi", priority="high")
+        mgr.run_until_idle()
+        assert placed(api, "t-low", "victim")      # never torn down
+        assert not placed(api, "t-hi", "ben")
+        assert metrics.preemptions.value(
+            PREEMPT_RESULT_NO_VICTIM, "high") >= 1
+        assert victim_sts_deletes(api, "victim") == []
+        assert len(api.list("Pod", namespace="t-low")) == HOSTS
+
+    def test_partial_coverage_evicts_nobody(self):
+        """The victim frees 16 chips but the beneficiary needs 32: evict
+        NOBODY (a partial eviction destroys a session without unblocking
+        anyone)."""
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        self._place_victim(api, cluster, mgr)
+        create_nb(api, "ben", "t-hi", priority="high", slices=2)
+        mgr.run_until_idle()
+        assert placed(api, "t-low", "victim")
+        assert not placed(api, "t-hi", "ben")
+        assert metrics.preemptions.value(
+            PREEMPT_RESULT_NO_VICTIM, "high") >= 1
+        assert victim_sts_deletes(api, "victim") == []
+        assert len(api.list("Pod", namespace="t-low")) == HOSTS
+
+    def test_preemption_fence_holds_until_beneficiary_places(self):
+        """A victim re-queued by an eviction must NOT reclaim the freed
+        capacity while its beneficiary still waits for it."""
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        # beneficiary of a DIFFERENT shape: it can never place here, so
+        # the fence (not capacity) is what holds the victim out
+        ben = Notebook.new("ben", "t-hi", tpu=TPUSpec("v5p", "2x2x2"))
+        ben.obj.spec["priority"] = "high"
+        api.create(ben.obj)
+        stamp = queued_stamp(0.0, priority="low", reason="preempted")
+        info = json.loads(stamp[C.ANNOTATION_QUEUED])
+        info["beneficiary"] = "t-hi/ben"
+        create_nb(api, "victim", "t-low", priority="low",
+                  annotations={C.ANNOTATION_QUEUED: json.dumps(info)})
+        mgr.run_until_idle()
+        for _ in range(3):
+            mgr.advance(20.0)
+        # capacity for the victim is RIGHT THERE, but the fence holds
+        assert not placed(api, "t-low", "victim")
+        assert queue_of(api, "t-low", "victim")["reason"] == "preempted"
+        # beneficiary gives up -> fence lifts -> victim places
+        live = api.get("Notebook", "t-hi", "ben")
+        live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+        api.update(live)
+        for _ in range(3):
+            mgr.advance(20.0)
+        assert placed(api, "t-low", "victim")
+
+    def test_resume_after_crash_exactly_once(self):
+        """A write-ahead record whose manager died before teardown is
+        re-driven by the next manager — exactly once: a second sweep
+        neither re-deletes pods nor double-counts."""
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        self._place_victim(api, cluster, mgr)
+        (snap,) = cluster.snapshot_sessions("t-low", "victim")
+        # the record's beneficiary exists but cannot place here (wrong
+        # accelerator) — the fence must keep the resumed victim from
+        # snatching its own freed capacity back
+        ben = Notebook.new("ben", "t-hi", tpu=TPUSpec("v5p", "2x2x2"))
+        ben.obj.spec["priority"] = "high"
+        api.create(ben.obj)
+        api.create(new_quota_object())
+        live = api.get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        live.status = {"preemptions": {"t-low/victim": {
+            "victim": "t-low/victim", "victimPriority": "low",
+            "beneficiary": "t-hi/ben", "beneficiaryPriority": "high",
+            "chips": 16.0, "phase": C.PREEMPTION_PENDING,
+            "createdAt": clock.now_iso(),
+            "restore": {"0": {
+                "restoreGeneration": snap.generation,
+                "restoreUri": snap.uri, "digest": snap.digest,
+                "savedAt": clock.now_iso()}}}}}
+        api.update_status(live)
+        assert pending_preemption(api, "t-low", "victim")
+        mgr.run_until_idle()   # TenantQuota watch drives the resume
+        assert not placed(api, "t-low", "victim")
+        assert not pending_preemption(api, "t-low", "victim")
+        assert metrics.preemptions.value(
+            PREEMPT_RESULT_RESUMED, "low") == 1
+        assert len(victim_sts_deletes(api, "victim")) == 1
+        assert victim_pod_deletes(api, "victim") == []
+        session = (api.get("Notebook", "t-low", "victim")
+                   .body["status"]["sessionState"])
+        assert session["0"]["digest"] == snap.digest
+        # second sweep: idempotent no-op
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        assert metrics.preemptions.value(
+            PREEMPT_RESULT_RESUMED, "low") == 1
+        assert len(victim_sts_deletes(api, "victim")) == 1
+
+
+# -- cull <-> preempt precedence (both orderings) ------------------------------
+class TestCullPreemptPrecedence:
+    def test_mid_cull_victim_never_selected(self):
+        """Cull first: a stop-annotated victim is already being parked —
+        the preemption engine must not double-handle it (the freed
+        capacity arrives through the ordinary release path instead)."""
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        create_nb(api, "victim", "t-low", priority="low")
+        mgr.run_until_idle()
+        cluster.set_session_payload("t-low", "victim", b"s")
+        live = api.get("Notebook", "t-low", "victim")
+        live.metadata.annotations[C.STOP_ANNOTATION] = "true"
+        api.update(live)
+        create_nb(api, "ben", "t-hi", priority="high")
+        mgr.run_until_idle()
+        for _ in range(3):
+            mgr.advance(20.0)
+        # the beneficiary got the capacity via release, NOT preemption
+        assert placed(api, "t-hi", "ben")
+        for p in PRIORITY_RANK:
+            assert metrics.preemptions.value(
+                PREEMPT_RESULT_EVICTED, p) == 0
+        quota = api.try_get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        assert quota is None or not (
+            (quota.body.get("status") or {}).get("recentPreemptions"))
+        session = (api.get("Notebook", "t-low", "victim")
+                   .body.get("status") or {}).get("sessionState") or {}
+        assert all(e.get("trigger") != "preempt"
+                   for e in session.values())
+
+    def test_pending_preemption_blocks_culler(self):
+        """Preempt first: while a write-ahead record owns the victim's
+        teardown, the culler must hold its stop annotation — a cull
+        landing mid-eviction would race the engine for the claims."""
+        api, cluster, clock, mgr, metrics, store = make_env(nodes=4)
+        create_nb(api, "victim", "t-low", priority="low")
+        mgr.run_until_idle()
+        api.create(new_quota_object())
+        live = api.get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        live.status = {"preemptions": {"t-low/victim": {
+            "victim": "t-low/victim", "phase": C.PREEMPTION_PENDING}}}
+        api.update_status(live)
+        jupyter = FakeJupyterState()
+        cull_cfg = CoreConfig(enable_culling=True, cull_idle_time_min=60,
+                              idleness_check_period_min=1)
+        culler_rec = CullingReconciler(api, cull_cfg, jupyter, metrics,
+                                       clock=clock)
+        req = Request("t-low", "victim")
+        culler_rec.reconcile(req)      # initializes activity annotations
+        clock.advance(61 * 60)
+        culler_rec.reconcile(req)      # idle — but the record holds it
+        nb = api.get("Notebook", "t-low", "victim")
+        assert C.STOP_ANNOTATION not in nb.metadata.annotations
+        # record closes -> the very next check culls normally
+        live = api.get(C.TENANTQUOTA_KIND, "", C.TENANTQUOTA_NAME)
+        live.status = {"preemptions": {}}
+        api.update_status(live)
+        clock.advance(2 * 60)
+        culler_rec.reconcile(req)
+        nb = api.get("Notebook", "t-low", "victim")
+        assert C.STOP_ANNOTATION in nb.metadata.annotations
+
+
+# -- observability satellites --------------------------------------------------
+class TestTenancyObservability:
+    def test_new_metric_families_registered(self):
+        api = ApiServer()
+        metrics = NotebookMetrics(api)
+        fams = dict(metrics.families())
+        assert fams["notebook_preemptions_total"] == "counter"
+        assert fams["notebook_queue_wait_seconds"] == "histogram"
+
+    def test_fleet_snapshot_has_tenancy_section(self):
+        api, cluster, clock, mgr, metrics, _ = make_env(nodes=4)
+        set_quota(api, tenants={"ta": {"chipQuota": 16}})
+        create_nb(api, "a1", "ta")
+        create_nb(api, "a2", "ta")
+        mgr.run_until_idle()
+        snap = metrics.fleet_snapshot()
+        tenancy = snap["tenancy"]
+        assert tenancy["queued"]["ta"]["depth"] == 1
+        assert tenancy["usage_chips"]["ta"] == 16.0
+        assert tenancy["quota"]["ta"]["chipQuota"] == 16
+        assert tenancy["pending_preemptions"] == 0
+
+    def test_placement_slo_objective_gated_on_knob(self):
+        from kubeflow_tpu.utils.slo import default_objectives
+
+        on = default_objectives(CoreConfig(slo_placement_p99_s=300.0))
+        assert any(o.name == "time_to_placement" for o in on)
+        off = default_objectives(CoreConfig())
+        assert not any(o.name == "time_to_placement" for o in off)
+
+    def test_quota_wait_is_a_lifecycle_stage(self):
+        from kubeflow_tpu.utils import lifecycle
+
+        assert lifecycle.STAGE_QUOTA_WAIT in lifecycle.STAGES
