@@ -110,3 +110,35 @@ if [[ "${INTERLEAVE_DEEP:-0}" == "1" ]]; then
   fi
   echo "interleave deep exploration OK"
 fi
+
+# FLEET_SCALE_DEEP=1: the tail of the sharded scale curve — 50k then
+# 100k notebooks over the 5-shard active-active fleet, each point gated
+# against its committed ci/fleet_budget.json "sharded_100k" sub-budget
+# (wall clock, p99 event->reconcile-start, ring balance,
+# reconciles/notebook) with the same safety contract as the default
+# lane's 2k/10k head (zero cross-process overlaps, zero steady-state
+# data-plane writes, zero conservation violations).  Off by default:
+# the 100k point alone runs ~20 minutes of real wall time (the fleet is
+# FakeClock-driven but the reconcile work is real CPU).
+if [[ "${FLEET_SCALE_DEEP:-0}" == "1" ]]; then
+  echo "== fleet scale deep sweep (5 shards, 50k/100k) =="
+  python loadtest/convergence.py --sweep 50000,100000 --shards 5 \
+    --check-budget ci/fleet_budget.json --budget-section sharded_100k \
+    --out "${FLEET_SCALE_OUT:-/tmp/fleet_scale_deep.json}"
+  python - "${FLEET_SCALE_OUT:-/tmp/fleet_scale_deep.json}" <<'PYEOF'
+import json, sys
+out = json.load(open(sys.argv[1]))
+for rec in out["sweep"]:
+    n = rec["count"]
+    assert rec.get("budget_ok"), f"point {n} over sharded_100k sub-budget"
+    assert rec["cross_process_overlaps"] == 0, f"point {n}: overlap"
+    assert rec["steady_data_plane_writes"] == 0, \
+        f"point {n}: steady-state data-plane writes"
+    assert rec["criticalpath"]["conservation"]["violations"] == 0, \
+        f"point {n}: conservation violations"
+    print(f"  {n}: wall={rec['wall_s']}s p99={rec['p99_event_to_reconcile_s']}s "
+          f"rss={rec['peak_rss_mb']}MB rmw_conflicts={rec['shard_map_rmw_conflicts']} "
+          f"binding={rec['binding_stage']}")
+print("fleet scale deep sweep OK")
+PYEOF
+fi

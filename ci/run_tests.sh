@@ -159,6 +159,46 @@ assert knee["binding_stage"] in STAGES, knee
 print(f"sweep diagnosis: knee at {knee['count']} notebooks "
       f"(binding stage {knee['binding_stage']})")
 PYEOF
+  # fleet-scale sharded sweep (5 shards): the head of the 100k curve —
+  # 2k then 10k notebooks over the active-active fleet with a
+  # kill+rejoin cycle per point, every point gated against its committed
+  # per-point sub-budget (ci/fleet_budget.json "sharded_100k" points
+  # map: wall clock + p99 event->reconcile-start, plus the section's
+  # ring-balance and reconciles/notebook ceilings).  The 50k/100k tail
+  # of the same curve runs in ci/chaos_soak.sh behind FLEET_SCALE_DEEP=1
+  # so the default lane stays minutes-sized.
+  echo "== loadtest sharded fleet scale sweep (5 shards) =="
+  python loadtest/convergence.py --sweep 2000,10000 --shards 5 \
+    --check-budget ci/fleet_budget.json --budget-section sharded_100k \
+    --out "${SHARD_SCALE_OUT:-/tmp/shard_scale_sweep.json}"
+  # scale-sweep contract: each point names its binding stage, records
+  # its memory + shard-map contention attribution (peak RSS, RMW
+  # conflicts), holds the safety invariants the sharding tier promises
+  # (zero cross-process overlaps, zero steady-state data-plane writes,
+  # zero conservation violations), and the knee of the wall-time curve
+  # is named
+  python - "${SHARD_SCALE_OUT:-/tmp/shard_scale_sweep.json}" <<'PYEOF'
+import json, sys
+from kubeflow_tpu.utils.lifecycle import STAGES
+out = json.load(open(sys.argv[1]))
+for rec in out["sweep"]:
+    n = rec["count"]
+    assert rec.get("budget_ok"), f"point {n} over sharded_100k sub-budget"
+    assert rec.get("binding_stage") in STAGES, rec.get("binding_stage")
+    assert "peak_rss_mb" in rec, f"point {n} missing peak_rss_mb"
+    assert "shard_map_rmw_conflicts" in rec, \
+        f"point {n} missing shard_map_rmw_conflicts"
+    assert rec["cross_process_overlaps"] == 0, f"point {n}: overlap"
+    assert rec["steady_data_plane_writes"] == 0, \
+        f"point {n}: steady-state data-plane writes"
+    assert rec["criticalpath"]["conservation"]["violations"] == 0, \
+        f"point {n}: conservation violations"
+knee = out["knee"]
+assert knee["count"] in out["points"], knee
+assert knee["binding_stage"] in STAGES, knee
+print(f"scale sweep diagnosis: knee at {knee['count']} notebooks "
+      f"(binding stage {knee['binding_stage']})")
+PYEOF
   # fleet-scale convergence gate: 10k notebooks must converge at the same
   # reconciles/notebook as the 200-notebook smoke (within tolerance),
   # reach a zero-write steady state, and stay under the committed

@@ -364,13 +364,16 @@ class InformerCache:
             return sorted((store[k] for k in hits if k in store),
                           key=lambda o: (o.namespace, o.name))
 
-    def resync(self, kind: str) -> None:
+    def resync(self, kind: str) -> list[tuple[str, str]]:
         """Realign the kind map with the live store under the CURRENT key
         filter — shard adoption (kube/shard.py) calls this after gaining
         keys, so objects whose events this cache skipped while another
-        shard owned them appear, and keys that moved away drop."""
+        shard owned them appear, and keys that moved away drop.  Returns
+        the keys the sweep newly admitted (they were not cached before),
+        so the adoption path can enqueue exactly what moved instead of
+        sweeping every key it holds."""
         self._ensure_primed(kind)
-        self._sync_kind(kind, prune=True)
+        return self._sync_kind(kind, prune=True)
 
     def stats(self) -> dict:
         with self._lock:
@@ -453,6 +456,21 @@ class InformerCache:
         def do() -> tuple[list[KubeObject], int]:
             lister = getattr(self.api, "list_with_rv", None)
             if lister is not None:
+                if self._key_filter is not None:
+                    # predicate pushdown: a sharded cache lists only its
+                    # owned keys instead of materializing the whole
+                    # fleet and filtering here (O(owned), not O(fleet),
+                    # per resync — the dominant cost of an adoption
+                    # sweep at 100k keys).  Backends without the
+                    # parameter (remote KubeClient) fall back to the
+                    # full list.
+                    kf = self._key_filter
+                    try:
+                        return lister(
+                            kind,
+                            predicate=lambda ns, name: kf(kind, ns, name))
+                    except TypeError:
+                        return lister(kind)
                 return lister(kind)
             return self.api.list(kind), 0
 
@@ -462,13 +480,14 @@ class InformerCache:
                 return do()
         return do()
 
-    def _sync_kind(self, kind: str, prune: bool) -> None:
+    def _sync_kind(self, kind: str, prune: bool) -> list[tuple[str, str]]:
         """Merge a live list snapshot into the kind map.  Watch events keep
         flowing while the list is in flight: newer stored versions win by
         resourceVersion, and deletions observed mid-sync are tombstoned so
         the snapshot cannot resurrect them.  `prune=True` (relist after
         410) additionally drops entries absent from the snapshot, unless
-        they are provably newer than it."""
+        they are provably newer than it.  Returns the keys the merge
+        newly admitted."""
         with self._lock:
             self._tombstones.setdefault(kind, set())
         try:
@@ -495,14 +514,18 @@ class InformerCache:
                     # its resourceVersion: not owned is not stored
                     del store[key]
                     self._deindex(kind, key, cur)
+            added: list[tuple[str, str]] = []
             for key, obj in fresh.items():
                 if key in tombstones:
                     continue  # deleted while the snapshot was in flight
                 cur = store.get(key)
                 if cur is not None and _rv_int(cur) >= _rv_int(obj):
                     continue
+                if cur is None:
+                    added.append(key)
                 self._reindex(kind, key, cur, obj)
                 store[key] = obj
+            return added
 
 
 __all__ = ["InformerCache"]

@@ -26,7 +26,7 @@ import time
 import traceback
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Protocol
+from typing import Callable, Iterable, Optional, Protocol
 
 from ..utils import invariants, tracing
 from ..utils.clock import Clock
@@ -610,15 +610,21 @@ class Manager:
             kinds.update(spec.kind for spec in reg.watches)
         return sorted(kinds)
 
-    def enqueue_all(self, reg_name: Optional[str] = None) -> None:
+    def enqueue_all(self, reg_name: Optional[str] = None,
+                    exclude_kinds: tuple = ()) -> None:
         """Resync: enqueue every existing primary object (informer
         re-list).  Reads the informer cache — key materialization only,
         no apiserver round trip, no per-object deepcopy — and the dirty
-        set dedupes against work already queued or in flight."""
+        set dedupes against work already queued or in flight.
+        `exclude_kinds` skips controllers whose For-kind is listed —
+        the shard adoption path covers those via `enqueue_keys` and
+        only needs the sweep for the rest."""
         if self.cache is not None:
             self.cache.ensure_connected()
         for reg in self._registrations:
             if reg_name is not None and reg.name != reg_name:
+                continue
+            if reg.for_kind in exclude_kinds:
                 continue
             if self.cache is not None:
                 keys = self.cache.keys(reg.for_kind)
@@ -627,6 +633,58 @@ class Manager:
                         for o in self.api.list(reg.for_kind)]
             for ns, name in keys:
                 self._enqueue(reg.name, Request(ns, name))
+
+    def enqueue_keys(self, kind: str,
+                     keys: Iterable[tuple[str, str]]) -> None:
+        """Batched enqueue of specific primary keys for every controller
+        whose For-kind is `kind` — ONE lock acquisition and ONE schedule
+        point for the whole batch.  The shard adoption path uses this: a
+        membership commit can grant thousands of keys at once, and the
+        per-key _enqueue walk (lock + yield point each) was measurable
+        wall time in the 10k+ fleet sweeps."""
+        reqs = [Request(ns, name) for ns, name in keys]
+        if self._key_filter is not None:
+            reqs = [r for r in reqs
+                    if self._key_filter(r.namespace, r.name)]
+        reg_names = [r.name for r in self._registrations
+                     if r.for_kind == kind]
+        if not reqs or not reg_names:
+            return
+        invariants.yield_point("queue.add", (kind, "batch", len(reqs)))
+        now = self.clock.now()
+        with self._lock:
+            for reg_name in reg_names:
+                queue = self._queues.get(reg_name)
+                if queue is None:
+                    continue
+                for req in reqs:
+                    key = (reg_name, req)
+                    if key in self._queued:
+                        continue
+                    self._queued.add(key)
+                    if key not in self._processing:
+                        queue.append(key)
+                    self._enqueued_at.setdefault(key, now)
+                    self._tenant_stamps.setdefault(key, req.namespace)
+
+    def has_pending_work(self) -> bool:
+        """Structural-idleness probe for fleet settle loops: anything
+        queued, parked in flight, or waiting in delayed retry means a
+        run_until_idle pass could still do work.  O(1) under the lock —
+        cheap enough to ask once per replica per settle round, which is
+        what lets an idle shard be skipped entirely."""
+        with self._lock:
+            return bool(self._queued or self._processing or self._delayed)
+
+    def pending_count(self) -> int:
+        """Outstanding work items (queued + in flight + delayed) — the
+        scale factor for drain-loop livelock caps: a shard that owns N
+        keys legitimately runs O(N) reconciles in one drain, so a flat
+        iteration cap misreads initial convergence at fleet scale as a
+        livelock."""
+        with self._lock:
+            return len(self._queued) + len(self._processing) + \
+                len(self._delayed)
 
     # -- execution ------------------------------------------------------------
     def _pop(self) -> Optional[tuple[str, Request]]:

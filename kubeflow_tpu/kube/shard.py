@@ -10,9 +10,14 @@ shards the Notebook keyspace across N in-process manager replicas:
     optimistic-concurrency, all-state-in-status pattern as TPUWarmPool)
     holding the authoritative membership: an epoch counter, per-shard
     member leases (each stamped with the epoch of its last (re)join —
-    its *incarnation*), and the pending handoff record.  The
+    its *incarnation*), and the pending handoff records.  The
     consistent-hash ring is DERIVED from the member list
     deterministically (`HashRing`), never stored key-by-key.
+  - **Namespace-affine placement** — a key's ring position hashes ONLY
+    its namespace, so every key of one tenant namespace lands on one
+    shard: that tenant's churn hits one cache and one workqueue instead
+    of spraying every ring (the 100k sweep's first binding lever; the
+    Kubeflow deployment model is a namespace per user profile).
   - **Fenced writes** — every replica's controllers write through a
     `FencedApi` proxy that calls the authority's `verify()` before each
     write verb: a deposed, evicted, or rejoined-elsewhere incarnation
@@ -22,20 +27,25 @@ shards the Notebook keyspace across N in-process manager replicas:
     LeaderElector (fencing epoch = leaseTransitions) and a ShardMember
     (fencing epoch = member incarnation) are interchangeable behind
     `verify()`.
-  - **Write-ahead handoff** — every membership change commits, in the
-    SAME map RMW as the epoch bump, a handoff record naming the shards
+  - **Write-ahead handoff, one record per change** — every membership
+    change commits, in the SAME map RMW as the epoch bump, its OWN
+    handoff record (appended to `status.handoffs`) naming the shards
     that gain keys (`adopters`) and the surviving shards that lose keys
     (`drains`).  Losers observe the commit (the in-process watch fires
     synchronously at commit), stop dispatching moved keys immediately,
-    finish in-flight ones, and RMW-ack out of `drains`; adopters enqueue
-    their new keys ONLY once `drains` is empty and then ack out of
-    `adopters` — the ack that empties both lists stamps
-    `status.lastHandoff` with the measured duration.  The commit is
-    strictly write-ahead of adoption (`ShardedReplica.join_fleet`;
-    pinned by ci/analyzers/write_ahead.py and model-checked by
-    tests/test_interleave.py), so no key is ever reconciled by two
-    shards in the same epoch and a crash mid-handoff leaves a committed
-    record any survivor completes.
+    finish in-flight ones, and RMW-ack out of every record's `drains`
+    in one commit (a drain resync against the CURRENT ring covers all
+    pending movements at once); adopters enqueue their gained keys ONLY
+    once every record granting them has drained, then ack out of
+    `adopters` — each record whose lists empty stamps
+    `status.lastHandoff` with its measured duration.  Per-change
+    records mean N simultaneous joins complete independently instead of
+    convoying through one merged record.  The commit is strictly
+    write-ahead of adoption (`ShardedReplica.join_fleet`; pinned by
+    ci/analyzers/write_ahead.py and model-checked by
+    tests/test_interleave.py — including two SIMULTANEOUS joins), so no
+    key is ever reconciled by two shards in the same epoch and a crash
+    mid-handoff leaves committed records any survivor completes.
 
 Per-shard resource isolation rides the PR 8 substrate: each replica runs
 its own Manager worker pool and its own `InformerCache` with a
@@ -58,7 +68,8 @@ from ..utils.flightrecorder import FlightRecorder
 from ..utils.metrics import Registry
 from .cache import InformerCache
 from .controller import Manager
-from .errors import ApiError, is_already_exists, retry_on_conflict
+from .errors import (ApiError, ConflictError, is_already_exists,
+                     retry_on_conflict)
 from .leader import FencingToken, StaleEpochError, _iso
 from .meta import KubeObject, ObjectMeta
 
@@ -95,9 +106,17 @@ class HashRing:
     """Consistent-hash ring derived deterministically from a member-id
     list: every replica that observes the same member set computes the
     same ownership, so the ring itself never needs to be persisted or
-    coordinated beyond the membership."""
+    coordinated beyond the membership.
 
-    __slots__ = ("members", "_points", "_keys")
+    Placement is **namespace-affine**: the ring position hashes ONLY
+    `namespace`, never the object name, so all keys of one namespace
+    share one owner — one tenant's churn stays on one shard's cache and
+    workqueue.  Ownership lookups memoize per namespace (a ring is
+    immutable once built; membership changes build a new ring), which
+    turns the hot dispatch-filter path from sha1+bisect per call into a
+    dict hit."""
+
+    __slots__ = ("members", "_points", "_keys", "_owner_cache")
 
     def __init__(self, members: Iterable[str], vnodes: int = VNODES) -> None:
         self.members: tuple[str, ...] = tuple(sorted(members))
@@ -108,13 +127,19 @@ class HashRing:
         pts.sort()
         self._points = pts
         self._keys = [p for p, _ in pts]
+        # benign CPython race: concurrent misses compute the same value
+        self._owner_cache: dict[str, str] = {}
 
     def owner_of(self, namespace: str, name: str) -> Optional[str]:
         if not self._points:
             return None
-        h = _hash64(f"{namespace}/{name}")
-        idx = bisect.bisect_right(self._keys, h) % len(self._points)
-        return self._points[idx][1]
+        owner = self._owner_cache.get(namespace)
+        if owner is None:
+            h = _hash64(namespace)
+            idx = bisect.bisect_right(self._keys, h) % len(self._points)
+            owner = self._points[idx][1]
+            self._owner_cache[namespace] = owner
+        return owner
 
 
 def _lease_expired(member: dict, now: float) -> bool:
@@ -125,26 +150,38 @@ def _lease_expired(member: dict, now: float) -> bool:
     return renew + duration < now
 
 
-def _merge_handoff(status: dict, now: float, adopters: set,
-                   drains: set) -> None:
-    """Fold a membership change's key movement into the (possibly
-    already pending) handoff record.  Records merge rather than replace
-    so overlapping changes keep one `startedAt` (handoff-stall time is
-    measured from the FIRST unfinished movement); departed members are
-    pruned from both lists — a dead shard cannot ack."""
-    members = status.get("members") or {}
-    h = status.get("handoff") or {}
-    adopters = (adopters | set(h.get("adopters") or ())) & set(members)
-    drains = (drains | set(h.get("drains") or ())) & set(members)
-    if not adopters and not drains:
-        status.pop("handoff", None)
-        return
-    status["handoff"] = {
-        "epoch": int(status.get("epoch") or 0),
-        "startedAt": h.get("startedAt") or _iso(now),
-        "adopters": sorted(adopters),
-        "drains": sorted(drains),
-    }
+def _append_handoff(status: dict, now: float, adopters: set,
+                    drains: set) -> None:
+    """Commit a membership change's key movement as its OWN write-ahead
+    record, appended to the epoch-ordered `status.handoffs` list.
+    Per-change records let overlapping changes complete independently —
+    N simultaneous joins each carry their own adopter/drain lists
+    instead of convoying through one merged record.  Departed members
+    are pruned from every pending record (a dead shard cannot ack); a
+    record pruned empty simply disappears — its movement became moot
+    before anyone had to act on it."""
+    members = set(status.get("members") or {})
+    records = []
+    for h in status.get("handoffs") or ():
+        a = sorted(set(h.get("adopters") or ()) & members)
+        d = sorted(set(h.get("drains") or ()) & members)
+        if a or d:
+            records.append({"epoch": h.get("epoch"),
+                            "startedAt": h.get("startedAt"),
+                            "adopters": a, "drains": d})
+    adopters = set(adopters) & members
+    drains = set(drains) & members
+    if adopters or drains:
+        records.append({
+            "epoch": int(status.get("epoch") or 0),
+            "startedAt": _iso(now),
+            "adopters": sorted(adopters),
+            "drains": sorted(drains),
+        })
+    if records:
+        status["handoffs"] = records
+    else:
+        status.pop("handoffs", None)
 
 
 class ShardMember:
@@ -169,6 +206,16 @@ class ShardMember:
         self.lease_duration_s = lease_duration_s
         self.clock = clock or Clock()
         self.token = FencingToken()
+        #: shard-map RMW optimistic-concurrency losses (409s retried by
+        #: _mutate_map) — the loadtest sweeps record this per point as
+        #: the membership-contention trend
+        self.rmw_conflicts = 0
+        #: resourceVersion of this member's last committed map RMW.
+        #: Written only by the protocol thread (join/renew/ack/leave run
+        #: single-threaded per replica), so callers may read it right
+        #: after an RMW returns to order the view they were handed.
+        self.last_commit_rv = 0
+        self._last_renew: Optional[float] = None
 
     # -- map access -----------------------------------------------------------
     def _exempt_get(self) -> Optional[KubeObject]:
@@ -193,22 +240,42 @@ class ShardMember:
 
     def _mutate_map(self, mutate: Callable[[dict], None]) -> dict:
         """One committed RMW of the map status; returns the committed
-        view.  Conflicts re-run `mutate` on a fresh read, so concurrent
-        membership changes serialize into distinct epochs."""
+        view.  Conflicts re-run `mutate` on a fresh read — concurrent
+        membership changes serialize into distinct epochs — with capped
+        exponential backoff on the INJECTED clock: a FakeClock-driven
+        run backs off in logical time (deterministic, no wall sleeps),
+        and membership churn under load spreads out instead of
+        hot-looping on 409s.  Every conflict is counted."""
         def attempt() -> dict:
             obj = self._load()
             status = copy.deepcopy(obj.body.get("status") or {})
             mutate(status)
             obj.body["status"] = status
-            self.api.update_status(obj)
+            try:
+                committed = self.api.update_status(obj)
+            except ConflictError:
+                self.rmw_conflicts += 1
+                raise
+            self.last_commit_rv = committed.metadata.resource_version
             return status
-        return retry_on_conflict(attempt)
+        return retry_on_conflict(attempt, jitter=0.0,
+                                 sleep_fn=self.clock.sleep)
 
     def read_status(self) -> dict:
         """The committed map status (read-only view; fault-exempt so
         membership observation cannot be chaos-injected away)."""
+        return self.read_status_rv()[0]
+
+    def read_status_rv(self) -> tuple[dict, int]:
+        """`read_status` plus the resourceVersion it was read at, so the
+        caller can order the view against watch-delivered ones (map
+        commits fan out to watchers outside the store lock, so two
+        writers' events can arrive out of commit order)."""
         obj = self._exempt_get()
-        return (obj.body.get("status") or {}) if obj is not None else {}
+        if obj is None:
+            return {}, 0
+        return (obj.body.get("status") or {}), \
+            obj.metadata.resource_version
 
     # -- membership mutations -------------------------------------------------
     def _join_mutation(self, status: dict, now: float) -> None:
@@ -228,7 +295,7 @@ class ShardMember:
         # the joiner gains keys from every survivor; an eviction in the
         # same commit hands the dead member's keys to ALL survivors
         adopters = {self.shard_id} | (survivors if expired else set())
-        _merge_handoff(status, now, adopters, survivors)
+        _append_handoff(status, now, adopters, survivors)
 
     def join(self) -> dict:
         """Commit this member into the map — epoch bump, fresh
@@ -240,6 +307,7 @@ class ShardMember:
         view = self._mutate_map(lambda status:
                                 self._join_mutation(status, now))
         self.token.renew(int(view["members"][self.shard_id]["epoch"]))
+        self._last_renew = now
         return view
 
     def preview_join(self) -> dict:
@@ -254,10 +322,22 @@ class ShardMember:
         self._join_mutation(status, self.clock.now())
         return status
 
+    def renew_due(self) -> bool:
+        """Whether the lease wants renewing: a third of the lease
+        duration since the last committed renewal (client-go's
+        renewDeadline idiom).  A fresh or fenced member is always due.
+        The fleet's settle/maintain loops use this to COALESCE renewals
+        — without it every settle round is a map RMW per replica, and N
+        replicas' heartbeats contend for 409s they don't need."""
+        if self._last_renew is None or not self.token.valid:
+            return True
+        return (self.clock.now() - self._last_renew) >= \
+            self.lease_duration_s / 3.0
+
     def renew(self) -> bool:
         """Renew this member's lease (incarnation unchanged) and evict
         any member whose lease expired — eviction bumps the epoch and
-        extends the handoff record in the same commit.  Returns False
+        appends a handoff record in the same commit.  Returns False
         (token invalidated FIRST) if this member was itself evicted."""
         now = self.clock.now()
 
@@ -277,15 +357,16 @@ class ShardMember:
                 for sid in expired:
                     members.pop(sid)
                 status["epoch"] = int(status.get("epoch") or 0) + 1
-                _merge_handoff(status, now, set(members), set())
+                _append_handoff(status, now, set(members), set())
             else:
-                # prune departed members out of a pending record even on
+                # prune departed members out of pending records even on
                 # a quiet renew (their ack will never come)
-                if status.get("handoff"):
-                    _merge_handoff(status, now, set(), set())
+                if status.get("handoffs"):
+                    _append_handoff(status, now, set(), set())
 
         try:
             self._mutate_map(mutate)
+            self._last_renew = now
             return True
         except StaleEpochError:
             self.token.invalidate()
@@ -307,24 +388,44 @@ class ShardMember:
         def mutate(status: dict) -> None:
             members = status.setdefault("members", {})
             if members.pop(self.shard_id, None) is None:
-                _merge_handoff(status, now, set(), set())
+                _append_handoff(status, now, set(), set())
                 return
             status["epoch"] = int(status.get("epoch") or 0) + 1
-            _merge_handoff(status, now, set(members), set())
+            _append_handoff(status, now, set(members), set())
 
         return self._mutate_map(mutate)
 
     # -- handoff acks ---------------------------------------------------------
     def _ack(self, status: dict, now: float, field: str,
              completed: list) -> None:
+        """Remove this member from `field` of EVERY pending record in
+        one commit: a drain resync runs against the CURRENT ring, so it
+        covers all pending movements at once, and an adopter only acks
+        when every record granting it keys has drained — N concurrent
+        handoffs cost one ack RMW here, not N.  Each record whose lists
+        both empty completes; completions land in epoch order, so the
+        highest-epoch completion wins the `lastHandoff` stamp."""
         completed[0] = None
-        h = status.get("handoff")
-        if not h or self.shard_id not in (h.get(field) or ()):
+        records = status.get("handoffs") or []
+        remaining: list = []
+        done: list = []
+        changed = False
+        for h in records:
+            if self.shard_id in (h.get(field) or ()):
+                h = dict(h)
+                h[field] = [s for s in h[field] if s != self.shard_id]
+                changed = True
+            if not h.get("adopters") and not h.get("drains"):
+                done.append(h)
+            else:
+                remaining.append(h)
+        if not changed and not done:
             return
-        h = dict(h)
-        h[field] = [s for s in h[field] if s != self.shard_id]
-        status["handoff"] = h
-        if not h.get("adopters") and not h.get("drains"):
+        if remaining:
+            status["handoffs"] = remaining
+        else:
+            status.pop("handoffs", None)
+        for h in done:
             started = parse_iso(h["startedAt"]) if h.get("startedAt") \
                 else now
             duration = max(now - started, 0.0)
@@ -333,7 +434,6 @@ class ShardMember:
                 "completedAt": _iso(now),
                 "durationSeconds": duration,
             }
-            status.pop("handoff")
             completed[0] = duration
 
     def ack_drain(self) -> dict:
@@ -345,8 +445,9 @@ class ShardMember:
 
     def ack_adopt(self) -> tuple[dict, Optional[float]]:
         """This member adopted its gained keys; returns the committed
-        view plus the whole handoff's duration when THIS ack completed
-        it (the handoff-duration observation point)."""
+        view plus the completed handoff's duration when THIS ack
+        finished one (the handoff-duration observation point — the last
+        record this ack completed, when it completed several)."""
         now = self.clock.now()
         completed: list = [None]
         view = self._mutate_map(
@@ -448,9 +549,19 @@ class ShardedReplica:
         self._lock = invariants.tracked(
             threading.Lock(), "ShardedReplica._lock")
         self._ring = HashRing((), vnodes=vnodes)
-        self._prev_ring: Optional[HashRing] = None
+        #: ring at the last NO-pending-handoff state: the dispatch gate
+        #: for keys gained by a still-draining change.  A single
+        #: previous-ring snapshot is wrong under overlapping changes
+        #: (the ring one change ago is not the last stable ownership);
+        #: this only advances when every record has acked out.
+        self._stable_ring = self._ring
         self._epoch = 0
-        self._pending_handoff: Optional[dict] = None
+        self._pending_handoffs: list[dict] = []
+        #: resourceVersion of the installed view — map commits fan out
+        #: to watchers outside the store lock, so two writers' events
+        #: can be DELIVERED out of commit order; installing by rv keeps
+        #: the ring/gate view from regressing to an older commit
+        self._installed_rv = 0
         #: completed-handoff durations observed by THIS replica's acks
         self.handoff_durations: list[float] = []
         self.member = ShardMember(api, shard_id, map_name=map_name,
@@ -474,17 +585,25 @@ class ShardedReplica:
         if ev.obj.kind != SHARD_MAP_KIND or \
                 ev.obj.name != self.member.map_name:
             return
-        self._install_status(ev.obj.body.get("status") or {})
+        self._install_status(ev.obj.body.get("status") or {},
+                             rv=ev.obj.metadata.resource_version)
 
-    def _install_status(self, status: dict) -> None:
+    def _install_status(self, status: dict,
+                        rv: Optional[int] = None) -> None:
         with self._lock:
+            if rv is not None:
+                if rv <= self._installed_rv:
+                    return    # stale delivery: a newer commit installed
+                self._installed_rv = rv
             members = tuple(sorted(status.get("members") or {}))
             if members != self._ring.members:
-                self._prev_ring = self._ring
                 self._ring = HashRing(members, vnodes=self._vnodes)
             self._epoch = int(status.get("epoch") or 0)
-            h = status.get("handoff")
-            self._pending_handoff = dict(h) if h else None
+            records = [dict(h) for h in status.get("handoffs") or ()]
+            self._pending_handoffs = records
+            if not records:
+                # every movement acked out: current ownership is stable
+                self._stable_ring = self._ring
 
     @property
     def epoch(self) -> int:
@@ -493,19 +612,22 @@ class ShardedReplica:
     def owns_key(self, namespace: str, name: str) -> bool:
         """Dispatch filter: the ring must assign the key here — and a
         key GAINED in a still-draining handoff is not dispatchable yet
-        (the previous owner may have it in flight); it arrives via
-        enqueue_all at adoption time."""
+        (the previous owner may have it in flight); it arrives via the
+        batched adopt-enqueue at adoption time.  "Gained" is judged
+        against the last STABLE ring — the ownership when no handoff was
+        pending — so the gate stays correct when two changes overlap."""
         with self._lock:
-            ring, prev, h = self._ring, self._prev_ring, \
-                self._pending_handoff
+            ring, stable, records = self._ring, self._stable_ring, \
+                self._pending_handoffs
         if self.shard_id not in ring.members or \
                 ring.owner_of(namespace, name) != self.shard_id:
             return False
-        if h and h.get("drains") and self.shard_id in h.get("adopters", ()):
-            # dispatchable mid-drain only if we ALREADY owned it under the
-            # previous ring; a fresh joiner (empty prev) owned nothing
-            if prev is None or not prev.members or \
-                    prev.owner_of(namespace, name) != self.shard_id:
+        gated = any(self.shard_id in (h.get("adopters") or ())
+                    and (h.get("drains") or ())
+                    for h in records)
+        if gated:
+            if not stable.members or \
+                    stable.owner_of(namespace, name) != self.shard_id:
                 return False
         return True
 
@@ -529,61 +651,90 @@ class ShardedReplica:
         (ci/analyzers/write_ahead.py pins this order statically,
         tests/test_interleave.py model-checks it)."""
         view = self.member.join()
-        self._install_status(view)
+        self._install_status(view, rv=self.member.last_commit_rv)
         self._drain_and_adopt(view)
         self.alive = True
 
     def sync(self) -> None:
         """One handoff-protocol step off the committed map: refresh the
-        ownership view, ack a pending drain once nothing foreign is in
-        flight, adopt once every drain is acked."""
-        status = self.member.read_status()
-        self._install_status(status)
+        ownership view, ack pending drains once nothing foreign is in
+        flight, adopt once every record granting us keys has drained."""
+        status, rv = self.member.read_status_rv()
+        self._install_status(status, rv=rv)
         self._drain_and_adopt(status)
 
     def maintain(self) -> bool:
-        """Periodic housekeeping: renew the member lease (evicting
-        expired peers) and run one handoff step.  Returns False when
-        this replica found itself evicted (token already invalidated)."""
-        ok = self.member.renew()
-        if ok:
-            self.sync()
-        return ok
+        """Periodic housekeeping: renew the member lease when a renewal
+        is actually due (evicting expired peers), then run one handoff
+        step.  Returns False when this replica found itself evicted
+        (token already invalidated)."""
+        if self.member.renew_due():
+            if not self.member.renew():
+                return False
+        self.sync()
+        return True
 
     def _drain_and_adopt(self, status: dict) -> None:
-        h = status.get("handoff")
-        if not h:
+        records = status.get("handoffs") or ()
+        if not records:
             return
-        if self.shard_id in (h.get("drains") or ()) and \
-                not self._holding_foreign_keys():
+        added: Optional[dict] = None
+        if any(self.shard_id in (h.get("drains") or ()) for h in records) \
+                and not self._holding_foreign_keys():
             # draining = dropping the moved keys: evict them from the
-            # filtered cache before the ack tells adopters to proceed
-            self._resync_sharded()
+            # filtered cache before the ack tells adopters to proceed.
+            # One resync against the CURRENT ring covers every pending
+            # record's movement, so the ack clears all our drains.
+            added = self._resync_sharded()
             status = self.member.ack_drain()
-            self._install_status(status)
-            h = status.get("handoff")
-        if h and self.shard_id in (h.get("adopters") or ()) and \
-                not (h.get("drains") or ()):
-            self._adopt()
+            self._install_status(status, rv=self.member.last_commit_rv)
+            records = status.get("handoffs") or ()
+        mine = [h for h in records
+                if self.shard_id in (h.get("adopters") or ())]
+        if mine and not any(h.get("drains") for h in mine):
+            self._adopt(added)
 
-    def _resync_sharded(self) -> None:
+    def _resync_sharded(self) -> dict:
+        """Realign the filtered cache for every sharded kind; returns
+        the keys the sweep newly admitted, per kind."""
+        added: dict = {}
         for kind in self.sharded_kinds:
             try:
-                self.cache.resync(kind)
+                added[kind] = set(self.cache.resync(kind))
             except ApiError as err:
+                added[kind] = set()
                 logger.warning("shard %s: resync of %s failed: %s",
                                self.shard_id, kind, err)
+        return added
 
-    def _adopt(self) -> None:
+    def _adopt(self, added: Optional[dict]) -> None:
         """Adopt the keys this shard gained: realign the filtered cache
-        with current ownership, enqueue everything the dispatch filter
-        now admits, and ack.  Runs strictly after the map commit that
-        granted the keys (see join_fleet) and strictly after every
-        drain ack."""
-        self._resync_sharded()
-        self.manager.enqueue_all()
+        with current ownership (unless the drain step just did), then
+        enqueue the GAINED keys in one batched pass per kind — gained =
+        newly admitted by the sweep plus anything the stable ring did
+        not already assign here (keys that arrived by watch while the
+        drain gate held) — and ack.  Runs strictly after the map commit
+        that granted the keys (see join_fleet) and strictly after every
+        drain ack.  The batched pass replaces a full enqueue_all walk:
+        adoption cost scales with the keys that MOVED, not the keys the
+        shard holds."""
+        if added is None:
+            added = self._resync_sharded()
+        with self._lock:
+            stable = self._stable_ring
+        for kind in self.sharded_kinds:
+            gained = set(added.get(kind) or ())
+            for ns, name in self.cache.keys(kind):
+                if not stable.members or \
+                        stable.owner_of(ns, name) != self.shard_id:
+                    gained.add((ns, name))
+            self.manager.enqueue_keys(kind, sorted(gained))
+        # non-sharded primary kinds (Event, TenantQuota, WarmPool, ...)
+        # keep the full resync sweep: their keyspaces are small and the
+        # dispatch filter still applies per namespace
+        self.manager.enqueue_all(exclude_kinds=self.sharded_kinds)
         view, duration = self.member.ack_adopt()
-        self._install_status(view)
+        self._install_status(view, rv=self.member.last_commit_rv)
         if duration is not None:
             self.handoff_durations.append(duration)
 
@@ -634,6 +785,7 @@ class ShardedReplica:
             "keys_owned": self.keys_owned(),
             "fenced_rejections": self.fenced.rejected_total,
             "handoffs_completed": len(self.handoff_durations),
+            "rmw_conflicts": self.member.rmw_conflicts,
         }
 
 
@@ -689,9 +841,14 @@ class ShardedFleet:
             return r.member.read_status()
         return {}
 
-    def pending_handoff(self) -> Optional[dict]:
-        h = self.map_status().get("handoff")
-        return dict(h) if h else None
+    def pending_handoffs(self) -> list:
+        """Every pending handoff record off the committed map."""
+        return [dict(h) for h in self.map_status().get("handoffs") or ()]
+
+    def rmw_conflicts(self) -> int:
+        """Total shard-map RMW 409 retries across the fleet's members —
+        the contention figure the sweep artifact records per point."""
+        return sum(r.member.rmw_conflicts for r in self.replicas.values())
 
     def owner_of(self, namespace: str, name: str) -> Optional[str]:
         ring = HashRing(sorted(self.map_status().get("members") or {}))
@@ -699,9 +856,14 @@ class ShardedFleet:
 
     def settle(self, max_rounds: int = 500,
                advance_clock: bool = True) -> int:
-        """Round-robin every live replica — renew, handoff step, drain
-        its workqueue — until a full pass does nothing and no handoff is
-        pending.  When a handoff stalls on a dead member's lease, the
+        """Round-robin the live replicas — renew, handoff step, drain
+        workqueues — until a full pass does nothing and no handoff is
+        pending.  Structurally idle replicas are SKIPPED: a replica with
+        nothing queued, parked, or delayed, no pending record naming it,
+        and a fresh lease has no step to run, so a pass costs O(active
+        shards) instead of walking every replica's maintain + workqueue
+        (at 10k+ notebooks the idle walks dominated handoff-stall wall
+        time).  When a handoff stalls on a dead member's lease, the
         FakeClock jumps past the lease duration so survivors evict it
         (exactly what wall time does in production).  Returns total
         reconciles executed."""
@@ -711,19 +873,34 @@ class ShardedFleet:
         last_status: Optional[dict] = None
         for _ in range(max_rounds):
             did = 0
+            involved: set = set()
+            for h in self.map_status().get("handoffs") or ():
+                involved.update(h.get("adopters") or ())
+                involved.update(h.get("drains") or ())
             for r in self.alive_replicas():
+                busy = r.manager.has_pending_work()
+                if not busy and r.shard_id not in involved and \
+                        not r.member.renew_due():
+                    continue
                 r.maintain()
-                did += r.manager.run_until_idle(
-                    advance_clock=advance_clock)
+                if busy or r.manager.has_pending_work():
+                    # livelock cap scaled to the shard's outstanding
+                    # work: a 100k-notebook fleet legitimately drains
+                    # tens of thousands of reconciles per round, so a
+                    # flat cap misreads initial convergence as livelock
+                    did += r.manager.run_until_idle(
+                        max_iterations=max(
+                            10_000, 8 * r.manager.pending_count()),
+                        advance_clock=advance_clock)
             total += did
             status = self.map_status()
             changed = status != last_status
             last_status = status
             if did == 0 and not changed:
                 # a full pass moved neither work nor the protocol
-                if status.get("handoff") is None:
+                if not status.get("handoffs"):
                     return total
-                # the handoff waits on a member that will never ack (it
+                # a handoff waits on a member that will never ack (it
                 # died): step time in sub-lease increments — survivors
                 # renew each round, so only the dead lease ages past the
                 # duration and gets evicted
@@ -735,7 +912,7 @@ class ShardedFleet:
                         "made progress and the clock is not advanceable")
         raise RuntimeError("sharded fleet did not settle: handoff "
                            f"stalled after {max_rounds} rounds "
-                           f"({self.pending_handoff()})")
+                           f"({self.pending_handoffs()})")
 
     def merged_records(self) -> list:
         """Every replica's flight-recorder history merged — the
@@ -756,13 +933,27 @@ class ShardedFleet:
     def shard_snapshot(self) -> dict:
         """Fleet-wide shard health: the committed map plus each
         replica's local view — the `shards` section of /debug/fleet and
-        the source the notebook_shard_* metric families scrape."""
+        the source the notebook_shard_* metric families scrape.  The
+        `handoff` key stays the one-record rollup older dashboards read
+        (None when nothing is pending); `handoffs` is the full
+        per-change list."""
         status = self.map_status()
+        records = [dict(h) for h in status.get("handoffs") or ()]
+        merged = None
+        if records:
+            merged = {
+                "epoch": records[-1].get("epoch"),
+                "startedAt": records[0].get("startedAt"),
+                "adopters": sorted({s for h in records
+                                    for s in h.get("adopters") or ()}),
+                "drains": sorted({s for h in records
+                                  for s in h.get("drains") or ()}),
+            }
         return {
             "epoch": int(status.get("epoch") or 0),
             "members": sorted(status.get("members") or {}),
-            "handoff": dict(status["handoff"])
-            if status.get("handoff") else None,
+            "handoff": merged,
+            "handoffs": records,
             "lastHandoff": dict(status["lastHandoff"])
             if status.get("lastHandoff") else None,
             "replicas": {sid: r.snapshot()

@@ -644,24 +644,33 @@ class ApiServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[dict[str, str]] = None,
+        predicate: Optional[Callable[[str, str], bool]] = None,
     ) -> list[KubeObject]:
         """List objects of a kind.  Returns the stored objects themselves
         (copy-on-write contract): they are frozen shared snapshots —
         READ-ONLY.  To mutate one, get() a private copy and update() it;
         mutating a listed object in place is a bug (it would corrupt every
-        other reader's view and defeat the store's no-op detection)."""
+        other reader's view and defeat the store's no-op detection).
+        `predicate(namespace, name)` filters server-side BEFORE results
+        materialize — a sharded informer's resync lists only its owned
+        keys instead of the whole fleet (the apiserver analog is a
+        field/label selector evaluated in the watch cache)."""
         with self._fault_scope("list", kind, namespace or ""):
             shard = self._shard(kind)
             with shard.lock:
-                return self._list_locked(shard, namespace, label_selector)
+                return self._list_locked(shard, namespace, label_selector,
+                                         predicate)
 
     @staticmethod
     def _list_locked(shard: _KindShard, namespace: Optional[str],
-                     label_selector: Optional[dict[str, str]]
+                     label_selector: Optional[dict[str, str]],
+                     predicate: Optional[Callable[[str, str], bool]] = None
                      ) -> list[KubeObject]:
         out = []
-        for (ns, _), obj in shard.objects.items():
+        for (ns, name), obj in shard.objects.items():
             if namespace is not None and ns != namespace:
+                continue
+            if predicate is not None and not predicate(ns, name):
                 continue
             if label_selector and not match_labels(
                     obj.metadata.labels, label_selector):
@@ -675,15 +684,17 @@ class ApiServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[dict[str, str]] = None,
+        predicate: Optional[Callable[[str, str], bool]] = None,
     ) -> tuple[list[KubeObject], int]:
         """List + the cluster resourceVersion as one atomic snapshot, so a
         list-then-watch client cannot miss events that land between the list
         and reading the rv (the apiserver returns both in one response).
-        Same read-only contract as list()."""
+        Same read-only and predicate contracts as list()."""
         with self._fault_scope("list", kind, namespace or ""):
             shard = self._shard(kind)
             with shard.lock:
-                objs = self._list_locked(shard, namespace, label_selector)
+                objs = self._list_locked(shard, namespace, label_selector,
+                                         predicate)
                 with self._rv_lock:
                     return objs, self._rv_counter
 

@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import resource
 import sys
 import time
 from pathlib import Path
@@ -684,6 +685,16 @@ def run_sharded_fleet(count: int, shards: int = 3,
         tracing.set_clock(None)
 
 
+def _shard_namespace_count(count: int, shards: int) -> int:
+    """Tenant namespaces for the sharded benchmark.  Ring placement is
+    namespace-affine (kube/shard.py), and the Kubeflow deployment model
+    is a namespace per user profile — so the keyspace must arrive as
+    many namespaces for the ring to spread it: enough that balance noise
+    stays small (>= 8 per shard), capped so namespace bookkeeping never
+    dominates a 100k run."""
+    return max(8 * shards, min(1024, count // 8)) or 1
+
+
 def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
                        clock: FakeClock) -> dict:
     from kubeflow_tpu.kube.shard import SHARD_MAP_KIND
@@ -699,10 +710,13 @@ def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
     cluster.add_node("cpu-node", allocatable={"cpu": str(count * 8),
                                               "memory": "8192Gi"})
 
+    n_ns = _shard_namespace_count(count, shards)
+    nb_keys = [(f"u{i % n_ns:04d}", f"nb-{i:04d}") for i in range(count)]
+
     def assert_converged(tag: str) -> None:
-        not_ready = [f"nb-{i:04d}" for i in range(count)
-                     if (api.get("Notebook", NAMESPACE,
-                                 f"nb-{i:04d}").body.get("status") or {}
+        not_ready = [name for ns, name in nb_keys
+                     if (api.get("Notebook", ns,
+                                 name).body.get("status") or {}
                          ).get("readyReplicas") != 1]
         if not_ready:
             raise AssertionError(
@@ -721,7 +735,8 @@ def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
     for b in range(n_batches):
         batch = count // n_batches + (1 if b < count % n_batches else 0)
         for i in range(created, created + batch):
-            api.create(Notebook.new(f"nb-{i:04d}", NAMESPACE).obj)
+            ns, name = nb_keys[i]
+            api.create(Notebook.new(name, ns).obj)
         created += batch
         clock.advance(2.0)  # queue dwell (well under the shard lease)
         rollout_reconciles_total += fleet.settle()
@@ -806,10 +821,21 @@ def _run_sharded_fleet(count: int, shards: int, kill_shard: bool,
     result = {
         "count": count,
         "notebooks": count,
+        "namespaces": n_ns,
         "shards": shards,
         "wall_s": round(rollout_wall_s, 3),
         "handoff_wall_s": round(handoff_wall_s, 3),
         "killed_shard": killed,
+        # process high-water RSS (ru_maxrss is KB on Linux).  Monotone
+        # over the process lifetime: in a sweep, each point's figure
+        # includes every smaller point before it — the trend to read is
+        # the growth between points, not the absolute per point.
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            1),
+        # shard-map RMW optimistic-concurrency losses (409s retried with
+        # backoff) — membership contention, the livelock trend
+        "shard_map_rmw_conflicts": fleet.rmw_conflicts(),
         "epoch": final["epoch"],
         "rollout_reconciles_total": rollout_reconciles_total,
         "reconciles_per_notebook": {
@@ -1516,6 +1542,12 @@ def main(argv=None) -> int:
                         "N-replica active-active fleet with a kill+rejoin "
                         "cycle; --check-budget reads the 'sharded' section "
                         "of the budget JSON")
+    parser.add_argument("--budget-section", default="", metavar="NAME",
+                        help="budget JSON section for sharded runs "
+                        "(default 'sharded').  A section carrying a "
+                        "'points' map gates EVERY sweep point listed in "
+                        "it — base ceilings overridden per point — "
+                        "instead of only the largest")
     parser.add_argument("--tenants", type=int, default=0, metavar="N",
                         help="adversarial multi-tenant mode: N namespaces "
                         "of --per-tenant TPU notebooks, tenant --noisy "
@@ -1593,8 +1625,9 @@ def main(argv=None) -> int:
         rc = 0
         if args.check_budget:
             budget = json.loads(Path(args.check_budget).read_text())
-            failures = check_shard_budget(result,
-                                          budget.get("sharded", budget))
+            section = budget.get(args.budget_section or "sharded", budget)
+            failures = check_shard_budget(
+                result, _point_budget(section, result["count"]))
             result["budget_ok"] = not failures
             for f in failures:
                 print(f"SHARD BUDGET FAIL: {f}", file=sys.stderr)
@@ -1676,12 +1709,25 @@ def main(argv=None) -> int:
     return rc
 
 
+def _point_budget(budget: dict, count: int) -> dict:
+    """A budget section scaled to one sweep point: the section's base
+    ceilings with the `points[str(count)]` overrides folded in.  A
+    section without a `points` map (or without this count) gates with
+    its base ceilings unchanged."""
+    sub = (budget.get("points") or {}).get(str(count)) or {}
+    merged = {k: v for k, v in budget.items() if k != "points"}
+    merged.update(sub)
+    return merged
+
+
 def _run_sweep(args) -> int:
     """`--sweep N1,N2,...`: the same fleet at increasing scale, one
     critical-path table + attribution record per point.  The per-point
     records land in --out so CI archives where each stage's contribution
-    starts to bend; the budget gates only the LARGEST point (the smaller
-    ones exist for the curve, not the ceiling)."""
+    starts to bend.  A budget section with a `points` map gates every
+    point it lists against scaled sub-budgets; without one the budget
+    gates only the LARGEST point (the smaller ones exist for the curve,
+    not the ceiling)."""
     points = sorted({int(x) for x in args.sweep.split(",") if x.strip()})
     if not points:
         print("SWEEP: no scale points parsed", file=sys.stderr)
@@ -1696,19 +1742,27 @@ def _run_sweep(args) -> int:
             r.pop("_state", None)
         sweep.append(r)
     rc = 0
-    largest = sweep[-1]
     if args.check_budget:
         budget = json.loads(Path(args.check_budget).read_text())
         if args.shards:
-            failures = check_shard_budget(largest,
-                                          budget.get("sharded", budget))
+            section = budget.get(args.budget_section or "sharded", budget)
         else:
-            failures = check_budget(largest, budget)
-        largest["budget_ok"] = not failures
-        for f in failures:
-            print(f"SWEEP BUDGET FAIL (count={largest['count']}): {f}",
-                  file=sys.stderr)
-            rc = 1
+            section = budget
+        point_budgets = section.get("points") or {}
+        for rec in sweep:
+            if point_budgets:
+                if str(rec["count"]) not in point_budgets:
+                    continue  # runs for the curve, not the ceiling
+            elif rec is not sweep[-1]:
+                continue
+            merged = _point_budget(section, rec["count"])
+            failures = (check_shard_budget(rec, merged) if args.shards
+                        else check_budget(rec, merged))
+            rec["budget_ok"] = not failures
+            for f in failures:
+                print(f"SWEEP BUDGET FAIL (count={rec['count']}): {f}",
+                      file=sys.stderr)
+                rc = 1
     out = {
         "mode": "sweep",
         "points": points,

@@ -47,6 +47,7 @@ from kubeflow_tpu.kube import (
 )
 from kubeflow_tpu.kube.events import EventRecorder
 from kubeflow_tpu.testing.interleave import InterleavingExplorer, await_cond
+from kubeflow_tpu.utils import invariants
 from kubeflow_tpu.utils.clock import FakeClock
 from kubeflow_tpu.utils.config import CoreConfig
 
@@ -530,8 +531,8 @@ def shard_handoff_scenario(shard_mod=None):
         assert status.get("epoch") == 2, (
             "membership change must be exactly one epoch bump: %r"
             % status.get("epoch"))
-        assert status.get("handoff") is None, (
-            "handoff record left open: %r" % status.get("handoff"))
+        assert not status.get("handoffs"), (
+            "handoff record left open: %r" % status.get("handoffs"))
         assert (status.get("lastHandoff") or {}).get("epoch") == 2, status
         for name in names:                    # no key dropped
             ann = api.get("Notebook", "default", name) \
@@ -544,6 +545,116 @@ def shard_handoff_scenario(shard_mod=None):
 
 def test_shard_handoff_single_owner_under_all_schedules():
     _explore(shard_handoff_scenario)
+
+
+def shard_concurrent_join_scenario(shard_mod=None):
+    """TWO replicas join an established fleet simultaneously — both
+    per-change handoff records are pending at once, which is exactly the
+    case the stable-ring drain gate exists for (a single previous-ring
+    snapshot is the wrong gate when changes overlap).
+
+    Every schedule must keep the INSTANTANEOUS single-owner contract:
+    the dispatch filter never admits a key on two replicas at once.
+    Each thread models reconcile windows explicitly — a key the filter
+    admits is held in a shared map across a preemption point; a second
+    holder is an overlap.  The schedule-independent end state: both
+    records complete, and ownership is an exact partition by the final
+    ring."""
+    if shard_mod is None:
+        shard_mod = importlib.import_module("kubeflow_tpu.kube.shard")
+    api = ApiServer()
+    clock = FakeClock()
+    # one namespace per final owner (a: team-3, b: team-0, c: team-1
+    # on the shard-a/b/c ring) — the smallest keyspace where BOTH
+    # joiners gain keys and the survivor keeps one, kept small so the
+    # bounded DFS covers the run's opening steps within its budget
+    keys = [("team-0", "nb-0"), ("team-1", "nb-1"), ("team-3", "nb-3")]
+    for ns, name in keys:
+        api.create(Notebook.new(name, ns).obj)
+    replicas = {sid: shard_mod.ShardedReplica(api, sid, clock=clock)
+                for sid in ("shard-a", "shard-b", "shard-c")}
+    a = replicas["shard-a"]
+    a.join_fleet()
+    joined = {"shard-b": False, "shard-c": False}
+    holders: dict = {}
+    # the committed pending-record list, published to plain Python state
+    # at every map commit (the in-process watch fires on the committing
+    # thread) so await_cond predicates may read it without touching the
+    # apiserver from the scheduler thread.  Commit fan-out happens
+    # outside the store lock, so two writers' events can arrive out of
+    # commit order — mirror by resourceVersion, exactly like the
+    # replicas' own rv-guarded _install_status.
+    records_view: list = [list(a.member.read_status().get("handoffs")
+                               or []), 0]
+
+    def mirror_map(ev):
+        rv = ev.obj.metadata.resource_version
+        if rv <= records_view[1]:
+            return
+        records_view[1] = rv
+        records_view[0] = list(
+            (ev.obj.body.get("status") or {}).get("handoffs") or [])
+
+    api.watch(mirror_map, kinds=[shard_mod.SHARD_MAP_KIND])
+
+    def dispatch_pass(sid):
+        replica = replicas[sid]
+        for key in keys:
+            if replica.owns_key(*key):
+                cur = holders.setdefault(key, set())
+                assert not cur, (
+                    "single-owner violation: %s dispatched %r while %r "
+                    "held it" % (sid, key, sorted(cur)))
+                cur.add(sid)
+                invariants.yield_point("shard.window", (sid,) + key)
+                cur.discard(sid)
+
+    def run_survivor():
+        dispatch_pass("shard-a")
+        await_cond("a-sees-joins",
+                   lambda: joined["shard-b"] and joined["shard-c"])
+        # one RMW acks shard-a out of EVERY pending record's drains
+        a.sync()
+        dispatch_pass("shard-a")
+
+    def run_joiner(sid):
+        replica = replicas[sid]
+        view = replica.member.join()
+        replica._install_status(view, rv=replica.member.last_commit_rv)
+        joined[sid] = True
+        dispatch_pass(sid)
+        await_cond(sid + "-sees-joins",
+                   lambda: joined["shard-b"] and joined["shard-c"])
+        replica.sync()  # ack own drains for the other joiner's record
+        dispatch_pass(sid)
+        await_cond(sid + "-grants-drained", lambda: not any(
+            h.get("drains") for h in records_view[0]
+            if sid in (h.get("adopters") or ())))
+        replica.sync()  # adopt the gained keys, ack out of the record
+        dispatch_pass(sid)
+
+    def check():
+        status = a.member.read_status()
+        assert sorted(status.get("members") or {}) == \
+            ["shard-a", "shard-b", "shard-c"], status
+        assert status.get("epoch") == 3, status
+        assert not status.get("handoffs"), (
+            "a per-change record was left open: %r"
+            % status.get("handoffs"))
+        assert status.get("lastHandoff"), status
+        ring = shard_mod.HashRing(sorted(status["members"]))
+        for key in keys:
+            owners = [sid for sid, r in replicas.items()
+                      if r.owns_key(*key)]
+            assert owners == [ring.owner_of(*key)], (key, owners)
+
+    return [("a-run", run_survivor),
+            ("b-join", lambda: run_joiner("shard-b")),
+            ("c-join", lambda: run_joiner("shard-c"))], check
+
+
+def test_shard_concurrent_joins_single_owner_under_all_schedules():
+    _explore(shard_concurrent_join_scenario)
 
 
 # -- byte-exact replay ---------------------------------------------------------
@@ -633,9 +744,9 @@ MUTANT_B = [
 ]
 
 
-def _explore_mutant(scenario):
-    ex = InterleavingExplorer(scenario, max_preemptions=2,
-                              max_schedules=600, budget_s=120.0)
+def _explore_mutant(scenario, *, max_preemptions=2, max_schedules=600):
+    ex = InterleavingExplorer(scenario, max_preemptions=max_preemptions,
+                              max_schedules=max_schedules, budget_s=120.0)
     res = ex.explore()
     assert res.failure is not None, (
         "mutant survived %d schedules — the harness cannot falsify"
@@ -732,7 +843,38 @@ def test_mutant_adopt_before_commit_fails_writeahead_analyzer():
     found = [v for v in wa.analyze(mutated)
              if v.context == "ShardedReplica.join_fleet"]
     assert found, "analyzer missed the commit-after-adopt reorder"
-    assert "not dominated" in found[0].message
+
+
+# Mutant O: drop the stable-ring drain gate in owns_key — a shard starts
+# dispatching keys it GAINED in a still-draining handoff while the
+# previous owner may have one inside an open reconcile window.
+MUTANT_OVERLAP = [(
+    """        if gated:
+            if not stable.members or \\
+                    stable.owner_of(namespace, name) != self.shard_id:
+                return False
+        return True""",
+    """        del gated, stable  # MUTANT O: drain gate dropped
+        return True""",
+)]
+
+
+def test_mutant_dropped_drain_gate_is_caught():
+    """Deleting the drain gate must be caught by a shrunk schedule of
+    the concurrent-join scenario: a joiner dispatches a gained key
+    inside the previous owner's still-open window."""
+    mod = _load_mutant("kubeflow_tpu.kube.shard", MUTANT_OVERLAP,
+                       "kubeflow_tpu.kube._shard_mutant_o")
+
+    # bound 1: the overlap needs exactly one preemption (into the
+    # survivor's open window), and the bound-2 DFS burns its schedule
+    # budget in deep suffix subtrees before reaching the run's opening
+    # steps, where the survivor still owns the whole keyspace.  The
+    # deepest-first sweep reaches those steps around schedule ~800, so
+    # the cap gets headroom over the default 600.
+    fail = _explore_mutant(lambda: shard_concurrent_join_scenario(mod),
+                           max_preemptions=1, max_schedules=1500)
+    assert "single-owner violation" in fail.message, fail.message
 
 
 def test_mutant_reordered_claim_commit_is_caught():

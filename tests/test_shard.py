@@ -1,5 +1,6 @@
-"""Active-active sharded control plane (kube/shard.py): consistent-hash
-ownership, fenced writes, write-ahead handoff, kill/rejoin survival.
+"""Active-active sharded control plane (kube/shard.py): namespace-affine
+consistent-hash ownership, fenced writes, per-change write-ahead handoff
+records, kill/rejoin survival.
 
 The headline invariant — one owner per key at every instant, across
 processes — is asserted three ways here: the dispatch filter agrees with
@@ -8,6 +9,9 @@ and the merged flight-recorder sweep finds zero cross-replica overlaps.
 """
 
 from __future__ import annotations
+
+import random
+from collections import Counter
 
 import pytest
 
@@ -32,6 +36,17 @@ def nb(name, ns="default"):
     return Notebook.new(name, ns).obj
 
 
+#: placement is namespace-affine (a key's ring position hashes only its
+#: namespace), so fleet fixtures spread keys over several tenant
+#: namespaces — these six split 2/2/2 across shard-0/1/2
+NAMESPACES = [f"team-{i}" for i in range(6)]
+
+
+def spread(n, nss=NAMESPACES):
+    """n (namespace, name) keys spread round-robin over namespaces."""
+    return [(nss[i % len(nss)], f"nb-{i}") for i in range(n)]
+
+
 def make_member(api, sid, clock, lease=DEFAULT_LEASE_DURATION_S):
     return ShardMember(api, sid, clock=clock, lease_duration_s=lease)
 
@@ -48,20 +63,29 @@ class _Recorder:
 
 class TestHashRing:
     def test_deterministic_across_observers(self):
-        keys = [("default", f"nb-{i}") for i in range(200)]
+        keys = [(f"ns-{i}", "nb") for i in range(200)]
         a = HashRing(["s0", "s1", "s2"])
         b = HashRing(["s2", "s0", "s1"])  # order must not matter
         assert [a.owner_of(*k) for k in keys] == [b.owner_of(*k) for k in keys]
 
     def test_every_member_owns_a_share(self):
         ring = HashRing(["s0", "s1", "s2"])
-        owners = {ring.owner_of("default", f"nb-{i}") for i in range(200)}
+        owners = {ring.owner_of(f"ns-{i}", "nb") for i in range(200)}
         assert owners == {"s0", "s1", "s2"}
+
+    def test_namespace_affinity_ignores_the_name(self):
+        """All keys of one namespace share one owner — the placement
+        property that keeps a tenant's churn on one shard's cache."""
+        ring = HashRing(["s0", "s1", "s2"])
+        for i in range(50):
+            ns = f"ns-{i}"
+            owners = {ring.owner_of(ns, f"nb-{j}") for j in range(25)}
+            assert len(owners) == 1
 
     def test_join_moves_a_fraction_not_half(self):
         """Consistent hashing's point: a 4th member takes roughly 1/4 of
         the keyspace; keys that don't move to it don't move at all."""
-        keys = [("default", f"nb-{i}") for i in range(500)]
+        keys = [(f"ns-{i}", "nb") for i in range(500)]
         before = HashRing(["s0", "s1", "s2"])
         after = HashRing(["s0", "s1", "s2", "s3"])
         moved = sum(1 for k in keys
@@ -73,7 +97,7 @@ class TestHashRing:
                     "a key not gained by the joiner must not move"
 
     def test_departure_only_moves_the_departed_keys(self):
-        keys = [("default", f"nb-{i}") for i in range(500)]
+        keys = [(f"ns-{i}", "nb") for i in range(500)]
         before = HashRing(["s0", "s1", "s2"])
         after = HashRing(["s0", "s1"])
         for k in keys:
@@ -82,6 +106,76 @@ class TestHashRing:
 
     def test_empty_ring_owns_nothing(self):
         assert HashRing(()).owner_of("default", "nb") is None
+
+
+#: candidate member ids for the seeded property sweeps below
+_POOL = [f"cp-{i}" for i in range(64)]
+
+
+class TestRingProperties:
+    """Seeded property sweeps over random membership sets — the three
+    contracts the 100k-sweep placement lever rests on.  Bounds are set
+    from measured worst cases (balance 1.6x fair share, movement 1.45x
+    the consistent-hashing expectation) with headroom; a regression in
+    vnode spreading or hash mixing trips them."""
+
+    def test_one_owner_per_namespace_always(self):
+        rng = random.Random(7)
+        for _ in range(100):
+            members = rng.sample(_POOL, rng.randrange(1, 9))
+            ring = HashRing(members)
+            for _ in range(10):
+                ns = f"ns-{rng.randrange(10 ** 9)}"
+                owners = {ring.owner_of(ns, f"nb-{j}") for j in range(8)}
+                assert len(owners) == 1
+                assert owners <= set(members)
+
+    def test_balance_bound_over_random_membership_sets(self):
+        rng = random.Random(1234)
+        namespaces = [f"ns-{i}" for i in range(512)]
+        for _ in range(100):
+            n = rng.randrange(2, 9)
+            members = rng.sample(_POOL, n)
+            counts = Counter(HashRing(members).owner_of(ns, "x")
+                             for ns in namespaces)
+            assert set(counts) <= set(members)
+            max_share = max(counts.values()) / len(namespaces)
+            assert max_share <= 2.0 / n, \
+                (members, dict(counts), max_share)
+
+    def test_join_movement_bounded_and_targeted(self):
+        """A join moves at most ~2x the consistent-hashing bound K/N,
+        and only ever moves keys TO the joiner."""
+        rng = random.Random(99)
+        namespaces = [f"ns-{i}" for i in range(512)]
+        for _ in range(100):
+            n = rng.randrange(2, 9)
+            members = rng.sample(_POOL, n)
+            joiner = next(m for m in _POOL if m not in members)
+            before = HashRing(members)
+            after = HashRing(members + [joiner])
+            moved = 0
+            for ns in namespaces:
+                b, a = before.owner_of(ns, "x"), after.owner_of(ns, "x")
+                if b != a:
+                    assert a == joiner, \
+                        "a join may only move keys to the joiner"
+                    moved += 1
+            assert moved <= 2.0 * len(namespaces) / (n + 1), \
+                (members, joiner, moved)
+
+    def test_leave_movement_only_from_the_departed(self):
+        rng = random.Random(4242)
+        namespaces = [f"ns-{i}" for i in range(512)]
+        for _ in range(100):
+            members = rng.sample(_POOL, rng.randrange(2, 9))
+            gone = rng.choice(members)
+            before = HashRing(members)
+            after = HashRing([m for m in members if m != gone])
+            for ns in namespaces:
+                if before.owner_of(ns, "x") != gone:
+                    assert after.owner_of(ns, "x") == \
+                        before.owner_of(ns, "x")
 
 
 class TestShardMember:
@@ -93,8 +187,9 @@ class TestShardMember:
         assert a.token.valid and a.token.epoch == 1
         assert api.get(SHARD_MAP_KIND, "", "control-plane") is not None
         # solo joiner: nobody to drain, self-adoption is the only ack
-        assert view["handoff"]["adopters"] == ["a"]
-        assert view["handoff"]["drains"] == []
+        (rec,) = view["handoffs"]
+        assert rec["adopters"] == ["a"]
+        assert rec["drains"] == []
 
     def test_second_join_bumps_epoch_and_writes_handoff_ahead(self):
         api, clock = ApiServer(), FakeClock()
@@ -106,8 +201,9 @@ class TestShardMember:
         assert b.token.epoch == 2
         assert a.token.epoch == 1, "survivor incarnation must not move"
         # the SAME commit that admitted b names the key movement
-        assert view["handoff"] == {
-            "epoch": 2, "startedAt": view["handoff"]["startedAt"],
+        (rec,) = view["handoffs"]
+        assert rec == {
+            "epoch": 2, "startedAt": rec["startedAt"],
             "adopters": ["b"], "drains": ["a"]}
 
     def test_ack_lifecycle_completes_handoff_with_duration(self):
@@ -117,10 +213,11 @@ class TestShardMember:
         b.join()
         clock.advance(2.5)
         view = a.ack_drain()
-        assert view["handoff"]["drains"] == []
-        assert view["handoff"]["adopters"] == ["b"]
+        (rec,) = view["handoffs"]
+        assert rec["drains"] == []
+        assert rec["adopters"] == ["b"]
         view, duration = b.ack_adopt()
-        assert "handoff" not in view
+        assert "handoffs" not in view
         assert duration == pytest.approx(2.5)
         assert view["lastHandoff"]["epoch"] == 2
         assert view["lastHandoff"]["durationSeconds"] == pytest.approx(2.5)
@@ -132,8 +229,37 @@ class TestShardMember:
         b.join()
         view, duration = b.ack_adopt()
         assert duration is None
-        assert view["handoff"]["drains"] == ["a"], \
+        assert view["handoffs"][0]["drains"] == ["a"], \
             "the record must survive until the drain acks too"
+
+    def test_two_overlapping_joins_carry_independent_records(self):
+        """Per-change records: two simultaneous joins each commit their
+        OWN adopter/drain lists instead of convoying through one merged
+        record, and one drain-ack RMW clears a member out of every
+        pending record at once."""
+        api, clock = ApiServer(), FakeClock()
+        a = make_member(api, "a", clock)
+        a.join(); a.ack_adopt()
+        b, c = make_member(api, "b", clock), make_member(api, "c", clock)
+        b.join()
+        view = c.join()
+        recs = view["handoffs"]
+        assert [r["epoch"] for r in recs] == [2, 3]
+        assert recs[0]["adopters"] == ["b"]
+        assert recs[0]["drains"] == ["a"]
+        assert recs[1]["adopters"] == ["c"]
+        assert recs[1]["drains"] == ["a", "b"]
+        # one ack RMW removes a from BOTH records' drains
+        view = a.ack_drain()
+        assert [r["drains"] for r in view["handoffs"]] == [[], ["b"]]
+        b.ack_drain()
+        view, duration = b.ack_adopt()
+        assert duration is not None, "b's record completed"
+        view, duration = c.ack_adopt()
+        assert duration is not None, "c's record completed"
+        assert "handoffs" not in view
+        # completions land in epoch order: the highest epoch wins
+        assert view["lastHandoff"]["epoch"] == 3
 
     def test_renew_keeps_incarnation_and_evicts_expired(self):
         api, clock = ApiServer(), FakeClock()
@@ -149,7 +275,24 @@ class TestShardMember:
         assert status["epoch"] == 3, "eviction must bump the epoch"
         assert a.token.epoch == 1, "renewals never change the incarnation"
         # the eviction commit hands the dead member's keys to survivors
-        assert status["handoff"]["adopters"] == ["a"]
+        assert status["handoffs"][0]["adopters"] == ["a"]
+
+    def test_renew_due_coalesces_heartbeats(self):
+        """renew_due gates the maintain-loop heartbeat: fresh leases are
+        not re-renewed every settle round (the steady-state map write
+        the 100k sweep eliminated), but a third of the lease flips it
+        and a fenced or never-joined member is always due."""
+        api, clock = ApiServer(), FakeClock()
+        a = make_member(api, "a", clock)
+        assert a.renew_due(), "a member that never joined is always due"
+        a.join()
+        assert not a.renew_due()
+        clock.advance(DEFAULT_LEASE_DURATION_S / 3 + 0.1)
+        assert a.renew_due()
+        assert a.renew()
+        assert not a.renew_due()
+        a.token.invalidate()
+        assert a.renew_due(), "a fenced member is always due"
 
     def test_evicted_member_renew_fails_and_invalidates(self):
         api, clock = ApiServer(), FakeClock()
@@ -173,7 +316,7 @@ class TestShardMember:
         assert not a.token.valid
         assert sorted(view["members"]) == ["b"]
         assert view["epoch"] == 3
-        assert view["handoff"]["adopters"] == ["b"]
+        assert view["handoffs"][0]["adopters"] == ["b"]
 
     def test_preview_join_never_writes(self):
         api, clock = ApiServer(), FakeClock()
@@ -255,30 +398,50 @@ class TestShardedFleet:
         api, clock = ApiServer(), FakeClock()
         recs = {}
         fleet = make_fleet(api, clock, recs=recs)
-        names = [f"nb-{i}" for i in range(20)]
-        for name in names:
-            api.create(nb(name))
+        keys = spread(20)
+        for ns, name in keys:
+            api.create(nb(name, ns))
         fleet.settle()
         snap = fleet.shard_snapshot()
         assert snap["members"] == ["shard-0", "shard-1", "shard-2"]
         assert snap["handoff"] is None
+        assert snap["handoffs"] == []
         owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
         assert sum(owned.values()) == 20
         assert all(v > 0 for v in owned.values())
+        assert all(r["rmw_conflicts"] == 0
+                   for r in snap["replicas"].values())
         # dispatch filter and committed ring agree, exactly one owner each
-        for name in names:
-            owner = fleet.owner_of("default", name)
+        for ns, name in keys:
+            owner = fleet.owner_of(ns, name)
             claimants = [sid for sid, r in fleet.replicas.items()
-                         if r.owns_key("default", name)]
+                         if r.owns_key(ns, name)]
             assert claimants == [owner]
-            assert recs[owner].seen.count(("default", name)) >= 1
+            assert recs[owner].seen.count((ns, name)) >= 1
+
+    def test_namespace_lands_whole_on_one_shard(self):
+        """The placement lever itself: every key of one namespace is
+        owned — and was reconciled — by the same shard."""
+        api, clock = ApiServer(), FakeClock()
+        recs = {}
+        fleet = make_fleet(api, clock, recs=recs)
+        for ns in NAMESPACES:
+            for i in range(4):
+                api.create(nb(f"nb-{i}", ns))
+        fleet.settle()
+        for ns in NAMESPACES:
+            owner = fleet.owner_of(ns, "nb-0")
+            for i in range(4):
+                assert fleet.owner_of(ns, f"nb-{i}") == owner
+                done_by = [sid for sid, r in recs.items()
+                           if (ns, f"nb-{i}") in r.seen]
+                assert done_by == [owner]
 
     def test_kill_evicts_and_survivors_adopt(self):
         api, clock = ApiServer(), FakeClock()
         fleet = make_fleet(api, clock)
-        names = [f"nb-{i}" for i in range(20)]
-        for name in names:
-            api.create(nb(name))
+        for ns, name in spread(20):
+            api.create(nb(name, ns))
         fleet.settle()
         epoch_before = fleet.shard_snapshot()["epoch"]
         fleet.kill("shard-1")
@@ -295,8 +458,8 @@ class TestShardedFleet:
     def test_zombie_write_after_eviction_is_fenced(self):
         api, clock = ApiServer(), FakeClock()
         fleet = make_fleet(api, clock)
-        for i in range(10):
-            api.create(nb(f"nb-{i}"))
+        for ns, name in spread(10):
+            api.create(nb(name, ns))
         fleet.settle()
         fleet.kill("shard-1")
         expire_dead_lease(fleet, clock)
@@ -310,8 +473,8 @@ class TestShardedFleet:
     def test_rejoin_restores_membership_with_fresh_incarnation(self):
         api, clock = ApiServer(), FakeClock()
         fleet = make_fleet(api, clock)
-        for i in range(20):
-            api.create(nb(f"nb-{i}"))
+        for ns, name in spread(20):
+            api.create(nb(name, ns))
         fleet.settle()
         old_incarnation = fleet.replicas["shard-1"].member.token.epoch
         fleet.kill("shard-1")
@@ -332,8 +495,8 @@ class TestShardedFleet:
         the single-owner proof the chaos soak scales up."""
         api, clock = ApiServer(), FakeClock()
         fleet = make_fleet(api, clock)
-        for i in range(20):
-            api.create(nb(f"nb-{i}"))
+        for ns, name in spread(20):
+            api.create(nb(name, ns))
         fleet.settle()
         fleet.kill("shard-2")
         expire_dead_lease(fleet, clock)
@@ -342,11 +505,38 @@ class TestShardedFleet:
         assert len(fleet.merged_records()) > 0
         assert fleet.cross_process_overlaps() == []
 
+    def test_two_simultaneous_joins_settle_cleanly(self):
+        """Two replicas join back-to-back with NO settle in between:
+        both per-change records are pending at once, and the fleet still
+        converges to an exact single-owner partition (the overlapping-
+        handoff case the stable-ring dispatch gate exists for)."""
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock, count=2)
+        keys = spread(24)
+        for ns, name in keys:
+            api.create(nb(name, ns))
+        fleet.settle()
+        fleet.add_replica("shard-2")
+        fleet.add_replica("shard-3")
+        fleet.settle()
+        snap = fleet.shard_snapshot()
+        assert snap["members"] == \
+            ["shard-0", "shard-1", "shard-2", "shard-3"]
+        assert snap["handoff"] is None
+        assert snap["handoffs"] == []
+        owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
+        assert sum(owned.values()) == 24
+        for ns, name in keys:
+            claimants = [sid for sid, r in fleet.replicas.items()
+                         if r.owns_key(ns, name)]
+            assert claimants == [fleet.owner_of(ns, name)]
+        assert fleet.cross_process_overlaps() == []
+
     def test_graceful_leave_hands_off_without_expiry(self):
         api, clock = ApiServer(), FakeClock()
         fleet = make_fleet(api, clock)
-        for i in range(12):
-            api.create(nb(f"nb-{i}"))
+        for ns, name in spread(12):
+            api.create(nb(name, ns))
         fleet.settle()
         fleet.replicas["shard-0"].leave_fleet()
         fleet.settle()  # no clock advance needed: leave commits the record
@@ -356,6 +546,57 @@ class TestShardedFleet:
         owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
         assert owned["shard-0"] == 0
         assert owned["shard-1"] + owned["shard-2"] == 12
+
+
+class TestSettleSkipsIdle:
+    """A settle pass costs O(active shards): replicas with nothing
+    queued, no pending handoff record naming them, and a fresh lease are
+    skipped entirely — at 10k+ notebooks the idle maintain+workqueue
+    walks dominated the sweep's handoff-stall wall time."""
+
+    def _count_maintains(self, fleet):
+        counts = {}
+        for sid, r in fleet.replicas.items():
+            def wrapped(orig=r.maintain, sid=sid):
+                counts[sid] = counts.get(sid, 0) + 1
+                return orig()
+            r.maintain = wrapped
+        return counts
+
+    def test_idle_fleet_settles_without_touching_replicas(self):
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        for ns, name in spread(12):
+            api.create(nb(name, ns))
+        fleet.settle()
+        counts = self._count_maintains(fleet)
+        assert fleet.settle(advance_clock=False) == 0
+        assert counts == {}, "idle replicas still walked in settle"
+
+    def test_only_the_busy_shard_runs(self):
+        api, clock = ApiServer(), FakeClock()
+        recs = {}
+        fleet = make_fleet(api, clock, recs=recs)
+        for ns, name in spread(12):
+            api.create(nb(name, ns))
+        fleet.settle()
+        counts = self._count_maintains(fleet)
+        owner = fleet.owner_of("team-0", "late")
+        api.create(nb("late", "team-0"))
+        assert fleet.settle(advance_clock=False) >= 1
+        assert set(counts) == {owner}, \
+            "only the shard owning the new key should run"
+        assert ("team-0", "late") in recs[owner].seen
+
+    def test_due_renewals_still_happen_when_idle(self):
+        api, clock = ApiServer(), FakeClock()
+        fleet = make_fleet(api, clock)
+        fleet.settle()
+        clock.advance(DEFAULT_LEASE_DURATION_S / 2)
+        counts = self._count_maintains(fleet)
+        fleet.settle(advance_clock=False)
+        assert set(counts) == set(fleet.replicas), \
+            "a due lease renewal must not be skipped"
 
 
 class TestDrainGate:
@@ -368,9 +609,9 @@ class TestDrainGate:
         r0 = ShardedReplica(api, "shard-0", clock=clock)
         r0.manager.register("nb", _Recorder("shard-0"), for_kind="Notebook")
         r0.join_fleet()
-        names = [f"nb-{i}" for i in range(20)]
-        for name in names:
-            api.create(nb(name))
+        keys = [(f"team-{i}", f"nb-{i}") for i in range(20)]
+        for ns, name in keys:
+            api.create(nb(name, ns))
         r0.manager.run_until_idle()
         r1 = ShardedReplica(api, "shard-1", clock=clock)
         r1.manager.register("nb", _Recorder("shard-1"), for_kind="Notebook")
@@ -378,27 +619,26 @@ class TestDrainGate:
         # handoff is now pending with drains=[shard-0]
         view = r1.member.join()
         r1._install_status(view)
-        gained = [n for n in names
-                  if HashRing(["shard-0", "shard-1"])
-                  .owner_of("default", n) == "shard-1"]
+        ring = HashRing(["shard-0", "shard-1"])
+        gained = [k for k in keys if ring.owner_of(*k) == "shard-1"]
         assert gained, "the joiner must gain part of the keyspace"
-        for name in gained:
-            assert not r1.owns_key("default", name), \
+        for ns, name in gained:
+            assert not r1.owns_key(ns, name), \
                 "gained key dispatched before the loser drained"
-            assert not r0.owns_key("default", name), \
+            assert not r0.owns_key(ns, name), \
                 "the ring moved the key: the loser must stop dispatching"
         # the loser acks its drain; the gate opens
         r0.sync()
-        for name in gained:
-            assert r1.owns_key("default", name)
+        for ns, name in gained:
+            assert r1.owns_key(ns, name)
 
     def test_cache_realigns_on_both_sides(self):
         api, clock = ApiServer(), FakeClock()
         r0 = ShardedReplica(api, "shard-0", clock=clock)
         r0.manager.register("nb", _Recorder("shard-0"), for_kind="Notebook")
         r0.join_fleet()
-        for i in range(20):
-            api.create(nb(f"nb-{i}"))
+        for ns, name in [(f"team-{i}", f"nb-{i}") for i in range(20)]:
+            api.create(nb(name, ns))
         r0.manager.run_until_idle()
         r0.sync()
         assert r0.keys_owned() == 20
@@ -636,17 +876,18 @@ class TestMainWiring:
         clock = FakeClock()
         fleet, api, cluster, metrics = build_sharded_fleet(
             count=3, clock=clock)
-        for i in range(6):
-            api.create(nb(f"nb-{i}"))
+        keys = spread(6)
+        for ns, name in keys:
+            api.create(nb(name, ns))
         fleet.settle()
         snap = fleet.shard_snapshot()
         assert snap["members"] == ["shard-0", "shard-1", "shard-2"]
         owned = {sid: r["keys_owned"] for sid, r in snap["replicas"].items()}
         assert sum(owned.values()) == 6
+        assert all(v > 0 for v in owned.values())
         # the real reconcilers ran: every notebook has a StatefulSet
-        for i in range(6):
-            assert api.try_get("StatefulSet", "default", f"nb-{i}") \
-                is not None
+        for ns, name in keys:
+            assert api.try_get("StatefulSet", ns, name) is not None
         text = metrics.scrape()
         for family in ("notebook_shard_keys_owned", "notebook_shard_epoch",
                        "notebook_shard_fenced_writes_total",
